@@ -1,0 +1,44 @@
+#
+# spark_rapids_ml_tpu — a TPU-native distributed ML framework with the
+# capabilities of spark-rapids-ml (reference: /root/reference).
+#
+# The reference is a pyspark.ml-compatible orchestration layer dispatching to
+# cuML/CUDA multi-GPU kernels synchronized by NCCL/UCX.  This framework is a
+# standalone re-design for TPU: the same estimator/model API surface
+# (fit/transform/save/load, Param system, CPU fallback, single-pass
+# CrossValidator, Pipeline) over a JAX SPMD runtime — row-sharded device
+# arrays on a `jax.sharding.Mesh`, XLA collectives (psum/all_gather/ppermute)
+# over ICI/DCN instead of NCCL/UCX, and jit/shard_map kernels instead of cuML.
+#
+# Layer map (analog of reference SURVEY.md §1):
+#   L6 API facade   models/{feature,clustering,classification,regression,knn,umap}
+#   L5 Param system params.py
+#   L4 Core runtime core.py  (_TpuEstimator/_TpuModel, staging, persistence)
+#   L3 Comm         parallel/ (Mesh, TpuContext, collectives over ICI/DCN)
+#   L2 Device/mem   parallel/mesh.py + data.py (host staging, sharded device put)
+#   L1 Compute      ops/ (jax.jit / shard_map / pallas kernels)
+#
+import sys as _sys
+
+__version__ = "0.1.0"
+
+from . import config  # noqa: F401
+
+# Re-export algorithm modules at the top level so imports mirror the
+# reference package layout (`spark_rapids_ml.feature` etc., reference
+# python/src/spark_rapids_ml/__init__.py).
+from .models import (  # noqa: F401
+    classification,
+    clustering,
+    feature,
+    knn,
+    regression,
+    umap,
+)
+
+_sys.modules[__name__ + ".feature"] = feature
+_sys.modules[__name__ + ".clustering"] = clustering
+_sys.modules[__name__ + ".classification"] = classification
+_sys.modules[__name__ + ".regression"] = regression
+_sys.modules[__name__ + ".knn"] = knn
+_sys.modules[__name__ + ".umap"] = umap

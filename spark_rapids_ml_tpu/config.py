@@ -1,0 +1,91 @@
+#
+# Global configuration — the analog of the reference's Spark-conf tier
+# (`spark.rapids.ml.{uvm.enabled, sam.enabled, gpuMemRatioForData,
+# cpu.fallback.enabled, verbose, float32_inputs, num_workers}`, read at
+# reference core.py:776-812 and core.py:1124-1170).  Without a Spark session
+# the confs live in a process-global dict, overridable from the environment
+# (`SPARK_RAPIDS_ML_TPU_<KEY>`) or `set_config()`.
+#
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_lock = threading.Lock()
+
+# Keys deliberately mirror the reference conf names (docs/site/configuration.md
+# in the reference repo) minus the spark.rapids.ml prefix.
+_DEFAULTS: Dict[str, Any] = {
+    # Cast float64 inputs to float32 on device (reference core.py:776,
+    # params.py:276-286).  TPU MXU strongly prefers f32/bf16.
+    "float32_inputs": True,
+    # Number of model-parallel workers (= mesh size).  None -> all visible
+    # jax devices (reference params.py:556-588 infers from cluster GPUs).
+    "num_workers": None,
+    # Fall back to sklearn on CPU when unsupported params are set
+    # (reference `spark.rapids.ml.cpu.fallback.enabled`, core.py:1283-1297).
+    "cpu_fallback_enabled": False,
+    # Verbose logging level 0-6 (reference core.py:413-436).
+    "verbose": 0,
+    # Fraction of free device memory to budget for staged training data
+    # (reference `spark.rapids.ml.gpuMemRatioForData`, utils.py:403-522).
+    # On TPU, XLA owns HBM; this bounds the host->device staging chunking.
+    "mem_ratio_for_data": 0.8,
+    # Host staging buffer size in bytes for streaming parquet reads.
+    "host_batch_bytes": 512 * 1024 * 1024,
+    # Multi-host bootstrap: coordinator address for jax.distributed
+    # (analog of the NCCL-uid allGather bootstrap, cuml_context.py:96-102).
+    "coordinator_address": None,
+    "process_id": None,
+    "num_processes": None,
+}
+
+_ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"
+
+_config: Dict[str, Any] = {}
+
+
+# Explicit types for keys whose default is None (type can't be inferred).
+_TYPES: Dict[str, type] = {
+    "num_workers": int,
+    "process_id": int,
+    "num_processes": int,
+    "coordinator_address": str,
+}
+
+
+def _coerce(key: str, raw: str) -> Any:
+    ty = _TYPES.get(key)
+    if ty is None:
+        ty = type(_DEFAULTS[key])
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+def get_config(key: str, default: Optional[Any] = None) -> Any:
+    if key not in _DEFAULTS and default is None:
+        raise KeyError(f"Unknown config key: {key}")
+    with _lock:
+        if key in _config:
+            return _config[key]
+    env = os.environ.get(_ENV_PREFIX + key.upper())
+    if env is not None and key in _DEFAULTS:
+        return _coerce(key, env)
+    return _config.get(key, _DEFAULTS.get(key, default))
+
+
+def set_config(**kwargs: Any) -> None:
+    with _lock:
+        for k, v in kwargs.items():
+            if k not in _DEFAULTS:
+                raise KeyError(f"Unknown config key: {k}")
+            _config[k] = v
+
+
+def reset_config() -> None:
+    with _lock:
+        _config.clear()

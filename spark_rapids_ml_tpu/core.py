@@ -1,0 +1,503 @@
+#
+# Core runtime — the analog of reference core.py (1967 LoC):
+# `_CumlCaller` (core.py:439) / `_CumlEstimator` (core.py:1067) /
+# `_CumlModel` (core.py:1356) re-designed for a single-controller JAX SPMD
+# runtime.  The reference's orchestration shape
+#   preprocess -> repartition(num_workers) -> mapInPandas barrier fit over
+#   NCCL -> collect model rows -> driver model
+# becomes
+#   extract host arrays -> shard rows onto a Mesh -> jit'd kernel with XLA
+#   collectives -> host model attributes
+# with no process boundary: the controller stages data and XLA moves it.
+#
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from abc import abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .data import DatasetLike, _ensure_dense, extract_arrays
+from .params import Param, Params, _TpuParams
+from .parallel import TpuContext, get_mesh, replicate, shard_rows
+from .parallel.mesh import row_mask
+from .utils import PartitionDescriptor, _ArrayBatch, get_logger
+
+
+@dataclass
+class FitInput:
+    """Everything a kernel needs for one distributed fit — the analog of the
+    `params` dict handed to `_cuml_fit_func` (reference `param_alias`
+    core.py:154-175: handle/part_sizes/num_cols/rank/loop)."""
+
+    mesh: Any  # jax.sharding.Mesh
+    X: Any  # jax.Array, rows sharded over DATA_AXIS, zero-padded
+    w: Any  # jax.Array (N_pad,) validity * sample weight
+    y: Optional[Any]  # jax.Array or None
+    pdesc: PartitionDescriptor
+    dtype: np.dtype
+    n_valid: int
+    params: Dict[str, Any]  # resolved backend params (_tpu_params)
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def _resolve_feature_params(inst: Params) -> Tuple[Optional[str], Sequence[str]]:
+    """Which column(s) hold features: featuresCol/featuresCols for
+    predictors, inputCol/inputCols for feature transformers like PCA
+    (reference _PCACumlParams setInputCol feature.py:77-115)."""
+    features_cols: Sequence[str] = ()
+    if inst.hasParam("featuresCols") and inst.isSet("featuresCols"):
+        features_cols = inst.getOrDefault("featuresCols")
+    elif inst.hasParam("inputCols") and inst.isSet("inputCols"):
+        features_cols = inst.getOrDefault("inputCols")
+    features_col: Optional[str] = None
+    if inst.hasParam("featuresCol") and inst.isDefined("featuresCol"):
+        features_col = inst.getOrDefault("featuresCol")
+    if inst.hasParam("inputCol") and inst.isSet("inputCol"):
+        features_col = inst.getOrDefault("inputCol")
+    return features_col, features_cols
+
+
+class Estimator(Params):
+    """pyspark.ml.Estimator-compatible base."""
+
+    def fit(self, dataset: DatasetLike, params: Optional[Dict[Param, Any]] = None):
+        if params:
+            return self.copy(params)._fit(dataset)
+        return self._fit(dataset)
+
+    @abstractmethod
+    def _fit(self, dataset: DatasetLike):
+        ...
+
+
+class Transformer(Params):
+    """pyspark.ml.Transformer-compatible base."""
+
+    def transform(self, dataset: DatasetLike, params: Optional[Dict[Param, Any]] = None):
+        if params:
+            return self.copy(params)._transform(dataset)
+        return self._transform(dataset)
+
+    @abstractmethod
+    def _transform(self, dataset: DatasetLike):
+        ...
+
+
+class Model(Transformer):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Persistence (reference _CumlEstimatorWriter/Reader core.py:268-307 and
+# _CumlModelWriter/Reader core.py:310-355).  Directory layout:
+#   <path>/metadata.json   class, uid, params, _tpu_params, scalar attributes
+#   <path>/arrays.npz      ndarray model attributes
+# ---------------------------------------------------------------------------
+
+
+def _json_default(o: Any) -> Any:
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+class _Writer:
+    def __init__(self, instance: "_TpuParams") -> None:
+        self.instance = instance
+        self._overwrite = False
+
+    def overwrite(self) -> "_Writer":
+        self._overwrite = True
+        return self
+
+    def save(self, path: str) -> None:
+        if os.path.exists(path) and not self._overwrite:
+            raise IOError(f"Path {path} already exists; use .write().overwrite().save()")
+        os.makedirs(path, exist_ok=True)
+        inst = self.instance
+        metadata: Dict[str, Any] = {
+            "class": type(inst).__module__ + "." + type(inst).__qualname__,
+            "uid": inst.uid,
+            "timestamp": int(time.time() * 1000),
+            "paramMap": {p.name: v for p, v in inst._paramMap.items()},
+            "defaultParamMap": {p.name: v for p, v in inst._defaultParamMap.items()},
+            "tpu_params": inst._tpu_params,
+            "num_workers": inst._num_workers,
+            "float32_inputs": inst._float32_inputs,
+        }
+        arrays: Dict[str, np.ndarray] = {}
+        if isinstance(inst, _TpuModel):
+            attrs: Dict[str, Any] = {}
+            for k, v in inst._get_model_attributes().items():
+                if isinstance(v, np.ndarray):
+                    arrays[k] = v
+                else:
+                    attrs[k] = v
+            metadata["attributes"] = attrs
+            metadata["array_attributes"] = sorted(arrays)
+        with open(os.path.join(path, "metadata.json"), "w") as f:
+            json.dump(metadata, f, default=_json_default)
+        npz_path = os.path.join(path, "arrays.npz")
+        if os.path.exists(npz_path):
+            os.remove(npz_path)  # stale arrays from a previous overwrite-save
+        if arrays:
+            np.savez(npz_path, **arrays)
+
+
+def _load_metadata(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "metadata.json")) as f:
+        return json.load(f)
+
+
+def _load_arrays(path: str) -> Dict[str, np.ndarray]:
+    npz_path = os.path.join(path, "arrays.npz")
+    if not os.path.exists(npz_path):
+        return {}
+    with np.load(npz_path, allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+class _ReadWriteMixin:
+    """save/load entry points shared by estimators and models."""
+
+    def write(self) -> _Writer:
+        return _Writer(self)  # type: ignore[arg-type]
+
+    def save(self, path: str) -> None:
+        self.write().save(path)
+
+    @classmethod
+    def _restore_params(cls, inst: "_TpuParams", meta: Dict[str, Any]) -> None:
+        for name, v in meta.get("defaultParamMap", {}).items():
+            if inst.hasParam(name):
+                inst._defaultParamMap[inst.getParam(name)] = v
+        for name, v in meta.get("paramMap", {}).items():
+            if inst.hasParam(name):
+                inst._paramMap[inst.getParam(name)] = v
+        inst._tpu_params = dict(meta.get("tpu_params", {}))
+        inst._num_workers = meta.get("num_workers")
+        inst._float32_inputs = meta.get("float32_inputs", True)
+
+    @classmethod
+    def load(cls, path: str):
+        meta = _load_metadata(path)
+        if issubclass(cls, _TpuModel):
+            arrays = _load_arrays(path)
+            wanted = meta.get("array_attributes")
+            if wanted is not None:
+                arrays = {k: v for k, v in arrays.items() if k in wanted}
+            attrs = dict(meta.get("attributes", {}))
+            attrs.update(arrays)
+            inst = cls._from_attributes(attrs)
+        else:
+            inst = cls()
+        cls._restore_params(inst, meta)
+        return inst
+
+    @classmethod
+    def read(cls):
+        class _Reader:
+            @staticmethod
+            def load(path: str):
+                return cls.load(path)
+
+        return _Reader()
+
+
+# ---------------------------------------------------------------------------
+# _TpuCaller: shared fit-calling logic (reference _CumlCaller core.py:439)
+# ---------------------------------------------------------------------------
+
+
+class _TpuCaller(_TpuParams, _ReadWriteMixin):
+    def _out_dtype(self, X: np.ndarray) -> np.dtype:
+        # float64 stays float64 only when float32_inputs is disabled
+        # (reference _float32_inputs handling, core.py:514-537).
+        if X.dtype == np.float64 and not self._float32_inputs:
+            return np.dtype(np.float64)
+        return np.dtype(np.float32)
+
+    def _require_p2p(self) -> bool:
+        """Analog of `_require_nccl_ucx` (reference core.py:570-577): whether
+        the kernel needs p2p-style all-to-all (exact kNN, DBSCAN)."""
+        return False
+
+    def _fit_label_dtype(self) -> Optional[np.dtype]:
+        return np.dtype(np.float32)
+
+    def _stage_fit_input(
+        self,
+        batch: _ArrayBatch,
+        paramMaps: Optional[Sequence[Dict[str, Any]]] = None,
+    ) -> FitInput:
+        """Stage host arrays onto the mesh — the analog of the executor-side
+        staging loop + CumlContext entry (reference core.py:886-994)."""
+        import jax
+
+        with TpuContext(self.num_workers, require_p2p=self._require_p2p()) as ctx:
+            mesh = ctx.mesh
+        n_dev = mesh.devices.size
+        X_host = _ensure_dense(batch.X)
+        dtype = self._out_dtype(X_host)
+        Xs, n_valid = shard_rows(X_host, mesh, dtype=dtype)
+        n_padded = Xs.shape[0]
+        w_host = np.zeros((n_padded,), dtype=dtype)
+        if batch.weight is not None:
+            w_host[:n_valid] = batch.weight.astype(dtype)
+        else:
+            w_host[:n_valid] = 1.0
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .parallel.mesh import DATA_AXIS
+
+        w = jax.device_put(w_host, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+        y = None
+        if batch.y is not None:
+            ldt = self._fit_label_dtype() or dtype
+            y_host = np.zeros((n_padded,), dtype=ldt)
+            y_host[:n_valid] = batch.y.astype(ldt)
+            y = jax.device_put(y_host, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+        per_shard = [n_padded // n_dev] * n_dev
+        pdesc = PartitionDescriptor.build(per_shard, X_host.shape[1])
+        return FitInput(
+            mesh=mesh,
+            X=Xs,
+            w=w,
+            y=y,
+            pdesc=pdesc,
+            dtype=dtype,
+            n_valid=n_valid,
+            params=dict(self._tpu_params),
+        )
+
+
+# ---------------------------------------------------------------------------
+# _TpuEstimator (reference _CumlEstimator core.py:1067)
+# ---------------------------------------------------------------------------
+
+
+class _TpuEstimator(Estimator, _TpuCaller):
+    def __init__(self) -> None:
+        super().__init__()
+        self._init_tpu_params()
+        self.logger = get_logger(type(self))
+
+    # -- subclass contract ---------------------------------------------------
+
+    @abstractmethod
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
+        """Run the distributed kernel, return host model attributes — the
+        analog of the closure returned by `_get_cuml_fit_func`
+        (e.g. reference classification.py:968-1221)."""
+
+    @abstractmethod
+    def _create_model(self, attrs: Dict[str, Any]) -> "_TpuModel":
+        """Build the Model from fit attributes (reference
+        `_create_pyspark_model` core.py:1267-1279)."""
+
+    def _is_supervised(self) -> bool:
+        return False
+
+    def _enable_fit_multiple_in_single_pass(self) -> bool:
+        # Reference core.py:1172-1175.
+        return True
+
+    def _supports_cpu_fallback(self) -> bool:
+        return self._cpu_fit is not _TpuEstimator._cpu_fit
+
+    def _cpu_fit(self, batch: _ArrayBatch) -> "_TpuModel":
+        """sklearn fallback fit (the reference falls back to pyspark.ml,
+        core.py:1283-1297; without Spark the CPU engine is sklearn)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no CPU fallback implementation"
+        )
+
+    # -- fit orchestration ---------------------------------------------------
+
+    def _extract(self, dataset: DatasetLike) -> _ArrayBatch:
+        features_col, features_cols = _resolve_feature_params(self)
+        label_col = (
+            self.getOrDefault("labelCol")
+            if self._is_supervised() and self.hasParam("labelCol")
+            else None
+        )
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self.hasParam("weightCol") and self.isSet("weightCol")
+            else None
+        )
+        return extract_arrays(
+            dataset,
+            features_col=features_col,
+            features_cols=features_cols,
+            label_col=label_col,
+            weight_col=weight_col,
+            dtype=np.float64,  # preserve input precision; _out_dtype decides
+            supervised=self._is_supervised(),
+        )
+
+    def _fit(self, dataset: DatasetLike) -> "_TpuModel":
+        if self._use_cpu_fallback():
+            self.logger.warning(
+                "Unsupported params set; falling back to CPU (sklearn) fit "
+                "(analog of spark.rapids.ml.cpu.fallback, reference core.py:1283-1297)."
+            )
+            model = self._cpu_fit(self._extract(dataset))
+            self._copyValues(model)
+            return model
+        t0 = time.time()
+        batch = self._extract(dataset)
+        fit_input = self._stage_fit_input(batch)
+        attrs = self._fit_array(fit_input)
+        model = self._create_model(attrs)
+        self._copyValues(model)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        self.logger.info(f"Finished fit in {time.time() - t0:.3f}s")
+        return model
+
+    def fitMultiple(
+        self, dataset: DatasetLike, paramMaps: Sequence[Dict[Param, Any]]
+    ) -> Iterator[Tuple[int, "_TpuModel"]]:
+        """Fit one model per param map in a SINGLE pass over the data: the
+        dataset is staged onto the mesh once and every param map re-runs the
+        (cached-compile) kernel on the resident device arrays — the analog of
+        the reference's single-pass fitMultiple (core.py:1177-1228,
+        `_FitMultipleIterator` core.py:1022-1064)."""
+        estimator = self.copy()
+
+        if estimator._enable_fit_multiple_in_single_pass():
+            batch = estimator._extract(dataset)
+            fit_input = estimator._stage_fit_input(batch)
+
+            def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
+                est_i = estimator.copy(paramMaps[index])
+                fi = FitInput(
+                    **{**fit_input.__dict__, "params": dict(est_i._tpu_params)}
+                )
+                attrs = est_i._fit_array(fi)
+                model = est_i._create_model(attrs)
+                est_i._copyValues(model, paramMaps[index])
+                return index, model
+
+        else:
+
+            def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
+                return index, estimator.fit(dataset, paramMaps[index])
+
+        return _FitMultipleIterator(fit_single, len(paramMaps))
+
+
+class _FitMultipleIterator:
+    """Thread-safe (index, model) iterator (reference core.py:1022-1064)."""
+
+    def __init__(self, fitSingleModel: Callable[[int], Tuple[int, Any]], numModels: int):
+        self.fitSingleModel = fitSingleModel
+        self.numModels = numModels
+        self.counter = 0
+        self.lock = threading.Lock()
+
+    def __iter__(self) -> "_FitMultipleIterator":
+        return self
+
+    def __next__(self) -> Tuple[int, Any]:
+        with self.lock:
+            index = self.counter
+            if index >= self.numModels:
+                raise StopIteration("No models remaining.")
+            self.counter += 1
+        return self.fitSingleModel(index)
+
+
+class _TpuEstimatorSupervised(_TpuEstimator):
+    """Supervised variant (reference _CumlEstimatorSupervised core.py:1314)."""
+
+    def _is_supervised(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# _TpuModel (reference _CumlModel core.py:1356, _CumlModelWithColumns
+# core.py:1756, _CumlModelWithPredictionCol core.py:1957)
+# ---------------------------------------------------------------------------
+
+
+class _TpuModel(Model, _TpuCaller):
+    def __init__(self, **model_attributes: Any) -> None:
+        super().__init__()
+        self._init_tpu_params()
+        self._model_attributes = model_attributes
+        self.logger = get_logger(type(self))
+
+    def _get_model_attributes(self) -> Dict[str, Any]:
+        return self._model_attributes
+
+    @classmethod
+    def _from_attributes(cls, attrs: Dict[str, Any]) -> "_TpuModel":
+        return cls(**attrs)
+
+    # -- transform contract --------------------------------------------------
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        """Map a feature block to output columns ({col_name: values}).
+        The analog of the per-batch predict closure from
+        `_get_cuml_transform_func` (reference core.py:1846-1881)."""
+        raise NotImplementedError
+
+    def _output_columns(self) -> List[str]:
+        if self.hasParam("predictionCol"):
+            return [self.getOrDefault("predictionCol")]
+        return ["prediction"]
+
+    def _transform(self, dataset: DatasetLike):
+        """Append output columns to a pandas DataFrame input, or return the
+        primary output array for array input (reference
+        `_CumlModelWithColumns._transform` core.py:1797-1941)."""
+        import pandas as pd
+
+        features_col, features_cols = _resolve_feature_params(self)
+        batch = extract_arrays(
+            dataset,
+            features_col=features_col,
+            features_cols=features_cols,
+            dtype=np.float64,
+            supervised=False,
+        )
+        X = _ensure_dense(batch.X)
+        dtype = self._out_dtype(X)
+        outputs = self._transform_array(np.asarray(X, dtype=dtype))
+        if isinstance(dataset, pd.DataFrame):
+            out_df = dataset.copy()
+            for col, values in outputs.items():
+                vals: Any = values
+                if isinstance(values, np.ndarray) and values.ndim == 2:
+                    vals = list(values)
+                out_df[col] = vals
+            return out_df
+        if len(outputs) == 1:
+            return next(iter(outputs.values()))
+        return outputs
+
+    # -- multi-model single-pass evaluation (reference core.py:1572-1753) ----
+
+    @classmethod
+    def _combine(cls, models: List["_TpuModel"]) -> "_TpuModel":
+        raise NotImplementedError
+
+    def _transformEvaluate(self, dataset: DatasetLike, evaluator: Any) -> List[float]:
+        raise NotImplementedError
+
+    def cpu(self):
+        """Equivalent sklearn model (the reference returns the pyspark.ml
+        model, e.g. utils.py:585-809 tree translation)."""
+        raise NotImplementedError

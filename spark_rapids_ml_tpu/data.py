@@ -1,0 +1,155 @@
+#
+# Data plane — the analog of the reference's input pre-processing
+# (`_CumlCaller._pre_process_data` core.py:467-568: column selection, dtype
+# cast, VectorUDT unwrap / vector_to_array, dimension probe) and the worker
+# staging loop (core.py:886-957).  Without Spark, the accepted dataset types
+# are: numpy 2-D arrays, (X, y) tuples, scipy CSR matrices, pandas
+# DataFrames (array-valued features column — the VectorUDT analog — or
+# multiple scalar columns, reference HasFeaturesCols params.py:69-88),
+# pyarrow Tables, and parquet paths.
+#
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .utils import _ArrayBatch, _concat_and_free
+
+try:  # scipy is baked in but keep the import soft
+    import scipy.sparse as sp
+except Exception:  # pragma: no cover
+    sp = None
+
+
+DatasetLike = Any  # np.ndarray | pd.DataFrame | pa.Table | str | tuple | csr_matrix
+
+
+def _is_sparse(x: Any) -> bool:
+    return sp is not None and sp.issparse(x)
+
+
+def _ensure_dense(X: Any) -> np.ndarray:
+    """Densify sparse host matrices before device staging.  TPU has no
+    cusparse analog (SURVEY.md §7 hard part (e)); until the BCOO kernel path
+    lands, CSR inputs densify on the host (the reference's LogReg similarly
+    switches representations at staging, classification.py:960-966)."""
+    if _is_sparse(X):
+        return np.ascontiguousarray(X.toarray())
+    return X
+
+
+def _to_pandas(dataset: DatasetLike):
+    import pandas as pd
+    import pyarrow as pa
+
+    if isinstance(dataset, pd.DataFrame):
+        return dataset
+    if isinstance(dataset, pa.Table):
+        return dataset.to_pandas()
+    if isinstance(dataset, str):
+        import pyarrow.parquet as pq
+
+        if os.path.isdir(dataset) or dataset.endswith(".parquet"):
+            return pq.read_table(dataset).to_pandas()
+        raise ValueError(f"Unsupported dataset path: {dataset}")
+    raise TypeError(f"Cannot interpret dataset of type {type(dataset)} as a DataFrame")
+
+
+def _features_from_pandas(
+    pdf,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    dtype: np.dtype,
+) -> np.ndarray:
+    """Extract the feature matrix from a pandas DataFrame.
+
+    Array-valued column == the reference's VectorUDT input unwrapped via
+    `vector_to_array` (core.py:493-537); multiple scalar columns == the
+    reference's HasFeaturesCols fast path that skips VectorAssembler
+    (params.py:69-88, pipeline.py:85-119).
+    """
+    if features_cols:
+        missing = [c for c in features_cols if c not in pdf.columns]
+        if missing:
+            raise ValueError(f"featuresCols {missing} not found in dataset")
+        return np.ascontiguousarray(pdf[list(features_cols)].to_numpy(dtype=dtype))
+    assert features_col is not None
+    if features_col not in pdf.columns:
+        raise ValueError(f"featuresCol '{features_col}' not found in dataset")
+    col = pdf[features_col]
+    first = col.iloc[0]
+    if np.isscalar(first):
+        return np.ascontiguousarray(col.to_numpy(dtype=dtype).reshape(-1, 1))
+    return np.ascontiguousarray(np.stack([np.asarray(v, dtype=dtype) for v in col]))
+
+
+def extract_arrays(
+    dataset: DatasetLike,
+    features_col: Optional[str] = None,
+    features_cols: Sequence[str] = (),
+    label_col: Optional[str] = None,
+    weight_col: Optional[str] = None,
+    id_col: Optional[str] = None,
+    dtype: Union[np.dtype, type] = np.float32,
+    supervised: bool = False,
+) -> _ArrayBatch:
+    """Normalize any accepted dataset into host numpy arrays.
+
+    The analog of `_pre_process_data` + the worker staging loop
+    (reference core.py:467-568, 886-957) collapsed into one host-side step:
+    there is no Spark/Arrow process boundary to cross, so the controller
+    assembles the full (X, y, w) arrays and `shard_rows` splits them onto
+    the mesh.
+    """
+    dtype = np.dtype(dtype)
+    y = w = rid = None
+
+    if isinstance(dataset, (tuple, list)) and len(dataset) == 2:
+        X, y = dataset
+        X = np.asarray(X, dtype=dtype) if not _is_sparse(X) else X
+        y = np.asarray(y)
+    elif isinstance(dataset, np.ndarray):
+        X = np.asarray(dataset, dtype=dtype)
+        if X.ndim == 1:
+            X = X.reshape(-1, 1)
+    elif _is_sparse(dataset):
+        X = dataset.tocsr()
+    else:
+        pdf = _to_pandas(dataset)
+        X = _features_from_pandas(pdf, features_col, list(features_cols), dtype)
+        if supervised:
+            if label_col is None or label_col not in pdf.columns:
+                raise ValueError(f"labelCol '{label_col}' not found in dataset")
+            y = pdf[label_col].to_numpy()
+        if weight_col and weight_col in pdf.columns:
+            w = pdf[weight_col].to_numpy(dtype=dtype)
+        if id_col and id_col in pdf.columns:
+            rid = pdf[id_col].to_numpy()
+
+    if supervised and y is None:
+        raise ValueError("Supervised fit requires labels: pass (X, y) or a DataFrame with labelCol")
+    if y is not None:
+        y = np.ascontiguousarray(np.asarray(y).reshape(-1))
+    if not _is_sparse(X):
+        X = np.ascontiguousarray(np.asarray(X, dtype=dtype))
+    return _ArrayBatch(X=X, y=y, weight=w, row_id=rid)
+
+
+def read_parquet_batches(
+    path: str, columns: Optional[List[str]] = None, batch_rows: int = 1_000_000
+):
+    """Stream a parquet dataset in record-batch chunks — the host-side
+    staging loop used for out-of-core inputs (reference reserved-memory
+    loader utils.py:403-522 streams Arrow batches straight into a
+    pre-reserved GPU buffer; here batches stream host->HBM per chunk)."""
+    import pyarrow.dataset as ds
+
+    dataset = ds.dataset(path, format="parquet")
+    for batch in dataset.to_batches(columns=columns, batch_size=batch_rows):
+        yield batch.to_pandas()
+
+
+def infer_dimension(batch: _ArrayBatch) -> int:
+    return int(batch.X.shape[1])

@@ -1,0 +1,3 @@
+# API facade — pyspark.ml-compatible estimators/models (reference
+# python/src/spark_rapids_ml/{feature,clustering,classification,regression,
+# knn,umap}.py), backed by the ops/ TPU kernels.

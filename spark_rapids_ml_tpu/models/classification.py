@@ -1,0 +1,2 @@
+# Implemented progressively; see models/feature.py for the pattern.
+__all__: list = []

@@ -1,0 +1,194 @@
+#
+# Feature transformers: PCA — the analog of reference feature.py (468 LoC).
+# The cuML PCAMG distributed fit (feature.py:240-261) is replaced by
+# ops/pca.py: one sharded Gram matmul + replicated eigh.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..core import FitInput, _TpuEstimator, _TpuModel
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasInputCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    _TpuParams,
+)
+from ..utils import _ArrayBatch
+
+
+class PCAClass:
+    """Param mapping (reference PCAClass feature.py:60-75)."""
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {"k": "n_components"}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_components": None,
+            "svd_solver": "auto",
+            "verbose": False,
+            "whiten": False,
+        }
+
+
+class _PCATpuParams(_TpuParams, HasInputCol, HasOutputCol, HasFeaturesCol, HasFeaturesCols):
+    """Shared params for PCA / PCAModel (reference _PCACumlParams
+    feature.py:77-130)."""
+
+    k = Param("_", "k", "the number of principal components.", TypeConverters.toInt)
+    inputCols = Param(
+        "_", "inputCols", "input column names for multi-column features.",
+        TypeConverters.toListString,
+    )
+
+    def setInputCol(self, value: Union[str, List[str]]) -> "_PCATpuParams":
+        if isinstance(value, str):
+            self._set_params(inputCol=value)
+        else:
+            self._set_params(inputCols=value)
+        return self
+
+    def setInputCols(self, value: List[str]) -> "_PCATpuParams":
+        return self._set_params(inputCols=value)
+
+    def setOutputCol(self, value: str) -> "_PCATpuParams":
+        return self._set_params(outputCol=value)
+
+    def getInputCol(self) -> Union[str, List[str]]:
+        if self.isSet(self.inputCols):
+            return self.getOrDefault(self.inputCols)
+        if self.isDefined(self.inputCol):
+            return self.getOrDefault(self.inputCol)
+        raise RuntimeError("inputCol is not set")
+
+    def setK(self, value: int) -> "_PCATpuParams":
+        return self._set_params(k=value)
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+
+class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
+    """Distributed PCA on TPU (API parity: reference PCA feature.py:117-297).
+
+    Learns the top-k principal components of row-sharded data with a single
+    psum'd Gram matrix per fit.  Spark semantics: `transform` projects the
+    raw (uncentered) input onto the components.
+
+    Examples
+    --------
+    >>> import pandas as pd
+    >>> from spark_rapids_ml_tpu.feature import PCA
+    >>> df = pd.DataFrame({"features": [[-1.0, -1.0], [0.0, 0.0], [1.0, 1.0]]})
+    >>> model = PCA(k=1).setInputCol("features").setOutputCol("pca_features").fit(df)
+    >>> model.transform(df)["pca_features"].tolist()  # doctest: +SKIP
+    [[-1.414...], [0.0], [1.414...]]
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(k=None)
+        self._set_params(**kwargs)
+
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
+        from ..ops.pca import pca_fit
+
+        k = fit_input.params.get("n_components") or fit_input.pdesc.n
+        if k > fit_input.pdesc.n:
+            raise ValueError(f"k={k} exceeds the number of features {fit_input.pdesc.n}")
+        mean, components, ev, evr, sv = pca_fit(fit_input.X, fit_input.w, int(k))
+        return {
+            "mean_": np.asarray(mean),
+            "components_": np.asarray(components),
+            "explained_variance_": np.asarray(ev),
+            "explained_variance_ratio_": np.asarray(evr),
+            "singular_values_": np.asarray(sv),
+            "n_cols": fit_input.pdesc.n,
+            "dtype": str(np.dtype(fit_input.dtype).name),
+        }
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "PCAModel":
+        return PCAModel(**attrs)
+
+    def _cpu_fit(self, batch: _ArrayBatch) -> "PCAModel":
+        from sklearn.decomposition import PCA as SkPCA
+
+        k = self.getOrDefault("k") or batch.X.shape[1]
+        sk = SkPCA(n_components=k, svd_solver="full").fit(batch.X)
+        model = PCAModel(
+            mean_=sk.mean_.astype(batch.X.dtype),
+            components_=sk.components_.astype(batch.X.dtype),
+            explained_variance_=sk.explained_variance_.astype(batch.X.dtype),
+            explained_variance_ratio_=sk.explained_variance_ratio_.astype(batch.X.dtype),
+            singular_values_=sk.singular_values_.astype(batch.X.dtype),
+            n_cols=int(batch.X.shape[1]),
+            dtype=str(batch.X.dtype),
+        )
+        return model
+
+
+class PCAModel(PCAClass, _TpuModel, _PCATpuParams):
+    """PCA projection model (reference PCAModel feature.py:299-468).
+
+    Note: like Spark, `transform` does NOT remove the mean — cuML does, and
+    the reference adds `mean @ components^T` back (feature.py:447-459); here
+    the projection is simply `X @ components^T`.
+    """
+
+    def __init__(self, **attrs: Any) -> None:
+        super().__init__(**attrs)
+        self.mean_: np.ndarray = np.asarray(attrs["mean_"])
+        self.components_: np.ndarray = np.asarray(attrs["components_"])
+        self.explained_variance_: np.ndarray = np.asarray(attrs["explained_variance_"])
+        self.explained_variance_ratio_: np.ndarray = np.asarray(
+            attrs["explained_variance_ratio_"]
+        )
+        self.singular_values_: np.ndarray = np.asarray(attrs["singular_values_"])
+        self.n_cols: int = int(attrs["n_cols"])
+        self.dtype: str = str(attrs.get("dtype", "float32"))
+        self._set_params(k=int(self.components_.shape[0]))
+
+    @property
+    def pc(self) -> np.ndarray:
+        """Principal components as a (n_features, k) matrix, matching
+        pyspark.ml PCAModel.pc (column-major components)."""
+        return self.components_.T
+
+    @property
+    def explainedVariance(self) -> np.ndarray:
+        """Ratio of variance explained per component (pyspark parity)."""
+        return self.explained_variance_ratio_
+
+    def _output_columns(self) -> List[str]:
+        return [self.getOrDefault("outputCol")]
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        from ..ops.pca import pca_transform
+
+        out = np.asarray(
+            pca_transform(jnp.asarray(X), jnp.asarray(self.components_.astype(X.dtype)))
+        )
+        return {self.getOrDefault("outputCol"): out}
+
+    def cpu(self):
+        from sklearn.decomposition import PCA as SkPCA
+
+        sk = SkPCA(n_components=self.components_.shape[0])
+        sk.components_ = self.components_
+        sk.mean_ = self.mean_
+        sk.explained_variance_ = self.explained_variance_
+        sk.explained_variance_ratio_ = self.explained_variance_ratio_
+        sk.singular_values_ = self.singular_values_
+        sk.n_components_ = self.components_.shape[0]
+        sk.n_features_in_ = self.n_cols
+        return sk

@@ -1,0 +1,9 @@
+#
+# ops/ — the compute layer: jit/shard_map kernels over row-sharded global
+# arrays.  This is the TPU-native replacement for the external cuML/cuVS/RAFT
+# CUDA kernels the reference dispatches to (SURVEY.md §2.11).  Kernels are
+# pure functions over (X, w, y) where X is a zero-padded global jax.Array
+# sharded over the "data" mesh axis and w carries validity/sample weights;
+# XLA's SPMD partitioner inserts the psum/all_gather collectives that NCCL
+# performed inside the cuML MG kernels.
+#

@@ -1,0 +1,61 @@
+#
+# PCA kernel — the TPU-native replacement for `cuml.decomposition.pca_mg.
+# PCAMG.fit` (called from reference feature.py:240-261).  The cuML MG kernel
+# computes a distributed covariance then an eigendecomposition with NCCL
+# reductions; here the Gram matrix of the row-sharded centered data is one
+# jnp matmul (XLA inserts the psum over ICI) and the k×k eigh runs
+# replicated on every chip.
+#
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("k",))
+def pca_fit(X: jax.Array, w: jax.Array, k: int):
+    """Distributed PCA fit.
+
+    X: (N_pad, d) rows sharded over the data axis, zero-padded.
+    w: (N_pad,) validity weights (0 for padded rows).
+    Returns (mean (d,), components (k,d), explained_variance (k,),
+             explained_variance_ratio (k,), singular_values (k,)).
+
+    The d×d covariance keeps all FLOPs in one MXU-friendly matmul; the
+    eigendecomposition of the small replicated matrix matches the
+    reference's strategy (distributed cov + replicated eig,
+    SURVEY.md §2.11 row 1).
+    """
+    wsum = w.sum()
+    mean = (X * w[:, None]).sum(axis=0) / wsum
+    # sqrt-weighted centering keeps cov = A^T A symmetric in one matmul;
+    # padded rows have w=0 and drop out.
+    A = (X - mean) * jnp.sqrt(w)[:, None]
+    cov = (A.T @ A) / (wsum - 1.0)
+    evals, evecs = jnp.linalg.eigh(cov)  # ascending order
+    evals = evals[::-1]
+    evecs = evecs[:, ::-1]
+    components = evecs[:, :k].T  # (k, d)
+    # Deterministic sign: largest-|.| element of each component positive
+    # (cuML's signFlip, reference deprecated/native rapidsml_jni.cu:35;
+    # same convention as sklearn's svd_flip on components).
+    flip_idx = jnp.argmax(jnp.abs(components), axis=1)
+    signs = jnp.sign(components[jnp.arange(k), flip_idx])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    components = components * signs[:, None]
+    explained_variance = jnp.clip(evals[:k], 0.0, None)
+    total_var = jnp.clip(evals, 0.0, None).sum()
+    explained_variance_ratio = explained_variance / total_var
+    singular_values = jnp.sqrt(explained_variance * (wsum - 1.0))
+    return mean, components, explained_variance, explained_variance_ratio, singular_values
+
+
+@jax.jit
+def pca_transform(X: jax.Array, components: jax.Array):
+    """Spark-semantics projection: X @ PC^T with NO mean removal.  cuML
+    centers and the reference adds mean@PC^T back to match Spark
+    (feature.py:447-459); projecting the raw X is the same result in one
+    matmul."""
+    return X @ components.T

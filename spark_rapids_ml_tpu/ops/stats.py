@@ -1,0 +1,35 @@
+#
+# Distributed summary statistics — the analog of the reference's
+# `_standardize_dataset` (utils.py:876-982: in-place on-GPU mean/std with
+# cross-worker reduction through barrier allGather + sum).  Here the
+# reduction is a plain jnp sum over the row-sharded global array; XLA emits
+# the psum over ICI.
+#
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def weighted_moments(X: jax.Array, w: jax.Array):
+    """Weighted column mean and (Spark summarizer, ddof=1-scaled) std.
+
+    X: (N_pad, d) rows sharded; w: (N_pad,) validity*sample weights.
+    Returns (mean (d,), std (d,), wsum ()).  Matches Spark's
+    MultivariateOnlineSummarizer semantics used by LinearRegression /
+    LogisticRegression standardization (reference utils.py:917-935).
+    """
+    wsum = w.sum()
+    mean = (X * w[:, None]).sum(axis=0) / wsum
+    centered = X - mean
+    var = ((centered * centered) * w[:, None]).sum(axis=0) / jnp.maximum(wsum - 1.0, 1.0)
+    std = jnp.sqrt(var)
+    std = jnp.where(std == 0.0, 1.0, std)
+    return mean, std, wsum
+
+
+@jax.jit
+def standardize(X: jax.Array, w: jax.Array, mean: jax.Array, std: jax.Array):
+    """(X - mean) / std with padded rows kept at zero."""
+    return ((X - mean) / std) * (w[:, None] > 0)

@@ -1,0 +1,19 @@
+#
+# parallel/ — the communication + device layer: the analog of the
+# reference's `common/cuml_context.py` (NCCL/UCX bootstrap over Spark
+# barrier allGather, reference cuml_context.py:35-206) and the GPU-placement
+# half of utils.py.  On TPU the whole layer collapses into JAX's SPMD model:
+# a `jax.sharding.Mesh` over the pod slice, XLA collectives over ICI/DCN,
+# and `jax.distributed.initialize` for the multi-host bootstrap.
+#
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    get_mesh,
+    pad_rows,
+    replicate,
+    shard_rows,
+    data_pspec,
+    replicated_pspec,
+)
+from .context import TpuContext  # noqa: F401

@@ -1,0 +1,82 @@
+#
+# TpuContext — the analog of the reference's `CumlContext` context manager
+# (reference common/cuml_context.py:35-206).  The reference bootstraps NCCL
+# (rank 0 creates a unique id, Spark barrier `allGather` distributes it,
+# cuml_context.py:96-102), optionally builds a UCX endpoint mesh for p2p
+# (cuml_context.py:104-115), and injects both into a RAFT handle.
+#
+# On TPU the same responsibilities map to:
+#   - NCCL uid allGather bootstrap  ->  `jax.distributed.initialize`
+#     (coordinator address + process id + process count)
+#   - RAFT handle with comms        ->  `jax.sharding.Mesh` over the global
+#     device set; XLA emits ICI/DCN collectives from shardings
+#   - UCX p2p endpoint mesh         ->  `jax.lax.ppermute` / all_to_all
+#     (no explicit endpoints: the compiler schedules transfers)
+#   - teardown destroy()/abort()    ->  `jax.distributed.shutdown`
+#
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..config import get_config
+from ..utils import get_logger
+from .mesh import get_mesh
+
+
+class TpuContext:
+    """Context manager wrapping one distributed fit.
+
+    Single-host (the common case in tests and on one v5e board): a no-op
+    wrapper that exposes rank/nranks and the mesh.  Multi-host: initializes
+    `jax.distributed` from config (coordinator_address / process_id /
+    num_processes) the first time, mirroring CumlContext's lazy NCCL init on
+    __enter__ (reference cuml_context.py:121-161).
+    """
+
+    _distributed_initialized = False
+
+    def __init__(
+        self,
+        num_workers: Optional[int] = None,
+        enable_collectives: bool = True,
+        require_p2p: bool = False,
+    ) -> None:
+        self._num_workers = num_workers
+        self._enable_collectives = enable_collectives
+        self._require_p2p = require_p2p  # exact-kNN/DBSCAN analog of require_ucx
+        self._logger = get_logger(type(self))
+        self.mesh = None
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def nranks(self) -> int:
+        return jax.process_count()
+
+    def __enter__(self) -> "TpuContext":
+        coord = get_config("coordinator_address")
+        if coord and not TpuContext._distributed_initialized:
+            # Multi-host bootstrap — the analog of the NCCL-uid allGather
+            # (reference cuml_context.py:96-102).
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=get_config("num_processes"),
+                process_id=get_config("process_id"),
+            )
+            TpuContext._distributed_initialized = True
+            self._logger.info(
+                f"jax.distributed initialized: process {jax.process_index()}"
+                f"/{jax.process_count()}"
+            )
+        self.mesh = get_mesh(self._num_workers)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        # The reference destroys/aborts the NCCL comm per fit
+        # (cuml_context.py:163-180).  JAX's runtime persists across fits by
+        # design (compilations are cached); nothing to tear down per-fit.
+        self.mesh = None

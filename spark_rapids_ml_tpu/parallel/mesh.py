@@ -1,0 +1,110 @@
+#
+# Device mesh + row-sharding helpers — the TPU-native replacement for the
+# reference's partition->GPU placement (`_get_gpu_id` utils.py:138-170,
+# `_CumlCommon._set_gpu_device` core.py:366-411) and the data-parallel rank
+# layout.  One 1-D mesh axis "data" carries the reference's row-sharded
+# data parallelism (SURVEY.md §2.12 strategy 1); a second axis name is
+# reserved for model/feature sharding extensions.
+#
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def ensure_x64(dtype) -> None:
+    """Enable jax x64 on demand when the user requests float64
+    (`float32_inputs=False`, reference core.py:514-537 keeps f64 inputs in
+    f64).  Scoped to the explicit request rather than an import-time global
+    flip so importing this library never changes the numerics of unrelated
+    JAX code in the process."""
+    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+        from ..utils import get_logger
+
+        get_logger("spark_rapids_ml_tpu").info(
+            "Enabling jax_enable_x64 for float64 inputs (float32_inputs=False)."
+        )
+        jax.config.update("jax_enable_x64", True)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_mesh_cache = {}
+
+
+def get_mesh(num_workers: Optional[int] = None) -> Mesh:
+    """A 1-D mesh over the first `num_workers` visible devices.  `num_workers`
+    is the analog of the reference's `num_workers` (= #GPUs = #barrier tasks,
+    reference params.py:556-588); on TPU it is the number of chips
+    participating in the SPMD fit."""
+    devices = jax.devices()
+    n = num_workers or len(devices)
+    if n > len(devices):
+        raise ValueError(
+            f"num_workers={n} exceeds the {len(devices)} visible devices. "
+            f"On multi-host pods initialize jax.distributed first."
+        )
+    key = (n, tuple(d.id for d in devices[:n]))
+    if key not in _mesh_cache:
+        _mesh_cache[key] = Mesh(np.array(devices[:n]), (DATA_AXIS,))
+    return _mesh_cache[key]
+
+
+def data_pspec(ndim: int = 2) -> PartitionSpec:
+    """Rows sharded over the data axis, features replicated."""
+    return PartitionSpec(DATA_AXIS, *([None] * (ndim - 1)))
+
+
+def replicated_pspec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
+    """Zero-pad rows to a multiple of the mesh size so the global array
+    shards evenly.  Padded rows carry zero weight in every kernel; static
+    shapes keep XLA retracing away (jit caches per padded shape)."""
+    n = arr.shape[0]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_shape = (rem,) + arr.shape[1:]
+    padded = np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)], axis=0)
+    return padded, n
+
+
+def shard_rows(
+    arr: np.ndarray,
+    mesh: Mesh,
+    dtype: Optional[np.dtype] = None,
+) -> Tuple[jax.Array, int]:
+    """Stage a host array onto the mesh with rows sharded over DATA_AXIS.
+
+    This is the host->device staging hot loop of the reference
+    (core.py:886-957 pandas->cupy conversion + `_concat_and_free`); here a
+    single `jax.device_put` with a NamedSharding splits rows across chips.
+    Returns (global sharded jax.Array, true row count before padding).
+    """
+    if dtype is not None and arr.dtype != dtype:
+        arr = arr.astype(dtype)
+    ensure_x64(arr.dtype)
+    padded, n_valid = pad_rows(arr, mesh.devices.size)
+    sharding = NamedSharding(mesh, data_pspec(padded.ndim))
+    return jax.device_put(padded, sharding), n_valid
+
+
+def row_mask(n_valid: int, n_padded: int, mesh: Mesh, dtype=np.float32) -> jax.Array:
+    """Validity weights for padded rows (1 real, 0 pad), sharded like data."""
+    w = np.zeros((n_padded,), dtype=dtype)
+    w[:n_valid] = 1.0
+    sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    return jax.device_put(w, sharding)
+
+
+def replicate(arr: Union[np.ndarray, jax.Array], mesh: Mesh) -> jax.Array:
+    """Replicate an array on every device of the mesh (model/centroid
+    arrays — the analog of NCCL-broadcast model state)."""
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.device_put(arr, sharding)

@@ -1,0 +1,61 @@
+#
+# Test harness — the analog of the reference's local-mode multi-GPU trick
+# (tests/conftest.py:34-70: a Spark local[N] session where partition-id ->
+# GPU-id exercises the real multi-rank NCCL path on one node).  Here an
+# 8-device virtual CPU mesh (`xla_force_host_platform_device_count`)
+# exercises the real SPMD sharding + collective path without TPU hardware;
+# the `num_workers` fixture parameterizes 1..4 ranks like `gpu_number`.
+#
+import os
+import sys
+
+# Must run before jax initializes its backend (lazily, on first
+# jax.devices()).  Force CPU even when the ambient env/plugin selects a TPU
+# platform: tests validate the SPMD sharding path on an 8-device virtual
+# mesh, not single-chip numerics.  A sitecustomize may have already
+# *imported* jax, so set both the env and the live config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=[1, 2, 4])
+def num_workers(request):
+    """Mesh sizes exercised per test (reference `gpu_number` fixture)."""
+    return request.param
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False, help="run slow tests"
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: mark test as slow to run")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip_slow = pytest.mark.skip(reason="need --runslow option to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)

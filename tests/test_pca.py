@@ -1,0 +1,117 @@
+#
+# PCA equivalence tests — the analog of the reference's tests/test_pca.py
+# CPU-reference comparisons (SURVEY.md §4: every algorithm compared against
+# pyspark.ml / sklearn with array_equal tolerances).
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.decomposition import PCA as SkPCA
+
+from spark_rapids_ml_tpu.feature import PCA, PCAModel
+from spark_rapids_ml_tpu.utils import array_equal_tol
+
+
+def _make_data(rng, n=500, d=8):
+    A = rng.normal(size=(d, d))
+    X = rng.normal(size=(n, d)) @ A + rng.normal(size=(d,)) * 3.0
+    return X.astype(np.float64)
+
+
+def test_pca_matches_sklearn(num_workers, rng):
+    X = _make_data(rng)
+    k = 3
+    model = PCA(k=k, num_workers=num_workers).setInputCol("features").fit(X)
+    sk = SkPCA(n_components=k, svd_solver="full").fit(X)
+
+    assert model.components_.shape == (k, X.shape[1])
+    assert array_equal_tol(model.mean_, sk.mean_, 1e-3)
+    assert array_equal_tol(model.explained_variance_, sk.explained_variance_, 1e-2)
+    assert array_equal_tol(
+        model.explained_variance_ratio_, sk.explained_variance_ratio_, 1e-4
+    )
+    # components equal up to per-component sign
+    for i in range(k):
+        dot = abs(float(np.dot(model.components_[i], sk.components_[i])))
+        assert dot == pytest.approx(1.0, abs=1e-3)
+
+
+def test_pca_spark_transform_semantics(num_workers, rng):
+    """Spark PCA projects WITHOUT mean removal (reference feature.py:447-459)."""
+    X = _make_data(rng, n=200, d=5)
+    df = pd.DataFrame({"features": list(X)})
+    model = (
+        PCA(k=2, num_workers=num_workers)
+        .setInputCol("features")
+        .setOutputCol("pca_features")
+        .fit(df)
+    )
+    out = model.transform(df)
+    got = np.stack(out["pca_features"].to_numpy())
+    expected = X.astype(np.float32) @ model.components_.T.astype(np.float32)
+    assert array_equal_tol(got, expected, 1e-3)
+
+
+def test_pca_doctest_example(num_workers):
+    """Reference doctest (feature.py:155-197): 3-point diagonal."""
+    df = pd.DataFrame({"features": [[-1.0, -1.0], [0.0, 0.0], [1.0, 1.0]]})
+    model = (
+        PCA(k=1, num_workers=num_workers)
+        .setInputCol("features")
+        .setOutputCol("pca_features")
+        .fit(df)
+    )
+    out = model.transform(df)
+    vals = np.array([v[0] for v in out["pca_features"]])
+    expected = np.array([-1.41421356, 0.0, 1.41421356])
+    sign = np.sign(vals[2]) or 1.0
+    assert np.allclose(vals * sign, expected, atol=1e-5)
+
+
+def test_pca_multi_col_input(num_workers, rng):
+    X = _make_data(rng, n=100, d=4)
+    cols = [f"c{i}" for i in range(4)]
+    df = pd.DataFrame(X, columns=cols)
+    model = PCA(k=2, num_workers=num_workers).setInputCol(cols).fit(df)
+    sk = SkPCA(n_components=2, svd_solver="full").fit(X)
+    assert array_equal_tol(model.explained_variance_, sk.explained_variance_, 1e-2)
+
+
+def test_pca_save_load(tmp_path, rng):
+    X = _make_data(rng, n=100, d=4)
+    model = PCA(k=2).setInputCol("features").setOutputCol("out").fit(X)
+    path = str(tmp_path / "pca_model")
+    model.write().save(path)
+    loaded = PCAModel.load(path)
+    assert array_equal_tol(loaded.components_, model.components_, 1e-7)
+    assert array_equal_tol(loaded.mean_, model.mean_, 1e-7)
+    assert loaded.getOrDefault("outputCol") == "out"
+    assert loaded.n_cols == 4
+
+    est_path = str(tmp_path / "pca_est")
+    est = PCA(k=3).setInputCol("features")
+    est.write().save(est_path)
+    est2 = PCA.load(est_path)
+    assert est2.getOrDefault("k") == 3
+    assert est2._tpu_params["n_components"] == 3
+
+
+def test_pca_float64(rng):
+    X = _make_data(rng, n=100, d=4)
+    model = PCA(k=2, float32_inputs=False).setInputCol("features").fit(X)
+    sk = SkPCA(n_components=2, svd_solver="full").fit(X)
+    assert array_equal_tol(model.explained_variance_, sk.explained_variance_, 1e-8)
+
+
+def test_pca_cpu_fallback(rng):
+    X = _make_data(rng, n=50, d=4)
+    from spark_rapids_ml_tpu import config
+
+    config.set_config(cpu_fallback_enabled=True)
+    try:
+        est = PCA(k=2).setInputCol("features")
+        est._set_params(svd_solver="randomized")  # backend kwarg passthrough
+        model = est.fit(X)
+        assert model.components_.shape == (2, 4)
+    finally:
+        config.reset_config()
