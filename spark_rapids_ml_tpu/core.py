@@ -308,6 +308,11 @@ class _TpuEstimator(Estimator, _TpuCaller):
     def _is_supervised(self) -> bool:
         return False
 
+    def _validate_input(self, batch: _ArrayBatch) -> None:
+        """Validate the raw host batch before dtype casting/staging (the
+        analog of `_validate_parameters` + label checks, reference
+        core.py:585-608)."""
+
     def _enable_fit_multiple_in_single_pass(self) -> bool:
         # Reference core.py:1172-1175.
         return True
@@ -352,11 +357,14 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 "Unsupported params set; falling back to CPU (sklearn) fit "
                 "(analog of spark.rapids.ml.cpu.fallback, reference core.py:1283-1297)."
             )
-            model = self._cpu_fit(self._extract(dataset))
+            batch = self._extract(dataset)
+            self._validate_input(batch)
+            model = self._cpu_fit(batch)
             self._copyValues(model)
             return model
         t0 = time.time()
         batch = self._extract(dataset)
+        self._validate_input(batch)
         fit_input = self._stage_fit_input(batch)
         attrs = self._fit_array(fit_input)
         model = self._create_model(attrs)
@@ -378,6 +386,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
 
         if estimator._enable_fit_multiple_in_single_pass():
             batch = estimator._extract(dataset)
+            estimator._validate_input(batch)
             fit_input = estimator._stage_fit_input(batch)
 
             def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
@@ -465,6 +474,12 @@ class _TpuModel(Model, _TpuCaller):
         `_CumlModelWithColumns._transform` core.py:1797-1941)."""
         import pandas as pd
 
+        if isinstance(dataset, pd.DataFrame) and len(dataset) == 0:
+            # empty input transforms to empty output (Spark semantics)
+            out_df = dataset.copy()
+            for col in self._output_columns():
+                out_df[col] = []
+            return out_df
         features_col, features_cols = _resolve_feature_params(self)
         batch = extract_arrays(
             dataset,

@@ -70,6 +70,9 @@ def _features_from_pandas(
     reference's HasFeaturesCols fast path that skips VectorAssembler
     (params.py:69-88, pipeline.py:85-119).
     """
+    if len(pdf) == 0:
+        # reference raises on empty partitions (core.py:959-962)
+        raise ValueError("Dataset is empty: nothing to fit/transform")
     if features_cols:
         missing = [c for c in features_cols if c not in pdf.columns]
         if missing:

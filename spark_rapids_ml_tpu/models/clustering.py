@@ -1,2 +1,257 @@
-# Implemented progressively; see models/feature.py for the pattern.
-__all__: list = []
+#
+# Clustering: KMeans (+ DBSCAN below) — the analog of reference
+# clustering.py (1182 LoC).  The cuML KMeansMG distributed fit
+# (clustering.py:377-411) is replaced by ops/kmeans.py: Gumbel-max
+# k-means++ seeding + a single compiled Lloyd while_loop with psum'd
+# centroid updates.  The reference's >1GB model-chunking machinery
+# (clustering.py:433-498) has no analog: there is no Spark row-size limit
+# in this runtime, model arrays go straight to the host.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core import FitInput, _TpuEstimator, _TpuModel
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasMaxIter,
+    HasWeightCol,
+    Param,
+    TypeConverters,
+    _TpuParams,
+)
+from ..utils import _ArrayBatch, get_logger
+
+
+class KMeansClass:
+    """Param mapping (reference KMeansClass clustering.py:84-137)."""
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {
+            "distanceMeasure": None,  # only euclidean on TPU (as in cuML)
+            "initMode": "init",
+            "k": "n_clusters",
+            "initSteps": "",
+            "maxIter": "max_iter",
+            "seed": "random_state",
+            "tol": "tol",
+            # improvement over the reference (maps weightCol -> None): the
+            # TPU kernel supports sample weights natively
+            "weightCol": "",
+            "solver": "",
+            "maxBlockSizeInMB": "",
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        def tol_mapper(x: float) -> float:
+            if x == 0.0:
+                get_logger(cls).warning(
+                    "tol=0 mapped to the smallest positive float32 "
+                    "(reference clustering.py:108-120)."
+                )
+                return float(np.finfo("float32").tiny)
+            return x
+
+        def init_mapper(x: str):
+            return {
+                "k-means||": "k-means++",
+                "scalable-k-means++": "k-means++",
+                "k-means++": "k-means++",
+                "random": "random",
+            }.get(x)
+
+        return {"tol": tol_mapper, "initMode": init_mapper}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_clusters": 8,
+            "max_iter": 300,
+            "tol": 0.0001,
+            "verbose": False,
+            "random_state": None,
+            "init": "k-means++",
+            "n_init": "auto",
+            "oversampling_factor": 2.0,
+            "max_samples_per_batch": 32768,
+        }
+
+
+class _KMeansTpuParams(
+    _TpuParams,
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasPredictionCol,
+    HasSeed,
+    HasTol,
+    HasMaxIter,
+    HasWeightCol,
+):
+    """Shared params for KMeans / KMeansModel (reference _KMeansCumlParams
+    clustering.py:140-183)."""
+
+    k = Param("_", "k", "The number of clusters to create.", TypeConverters.toInt)
+    initMode = Param(
+        "_", "initMode", 'The initialization algorithm: "k-means||" or "random".',
+        TypeConverters.toString,
+    )
+    initSteps = Param("_", "initSteps", "The number of steps for k-means|| init.",
+                      TypeConverters.toInt)
+    distanceMeasure = Param("_", "distanceMeasure", "The distance measure.",
+                            TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            k=2, initMode="k-means||", initSteps=2, maxIter=20, tol=1e-4
+        )
+
+    def setFeaturesCol(self, value):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+    def setFeaturesCols(self, value: List[str]):
+        return self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str):
+        self._set(predictionCol=value)
+        return self
+
+    def setK(self, value: int):
+        return self._set_params(k=value)
+
+    def getK(self) -> int:
+        return self.getOrDefault("k")
+
+    def setInitMode(self, value: str):
+        return self._set_params(initMode=value)
+
+    def setMaxIter(self, value: int):
+        return self._set_params(maxIter=value)
+
+    def setTol(self, value: float):
+        return self._set_params(tol=value)
+
+    def setWeightCol(self, value: str):
+        return self._set_params(weightCol=value)
+
+
+class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
+    """Distributed KMeans on TPU (API parity: reference KMeans
+    clustering.py:185-498).
+
+    Seeding runs on-device (Gumbel-max k-means++, the quality analog of
+    cuML's scalable-k-means++); Lloyd iterations are one compiled
+    while_loop whose centroid partial sums psum over the mesh.
+
+    Examples
+    --------
+    >>> import pandas as pd
+    >>> from spark_rapids_ml_tpu.clustering import KMeans
+    >>> df = pd.DataFrame({"features": [[0.0, 0.0], [1.0, 1.0], [9.0, 8.0], [8.0, 9.0]]})
+    >>> model = KMeans(k=2, seed=1).setFeaturesCol("features").fit(df)
+    >>> sorted(model.transform(df)["prediction"].tolist())
+    [0, 0, 1, 1]
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._set_params(**kwargs)
+
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
+        from ..ops.kmeans import kmeans_fit
+
+        p = fit_input.params
+        k = int(p["n_clusters"])
+        seed = p.get("random_state")
+        seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
+        centers, cost, n_iter = kmeans_fit(
+            fit_input.X,
+            fit_input.w,
+            k=k,
+            seed=seed,
+            max_iter=int(p["max_iter"]),
+            tol=float(p["tol"]),
+            init=str(p["init"]),
+        )
+        return {
+            "cluster_centers_": np.asarray(centers),
+            "inertia_": float(cost),
+            "n_iter_": int(n_iter),
+            "n_cols": fit_input.pdesc.n,
+            "dtype": str(np.dtype(fit_input.dtype).name),
+        }
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "KMeansModel":
+        return KMeansModel(**attrs)
+
+    def _cpu_fit(self, batch: _ArrayBatch) -> "KMeansModel":
+        from sklearn.cluster import KMeans as SkKMeans
+
+        sk = SkKMeans(
+            n_clusters=self.getOrDefault("k"),
+            max_iter=self.getOrDefault("maxIter"),
+            tol=self.getOrDefault("tol"),
+            random_state=self.getOrDefault("seed") & 0x7FFFFFFF,
+            n_init=1,
+        ).fit(batch.X, sample_weight=batch.weight)
+        return KMeansModel(
+            cluster_centers_=sk.cluster_centers_.astype(batch.X.dtype),
+            inertia_=float(sk.inertia_),
+            n_iter_=int(sk.n_iter_),
+            n_cols=int(batch.X.shape[1]),
+            dtype=str(batch.X.dtype),
+        )
+
+
+class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
+    """KMeans model (reference KMeansModel clustering.py:501-600)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        super().__init__(**attrs)
+        self.cluster_centers_: np.ndarray = np.asarray(attrs["cluster_centers_"])
+        self.inertia_: float = float(attrs.get("inertia_", 0.0))
+        self.n_iter_: int = int(attrs.get("n_iter_", 0))
+        self.n_cols: int = int(attrs["n_cols"])
+        self.dtype: str = str(attrs.get("dtype", "float32"))
+        self._set_params(k=int(self.cluster_centers_.shape[0]))
+
+    def clusterCenters(self) -> List[np.ndarray]:
+        """pyspark.ml parity: list of center vectors."""
+        return list(self.cluster_centers_)
+
+    @property
+    def hasSummary(self) -> bool:
+        return False
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+
+        from ..ops.kmeans import kmeans_predict
+
+        preds = np.asarray(
+            kmeans_predict(jnp.asarray(X), jnp.asarray(self.cluster_centers_.astype(X.dtype)))
+        )
+        return {self.getOrDefault("predictionCol"): preds}
+
+    def cpu(self):
+        from sklearn.cluster import KMeans as SkKMeans
+
+        sk = SkKMeans(n_clusters=self.cluster_centers_.shape[0], n_init=1)
+        sk.cluster_centers_ = self.cluster_centers_.astype(np.float64)
+        sk.inertia_ = self.inertia_
+        sk.n_iter_ = self.n_iter_
+        sk._n_threads = 1
+        sk.n_features_in_ = self.n_cols
+        return sk

@@ -1,0 +1,177 @@
+#
+# Distributed L-BFGS / OWL-QN — the TPU-native replacement for the solver
+# inside `cuml.linear_model.logistic_regression_mg.LogisticRegressionMG`
+# (invoked from reference classification.py:1046-1081; cuML runs L-BFGS for
+# none/L2 and OWL-QN for L1/elastic-net, with `lbfgs_memory=10`,
+# `linesearch_max_iter=20`, classification.py:1046-1052).
+#
+# TPU-first design: the WHOLE optimizer — two-loop recursion, backtracking
+# line search, orthant projection, convergence tests — is one
+# `lax.while_loop` under jit.  The loss closure evaluates over the
+# row-sharded global data, so XLA inserts one gradient psum over ICI per
+# function evaluation; optimizer state (m history pairs of flattened
+# parameter size) is replicated.  Zero host round-trips for the entire fit.
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LbfgsResult(NamedTuple):
+    w: jax.Array
+    f: jax.Array
+    n_iter: jax.Array
+    converged: jax.Array
+
+
+def _pseudo_gradient(w: jax.Array, g: jax.Array, l1: jax.Array, l1_mask: jax.Array):
+    """OWL-QN pseudo-gradient of f(w) + l1·‖w∘mask‖₁ (mask excludes
+    intercept entries from the penalty, matching Spark)."""
+    l1v = l1 * l1_mask
+    gp_plus = g + l1v
+    gp_minus = g - l1v
+    pg = jnp.where(
+        w > 0,
+        gp_plus,
+        jnp.where(
+            w < 0,
+            gp_minus,
+            jnp.where(gp_minus > 0, gp_minus, jnp.where(gp_plus < 0, gp_plus, 0.0)),
+        ),
+    )
+    return pg
+
+
+def lbfgs_minimize(
+    loss_fn: Callable[[jax.Array], jax.Array],
+    w0: jax.Array,
+    max_iter: int = 100,
+    tol: float = 1e-6,
+    history: int = 10,
+    l1: float = 0.0,
+    l1_mask: jax.Array = None,
+    ls_max: int = 20,
+) -> LbfgsResult:
+    """Minimize loss_fn(w) + l1·‖w∘l1_mask‖₁ with L-BFGS (OWL-QN when l1>0).
+
+    loss_fn must be smooth and differentiable (the L2 term belongs inside
+    it); w0 is the flattened replicated parameter vector.  Runs as a single
+    jitted while_loop.
+    """
+    n = w0.shape[0]
+    m = history
+    dtype = w0.dtype
+    l1 = jnp.asarray(l1, dtype)
+    if l1_mask is None:
+        l1_mask = jnp.ones((n,), dtype)
+
+    value_and_grad = jax.value_and_grad(loss_fn)
+
+    def full_obj(w):
+        return loss_fn(w) + (l1 * l1_mask * jnp.abs(w)).sum()
+
+    def direction(pg, S, Y, rho, k):
+        def bwd(j, carry):
+            q, alpha = carry
+            idx = (k - 1 - j) % m
+            valid = j < jnp.minimum(k, m)
+            a = jnp.where(valid, rho[idx] * (S[idx] @ q), 0.0)
+            q = q - a * Y[idx]
+            alpha = alpha.at[idx].set(a)
+            return q, alpha
+
+        q, alpha = jax.lax.fori_loop(0, m, bwd, (pg, jnp.zeros((m,), dtype)))
+        newest = (k - 1) % m
+        sy = S[newest] @ Y[newest]
+        yy = Y[newest] @ Y[newest]
+        gamma = jnp.where(k > 0, sy / jnp.maximum(yy, 1e-30), 1.0)
+        r = gamma * q
+
+        def fwd(j, r):
+            idx = (k - m + j) % m
+            valid = j >= (m - jnp.minimum(k, m))
+            b = rho[idx] * (Y[idx] @ r)
+            r = r + jnp.where(valid, alpha[idx] - b, 0.0) * S[idx]
+            return r
+
+        r = jax.lax.fori_loop(0, m, fwd, r)
+        return -r
+
+    def body(state):
+        w, f, g, S, Y, rho, k, it, _ = state
+        pg = _pseudo_gradient(w, g, l1, l1_mask)
+        p = direction(pg, S, Y, rho, k)
+        # OWL-QN: force descent orthant agreement with -pseudo-gradient
+        p = jnp.where(l1 > 0, jnp.where(p * (-pg) > 0, p, 0.0), p)
+        # orthant for projection: sign(w), or sign(-pg) where w == 0
+        xi = jnp.where(w != 0, jnp.sign(w), jnp.sign(-pg))
+
+        # backtracking Armijo line search (ls_max halvings, cuML's
+        # linesearch_max_iter analog).  Displacement form
+        # φ(π(w+tp)) ≤ φ(w) + c₁·pg·(π(w+tp)−w) — required for OWL-QN
+        # where the orthant projection changes the actual step.
+        t0 = jnp.where(k == 0, 1.0 / jnp.maximum(jnp.linalg.norm(p), 1.0), 1.0)
+        fw_full = full_obj(w)
+
+        def project(w_t):
+            return jnp.where(l1 > 0, jnp.where(w_t * xi >= 0, w_t, 0.0), w_t)
+
+        def ls_cond(ls_state):
+            t, w_t, f_t, j = ls_state
+            armijo = f_t <= fw_full + 1e-4 * (pg @ (w_t - w))
+            return (~armijo) & (j < ls_max)
+
+        def ls_body(ls_state):
+            t, _, _, j = ls_state
+            t = t * 0.5
+            w_t = project(w + t * p)
+            return t, w_t, full_obj(w_t), j + 1
+
+        w_1 = project(w + t0 * p)
+        t, w_new, f_new_full, _ = jax.lax.while_loop(
+            ls_cond, ls_body, (t0, w_1, full_obj(w_1), jnp.array(0, jnp.int32))
+        )
+
+        f_new, g_new = value_and_grad(w_new)
+        s = w_new - w
+        y = g_new - g
+        sy = s @ y
+        update_ok = sy > 1e-10
+        idx = k % m
+        S = jnp.where(update_ok, S.at[idx].set(s), S)
+        Y = jnp.where(update_ok, Y.at[idx].set(y), Y)
+        rho = jnp.where(update_ok, rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-30)), rho)
+        k = jnp.where(update_ok, k + 1, k)
+
+        new_full = f_new + (l1 * l1_mask * jnp.abs(w_new)).sum()
+        old_full = f + (l1 * l1_mask * jnp.abs(w)).sum()
+        rel_impr = (old_full - new_full) / jnp.maximum(jnp.abs(old_full), 1e-30)
+        pg_new = _pseudo_gradient(w_new, g_new, l1, l1_mask)
+        gnorm = jnp.linalg.norm(pg_new)
+        converged = (gnorm <= tol * jnp.maximum(1.0, jnp.linalg.norm(w_new))) | (
+            jnp.abs(rel_impr) <= tol
+        )
+        return w_new, f_new, g_new, S, Y, rho, k, it + 1, converged
+
+    def cond(state):
+        _, _, _, _, _, _, _, it, converged = state
+        return (it < max_iter) & (~converged)
+
+    f0, g0 = value_and_grad(w0)
+    state0 = (
+        w0,
+        f0,
+        g0,
+        jnp.zeros((m, n), dtype),
+        jnp.zeros((m, n), dtype),
+        jnp.zeros((m,), dtype),
+        jnp.array(0, jnp.int32),
+        jnp.array(0, jnp.int32),
+        jnp.array(False),
+    )
+    w, f, g, S, Y, rho, k, it, converged = jax.lax.while_loop(cond, body, state0)
+    return LbfgsResult(w=w, f=f, n_iter=it, converged=converged)

@@ -544,6 +544,25 @@ class _TpuParams(_TpuClass, Params):
 
     def _init_tpu_params(self) -> None:
         self._tpu_params = dict(self._get_tpu_params_default())
+        self._spark_defaults_synced = False
+
+    def _sync_spark_defaults_to_tpu(self) -> None:
+        """Overlay the Spark-side param *defaults* onto the backend dict so
+        precedence is: backend defaults < Spark defaults < explicit sets.
+        (The reference hardcodes cuML defaults that can disagree with Spark
+        defaults, e.g. l1_ratio=0.15 vs elasticNetParam=0.0; Spark semantics
+        must win for un-set params.)"""
+        value_map = self._param_value_mapping()
+        for sname, mapped in self._param_mapping().items():
+            if not mapped:
+                continue
+            if self.hasParam(sname) and self.hasDefault(sname) and not self.isSet(sname):
+                v = self._defaultParamMap[self.getParam(sname)]
+                if sname in value_map:
+                    v = value_map[sname](v)
+                    if v is None:
+                        continue
+                self._tpu_params[mapped] = v
 
     @property
     def tpu_params(self) -> Dict[str, Any]:
@@ -587,6 +606,9 @@ class _TpuParams(_TpuClass, Params):
         """Set params on both the Spark-API side and the backend `_tpu_params`
         side, keeping the two in sync (reference `_set_params`,
         params.py:430-487)."""
+        if not getattr(self, "_spark_defaults_synced", True):
+            self._sync_spark_defaults_to_tpu()
+            self._spark_defaults_synced = True
         mapping = self._param_mapping()
         value_map = self._param_value_mapping()
         for k, v in kwargs.items():
@@ -620,6 +642,12 @@ class _TpuParams(_TpuClass, Params):
                         val = v
                         if k in value_map:
                             val = value_map[k](v)
+                            if val is None:
+                                # unsupported *value* for a supported param
+                                # (reference params.py:201-221)
+                                raise ValueError(
+                                    f"Value '{v}' for param '{k}' is not supported on TPU."
+                                )
                         self._tpu_params[mapped] = val
             elif k in self._tpu_params or k in self._get_tpu_params_default():
                 # backend-only kwarg passed straight through (reference

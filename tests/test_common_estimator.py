@@ -208,3 +208,15 @@ def test_fit_params_unsupported_raises():
     est = DummyEstimator()
     with pytest.raises(ValueError, match="not supported on TPU"):
         est.fit(np.ones((4, 2), dtype=np.float32), {est.gamma: "x"})
+
+
+def test_transform_empty_dataframe():
+    X = np.arange(12, dtype=np.float32).reshape(4, 3)
+    est = DummyEstimator()
+    est._set(featuresCol="features")
+    model = est.fit(X)
+    model._set(featuresCol="features")
+    empty = pd.DataFrame({"features": pd.Series([], dtype=object)})
+    out = model.transform(empty)
+    assert len(out) == 0
+    assert "prediction" in out.columns

@@ -1,0 +1,138 @@
+#
+# LinearRegression equivalence tests vs sklearn (SURVEY.md §4; analog of
+# reference tests/test_linear_regression.py).  Objective parity notes:
+# Spark obj = 1/(2n)Σ(residual²) + regParam(α‖β‖₁ + (1-α)/2‖β‖²), so
+# sklearn Ridge(alpha = n·regParam) and ElasticNet(alpha=regParam,
+# l1_ratio=elasticNetParam) are the matching CPU references.
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.linear_model import ElasticNet, LinearRegression as SkLR, Ridge
+
+from spark_rapids_ml_tpu.regression import LinearRegression, LinearRegressionModel
+from spark_rapids_ml_tpu.utils import array_equal_tol
+
+
+def _make_data(seed=0, n=400, d=6, noise=0.1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)) * rng.uniform(0.5, 3.0, d) + rng.normal(size=d)
+    true_coef = rng.normal(size=d)
+    y = X @ true_coef + 1.7 + noise * rng.normal(size=n)
+    return X, y
+
+
+def test_ols_matches_sklearn(num_workers):
+    X, y = _make_data()
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = (
+        LinearRegression(regParam=0.0, num_workers=num_workers, float32_inputs=False)
+        .setFeaturesCol("features")
+        .fit(df)
+    )
+    sk = SkLR().fit(X, y)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-6)
+    assert model.intercept == pytest.approx(sk.intercept_, abs=1e-6)
+
+
+def test_ols_no_intercept(num_workers):
+    X, y = _make_data()
+    model = LinearRegression(
+        regParam=0.0, fitIntercept=False, num_workers=num_workers, float32_inputs=False
+    ).fit((X, y))
+    sk = SkLR(fit_intercept=False).fit(X, y)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-6)
+    assert model.intercept == 0.0
+
+
+def test_ridge_matches_sklearn(num_workers):
+    X, y = _make_data()
+    reg = 0.5
+    model = LinearRegression(
+        regParam=reg, elasticNetParam=0.0, standardization=False,
+        num_workers=num_workers, float32_inputs=False,
+    ).fit((X, y))
+    sk = Ridge(alpha=reg * X.shape[0]).fit(X, y)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-5)
+    assert model.intercept == pytest.approx(sk.intercept_, abs=1e-5)
+
+
+def test_elasticnet_matches_sklearn(num_workers):
+    X, y = _make_data(n=500)
+    reg, l1r = 0.1, 0.5
+    model = LinearRegression(
+        regParam=reg, elasticNetParam=l1r, standardization=False,
+        maxIter=2000, tol=1e-10, num_workers=num_workers, float32_inputs=False,
+    ).fit((X, y))
+    sk = ElasticNet(alpha=reg, l1_ratio=l1r, max_iter=10000, tol=1e-10).fit(X, y)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-4)
+    assert model.intercept == pytest.approx(sk.intercept_, abs=1e-4)
+
+
+def test_lasso_sparsity(num_workers):
+    X, y = _make_data(n=500)
+    model = LinearRegression(
+        regParam=1.0, elasticNetParam=1.0, standardization=False,
+        maxIter=3000, tol=1e-10, num_workers=num_workers, float32_inputs=False,
+    ).fit((X, y))
+    sk = ElasticNet(alpha=1.0, l1_ratio=1.0, max_iter=10000, tol=1e-10).fit(X, y)
+    np.testing.assert_array_equal(model.coefficients == 0.0, sk.coef_ == 0.0)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-4)
+
+
+def test_standardization_ols_invariant(num_workers):
+    # standardization shouldn't change the OLS optimum
+    X, y = _make_data()
+    m1 = LinearRegression(regParam=0.0, standardization=True,
+                          num_workers=num_workers, float32_inputs=False).fit((X, y))
+    m2 = LinearRegression(regParam=0.0, standardization=False,
+                          num_workers=num_workers, float32_inputs=False).fit((X, y))
+    assert array_equal_tol(m1.coefficients, m2.coefficients, 1e-6)
+
+
+def test_ridge_standardization_penalizes_scaled_space():
+    # With standardization=True the penalty applies to standardized coefs:
+    # equivalent to sklearn Ridge on scaled features with unscaled-back coefs.
+    X, y = _make_data()
+    reg = 0.7
+    model = LinearRegression(
+        regParam=reg, standardization=True, float32_inputs=False
+    ).fit((X, y))
+    std = X.std(axis=0, ddof=1)
+    Xs = (X - X.mean(axis=0)) / std
+    sk = Ridge(alpha=reg * X.shape[0]).fit(Xs, y)
+    assert array_equal_tol(model.coefficients, sk.coef_ / std, 1e-5)
+
+
+def test_weighted_ols(num_workers):
+    X, y = _make_data(n=300)
+    rng = np.random.default_rng(1)
+    w = rng.uniform(0.1, 3.0, X.shape[0])
+    df = pd.DataFrame({"features": list(X), "label": y, "wt": w})
+    model = (
+        LinearRegression(regParam=0.0, num_workers=num_workers, float32_inputs=False)
+        .setFeaturesCol("features")
+        .setWeightCol("wt")
+        .fit(df)
+    )
+    sk = SkLR().fit(X, y, sample_weight=w)
+    assert array_equal_tol(model.coefficients, sk.coef_, 1e-6)
+
+
+def test_transform_and_save_load(tmp_path, num_workers):
+    X, y = _make_data(n=100)
+    model = LinearRegression(num_workers=num_workers).fit((X, y))
+    preds = model.transform(X)
+    assert preds.shape == (100,)
+    path = str(tmp_path / "lr")
+    model.write().save(path)
+    loaded = LinearRegressionModel.load(path)
+    np.testing.assert_allclose(loaded.coef_, model.coef_)
+    assert loaded.intercept == pytest.approx(model.intercept)
+
+
+def test_unsupported_values():
+    with pytest.raises(ValueError, match="not supported"):
+        LinearRegression(loss="huber")
+    with pytest.raises(ValueError, match="not supported"):
+        LinearRegression(solver="l-bfgs")
