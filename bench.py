@@ -1,0 +1,90 @@
+#
+# Driver benchmark — prints ONE JSON line:
+#   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+#
+# Workload: the flagship algorithm (distributed LogisticRegression, the
+# north-star of BASELINE.md) fit on synthetic dense binary data, the TPU
+# analog of the reference's bench_logistic_regression.py
+# (python/benchmark/benchmark_runner.py registry).  The reference publishes
+# no numeric tables (BASELINE.md), so `vs_baseline` is the measured speedup
+# over the strongest same-host CPU baseline (sklearn lbfgs on a subsample,
+# extrapolated linearly in rows) — the same GPU-vs-CPU comparison the
+# reference's published chart makes.
+#
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+N_COLS = int(os.environ.get("BENCH_COLS", 256))
+MAX_ITER = int(os.environ.get("BENCH_MAX_ITER", 50))
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 100_000))
+
+
+def _gen(n_rows: int, n_cols: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((n_rows, n_cols), dtype=np.float32)
+    true_w = rng.standard_normal((n_cols,)).astype(np.float32)
+    logits = X @ true_w + 0.25 * rng.standard_normal(n_rows).astype(np.float32)
+    y = (logits > 0).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    import numpy as np
+
+    from spark_rapids_ml_tpu import DeviceDataset
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    X, y = _gen(N_ROWS, N_COLS)
+
+    # Stage the dataset onto the device mesh once, like the reference's
+    # benchmarks fit on a cached Spark DataFrame (data already resident on
+    # the executors when fit is timed).
+    ds = DeviceDataset.from_host(X, y=y, label_dtype=np.int32)
+
+    def fit() -> float:
+        est = LogisticRegression(
+            maxIter=MAX_ITER, regParam=1e-4, elasticNetParam=0.0, tol=1e-8
+        )
+        t0 = time.perf_counter()
+        est.fit(ds)
+        return time.perf_counter() - t0
+
+    fit()  # warm up (jit compile at the benchmark shape)
+    elapsed = min(fit() for _ in range(3))
+    rows_per_sec = N_ROWS / elapsed
+
+    # CPU baseline: sklearn lbfgs on a subsample, extrapolated in rows
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    n_cpu = min(CPU_SAMPLE, N_ROWS)
+    t0 = time.perf_counter()
+    SkLR(C=1.0 / (1e-4 * n_cpu), l1_ratio=0.0, max_iter=MAX_ITER, tol=1e-8).fit(
+        X[:n_cpu], y[:n_cpu].astype(np.int32)
+    )
+    cpu_elapsed = time.perf_counter() - t0
+    cpu_rows_per_sec = n_cpu / cpu_elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": f"logreg_fit_rows_per_sec ({N_ROWS}x{N_COLS}, "
+                f"maxIter={MAX_ITER}, fit {elapsed:.2f}s)",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/sec/chip",
+                "vs_baseline": round(rows_per_sec / cpu_rows_per_sec, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 
 import numpy as np
 
-from .data import DatasetLike, _ensure_dense, extract_arrays
+from .data import DatasetLike, DeviceDataset, _ensure_dense, extract_arrays
 from .params import Param, Params, _TpuParams
 from .parallel import TpuContext, get_mesh, replicate, shard_rows
 from .parallel.mesh import row_mask
@@ -232,6 +232,10 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
         the kernel needs p2p-style all-to-all (exact kNN, DBSCAN)."""
         return False
 
+    def _validate_device_input(self, ds: DeviceDataset) -> None:
+        """Device-side analog of `_validate_input` for device-resident
+        datasets (runs BEFORE any label dtype cast)."""
+
     def _fit_label_dtype(self) -> Optional[np.dtype]:
         return np.dtype(np.float32)
 
@@ -277,6 +281,33 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
             pdesc=pdesc,
             dtype=dtype,
             n_valid=n_valid,
+            params=dict(self._tpu_params),
+        )
+
+    def _stage_from_device(self, ds: DeviceDataset) -> FitInput:
+        """Zero-copy staging from an already-device-resident DeviceDataset
+        (the cached-DataFrame fast path): only label dtype casts run, on
+        device."""
+        supervised = getattr(self, "_is_supervised", lambda: False)()
+        if supervised and ds.y is None:
+            raise ValueError("Supervised fit requires a DeviceDataset with labels")
+        self._validate_device_input(ds)
+        dtype = np.dtype(ds.X.dtype)
+        y = ds.y
+        ldt = self._fit_label_dtype() if supervised else None
+        if y is not None and ldt is not None and np.dtype(y.dtype) != ldt:
+            y = y.astype(ldt)
+        n_dev = ds.mesh.devices.size
+        per_shard = [ds.X.shape[0] // n_dev] * n_dev
+        pdesc = PartitionDescriptor.build(per_shard, int(ds.X.shape[1]))
+        return FitInput(
+            mesh=ds.mesh,
+            X=ds.X,
+            w=ds.weight,
+            y=y,
+            pdesc=pdesc,
+            dtype=dtype,
+            n_valid=ds.n_valid,
             params=dict(self._tpu_params),
         )
 
@@ -347,7 +378,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
             features_cols=features_cols,
             label_col=label_col,
             weight_col=weight_col,
-            dtype=np.float64,  # preserve input precision; _out_dtype decides
+            dtype=None,  # preserve input precision; _out_dtype decides
             supervised=self._is_supervised(),
         )
 
@@ -357,15 +388,21 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 "Unsupported params set; falling back to CPU (sklearn) fit "
                 "(analog of spark.rapids.ml.cpu.fallback, reference core.py:1283-1297)."
             )
-            batch = self._extract(dataset)
+            if isinstance(dataset, DeviceDataset):
+                batch = dataset.to_host_batch()
+            else:
+                batch = self._extract(dataset)
             self._validate_input(batch)
             model = self._cpu_fit(batch)
             self._copyValues(model)
             return model
         t0 = time.time()
-        batch = self._extract(dataset)
-        self._validate_input(batch)
-        fit_input = self._stage_fit_input(batch)
+        if isinstance(dataset, DeviceDataset):
+            fit_input = self._stage_from_device(dataset)
+        else:
+            batch = self._extract(dataset)
+            self._validate_input(batch)
+            fit_input = self._stage_fit_input(batch)
         attrs = self._fit_array(fit_input)
         model = self._create_model(attrs)
         self._copyValues(model)
@@ -385,9 +422,12 @@ class _TpuEstimator(Estimator, _TpuCaller):
         estimator = self.copy()
 
         if estimator._enable_fit_multiple_in_single_pass():
-            batch = estimator._extract(dataset)
-            estimator._validate_input(batch)
-            fit_input = estimator._stage_fit_input(batch)
+            if isinstance(dataset, DeviceDataset):
+                fit_input = estimator._stage_from_device(dataset)
+            else:
+                batch = estimator._extract(dataset)
+                estimator._validate_input(batch)
+                fit_input = estimator._stage_fit_input(batch)
 
             def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
                 est_i = estimator.copy(paramMaps[index])
@@ -485,7 +525,7 @@ class _TpuModel(Model, _TpuCaller):
             dataset,
             features_col=features_col,
             features_cols=features_cols,
-            dtype=np.float64,
+            dtype=None,
             supervised=False,
         )
         X = _ensure_dense(batch.X)

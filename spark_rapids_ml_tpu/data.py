@@ -61,7 +61,7 @@ def _features_from_pandas(
     pdf,
     features_col: Optional[str],
     features_cols: Sequence[str],
-    dtype: np.dtype,
+    dtype: Optional[np.dtype],
 ) -> np.ndarray:
     """Extract the feature matrix from a pandas DataFrame.
 
@@ -85,6 +85,8 @@ def _features_from_pandas(
     first = col.iloc[0]
     if np.isscalar(first):
         return np.ascontiguousarray(col.to_numpy(dtype=dtype).reshape(-1, 1))
+    if dtype is None:
+        return np.ascontiguousarray(np.stack([np.asarray(v) for v in col]))
     return np.ascontiguousarray(np.stack([np.asarray(v, dtype=dtype) for v in col]))
 
 
@@ -95,7 +97,7 @@ def extract_arrays(
     label_col: Optional[str] = None,
     weight_col: Optional[str] = None,
     id_col: Optional[str] = None,
-    dtype: Union[np.dtype, type] = np.float32,
+    dtype: Union[np.dtype, type, None] = None,
     supervised: bool = False,
 ) -> _ArrayBatch:
     """Normalize any accepted dataset into host numpy arrays.
@@ -106,12 +108,13 @@ def extract_arrays(
     assembles the full (X, y, w) arrays and `shard_rows` splits them onto
     the mesh.
     """
-    dtype = np.dtype(dtype)
+    dtype = np.dtype(dtype) if dtype is not None else None
     y = w = rid = None
 
     if isinstance(dataset, (tuple, list)) and len(dataset) == 2:
         X, y = dataset
-        X = np.asarray(X, dtype=dtype) if not _is_sparse(X) else X
+        if not _is_sparse(X) and dtype is not None:
+            X = np.asarray(X, dtype=dtype)
         y = np.asarray(y)
     elif isinstance(dataset, np.ndarray):
         X = np.asarray(dataset, dtype=dtype)
@@ -136,8 +139,113 @@ def extract_arrays(
     if y is not None:
         y = np.ascontiguousarray(np.asarray(y).reshape(-1))
     if not _is_sparse(X):
-        X = np.ascontiguousarray(np.asarray(X, dtype=dtype))
+        X = np.asarray(X, dtype=dtype)
+        if not np.issubdtype(X.dtype, np.floating):
+            # integer/bool features promote to float64 (Spark double semantics)
+            X = X.astype(np.float64)
+        X = np.ascontiguousarray(X)
     return _ArrayBatch(X=X, y=y, weight=w, row_id=rid)
+
+
+class DeviceDataset:
+    """A dataset staged once onto the device mesh and reused across fits —
+    the analog of benchmarking against a cached Spark DataFrame (the
+    reference's benchmarks `.cache()` the input before timing fit,
+    python/benchmark/benchmark_runner.py workloads).
+
+    `fit(DeviceDataset)` skips host extraction and host->HBM staging
+    entirely: the rows already live sharded over the mesh.  Build one with
+    `DeviceDataset.from_host(X, y)` or from any accepted dataset type via
+    `DeviceDataset.persist(dataset, ...)`.
+    """
+
+    def __init__(self, mesh, X, n_valid: int, y=None, weight=None) -> None:
+        self.mesh = mesh
+        self.X = X  # jax.Array (N_pad, d), rows sharded over DATA_AXIS
+        self.y = y  # jax.Array (N_pad,) or None
+        self.weight = weight  # jax.Array (N_pad,) validity * sample weight
+        self.n_valid = int(n_valid)
+
+    @property
+    def shape(self) -> Tuple[int, int]:
+        return (self.n_valid, int(self.X.shape[1]))
+
+    def to_host_batch(self) -> _ArrayBatch:
+        """Pull the valid rows back to host (used by CPU-fallback fits)."""
+        import jax
+
+        fetch = {"X": self.X}
+        if self.y is not None:
+            fetch["y"] = self.y
+        if self.weight is not None:
+            fetch["w"] = self.weight
+        host = jax.device_get(fetch)
+        n = self.n_valid
+        return _ArrayBatch(
+            X=np.asarray(host["X"])[:n],
+            y=np.asarray(host["y"])[:n] if "y" in host else None,
+            weight=np.asarray(host["w"])[:n] if "w" in host else None,
+        )
+
+    @classmethod
+    def from_host(
+        cls,
+        X: np.ndarray,
+        y: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        num_workers: Optional[int] = None,
+        dtype: Union[np.dtype, type] = np.float32,
+        label_dtype: Union[np.dtype, type, None] = None,
+    ) -> "DeviceDataset":
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .parallel import get_mesh
+        from .parallel.mesh import DATA_AXIS, shard_rows
+
+        dtype = np.dtype(dtype)
+        mesh = get_mesh(num_workers)
+        X = _ensure_dense(np.asarray(X))
+        Xs, n_valid = shard_rows(X, mesh, dtype=dtype)
+        n_padded = Xs.shape[0]
+        pspec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+        w_host = np.zeros((n_padded,), dtype=dtype)
+        w_host[:n_valid] = 1.0 if weight is None else np.asarray(weight, dtype)
+        w = jax.device_put(w_host, pspec)
+        yd = None
+        if y is not None:
+            ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
+            y_host = np.zeros((n_padded,), dtype=ldt)
+            y_host[:n_valid] = np.asarray(y).reshape(-1).astype(ldt)
+            yd = jax.device_put(y_host, pspec)
+        return cls(mesh, Xs, n_valid, y=yd, weight=w)
+
+    @classmethod
+    def persist(
+        cls,
+        dataset: DatasetLike,
+        features_col: Optional[str] = None,
+        features_cols: Sequence[str] = (),
+        label_col: Optional[str] = None,
+        weight_col: Optional[str] = None,
+        num_workers: Optional[int] = None,
+        dtype: Union[np.dtype, type] = np.float32,
+    ) -> "DeviceDataset":
+        batch = extract_arrays(
+            dataset,
+            features_col=features_col,
+            features_cols=features_cols,
+            label_col=label_col,
+            weight_col=weight_col,
+            supervised=label_col is not None,
+        )
+        return cls.from_host(
+            _ensure_dense(batch.X),
+            y=batch.y,
+            weight=batch.weight,
+            num_workers=num_workers,
+            dtype=dtype,
+        )
 
 
 def read_parquet_batches(

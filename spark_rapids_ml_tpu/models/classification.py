@@ -34,6 +34,44 @@ from ..params import (
 from ..utils import _ArrayBatch, get_logger
 
 
+def _label_range_kernel(y, w):
+    import jax.numpy as jnp
+
+    valid = w > 0
+    big = jnp.iinfo(jnp.int32).max
+    return (
+        jnp.where(valid, y, big).min(),
+        jnp.where(valid, y, -1).max(),
+    )
+
+
+def _label_check_kernel(y, w):
+    """(is_integral, min_label) among valid rows, for float label arrays."""
+    import jax.numpy as jnp
+
+    valid = w > 0
+    yf = y.astype(jnp.float32)
+    integral = jnp.all(jnp.where(valid, yf == jnp.round(yf), True))
+    mn = jnp.where(valid, yf, jnp.inf).min()
+    return integral, mn
+
+
+def _label_range(y, w):
+    """(min, max) label among valid (w>0) rows, computed on device."""
+    import jax
+
+    global _label_range_jit
+    if _label_range_jit is None:
+        _label_range_jit = jax.jit(_label_range_kernel)
+    # one host round-trip for both scalars (device_get batches the fetch;
+    # separate int() casts would each block on the tunnel)
+    return jax.device_get(_label_range_jit(y, w))
+
+
+_label_range_jit = None
+_label_check_jit = None
+
+
 class LogisticRegressionClass:
     """Param mapping (reference LogisticRegressionClass
     classification.py:679-747, incl. the regParam -> C inversion
@@ -232,6 +270,20 @@ class LogisticRegression(
         if classes.min() < 0:
             raise RuntimeError(f"Labels MUST be non-negative, but got {classes}")
 
+    def _validate_device_input(self, ds) -> None:
+        """Same label contract as `_validate_input`, evaluated on device for
+        DeviceDataset fits (before the int32 cast would mask violations)."""
+        import jax
+
+        global _label_check_jit
+        if _label_check_jit is None:
+            _label_check_jit = jax.jit(_label_check_kernel)
+        integral, mn = jax.device_get(_label_check_jit(ds.y, ds.weight))
+        if not bool(integral):
+            raise RuntimeError("Labels MUST be Integers")
+        if float(mn) < 0:
+            raise RuntimeError(f"Labels MUST be non-negative, but got min {mn}")
+
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
         import jax.numpy as jnp
 
@@ -240,13 +292,16 @@ class LogisticRegression(
 
         p = fit_input.params
         dtype = np.dtype(fit_input.dtype)
+        # label range via two on-device scalar reductions — pulling the full
+        # y/w arrays to host would cross HBM->host for the whole dataset;
         # integrality was validated host-side pre-staging (_validate_input)
-        classes = np.unique(np.asarray(fit_input.y)[np.asarray(fit_input.w) > 0])
+        y_min, y_max = _label_range(fit_input.y, fit_input.w)
+        y_min, y_max = int(y_min), int(y_max)
 
         # degenerate single-label dataset (Spark semantics: +/-inf intercept,
         # reference classification.py:1106-1121)
-        if len(classes) == 1:
-            cv = float(classes[0])
+        if y_min == y_max:
+            cv = float(y_min)
             if cv not in (0.0, 1.0):
                 raise RuntimeError(
                     "class value must be either 1. or 0. when dataset has one label"
@@ -263,7 +318,7 @@ class LogisticRegression(
 
         # Spark numClasses = max(label)+1 (can include empty classes;
         # cuML instead uses unique - see reference TODO classification.py:1106)
-        n_classes = int(classes.max()) + 1
+        n_classes = y_max + 1
         family = str(self.getOrDefault("family"))
         binomial = n_classes == 2 and family in ("auto", "binomial")
 
@@ -294,20 +349,31 @@ class LogisticRegression(
             history=int(p.get("lbfgs_memory", 10)),
             ls_max=int(p.get("linesearch_max_iter", 20)),
         )
+        import jax
+
         if binomial:
             coef, b, loss, n_iter = logreg_fit_binary(X, w, fit_input.y, **kwargs)
-            coef = np.asarray(coef, np.float64).reshape(1, -1)
-            intercept = np.array([float(b)])
         else:
-            Wm, bvec, loss, n_iter = logreg_fit(
+            coef, b, loss, n_iter = logreg_fit(
                 X, w, fit_input.y, n_classes=n_classes, **kwargs
             )
-            coef = np.asarray(Wm, np.float64)
-            intercept = np.asarray(bvec, np.float64)
+        # ONE batched device->host fetch for every output (each separate
+        # np.asarray/float() would pay a full host sync)
+        fetch = {"coef": coef, "b": b, "loss": loss, "n_iter": n_iter}
+        if standardization:
+            fetch["mean"], fetch["std"] = mean, std
+        host = jax.device_get(fetch)
+        loss, n_iter = host["loss"], host["n_iter"]
+        if binomial:
+            coef = np.asarray(host["coef"], np.float64).reshape(1, -1)
+            intercept = np.array([float(host["b"])])
+        else:
+            coef = np.asarray(host["coef"], np.float64)
+            intercept = np.asarray(host["b"], np.float64)
 
         if standardization:
-            mean = np.asarray(mean, np.float64)
-            std = np.asarray(std, np.float64)
+            mean = np.asarray(host["mean"], np.float64)
+            std = np.asarray(host["std"], np.float64)
             coef = np.where(std > 0, coef / std, coef)
             if fit_intercept:
                 intercept = intercept - coef @ mean

@@ -98,7 +98,11 @@ def kmeans_fit(
         onehot = jax.nn.one_hot(labels, k, dtype=X.dtype) * w[:, None]
         counts = onehot.sum(axis=0)  # (k,)  — psum over shards
         sums = onehot.T @ X  # (k,d) — MXU + psum
-        new_C = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts, 1.0)[:, None], C)
+        # guard only against zero weight — fractional total weights (<1)
+        # must still divide exactly
+        new_C = jnp.where(
+            counts[:, None] > 0, sums / jnp.where(counts > 0, counts, 1.0)[:, None], C
+        )
         cost = (min_d2 * w).sum()
         return new_C, cost
 

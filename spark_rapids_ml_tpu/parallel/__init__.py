@@ -10,7 +10,6 @@ from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
     get_mesh,
-    pad_rows,
     replicate,
     shard_rows,
     data_pspec,

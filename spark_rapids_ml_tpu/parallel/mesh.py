@@ -62,19 +62,6 @@ def replicated_pspec() -> PartitionSpec:
     return PartitionSpec()
 
 
-def pad_rows(arr: np.ndarray, multiple: int) -> Tuple[np.ndarray, int]:
-    """Zero-pad rows to a multiple of the mesh size so the global array
-    shards evenly.  Padded rows carry zero weight in every kernel; static
-    shapes keep XLA retracing away (jit caches per padded shape)."""
-    n = arr.shape[0]
-    rem = (-n) % multiple
-    if rem == 0:
-        return arr, n
-    pad_shape = (rem,) + arr.shape[1:]
-    padded = np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)], axis=0)
-    return padded, n
-
-
 def shard_rows(
     arr: np.ndarray,
     mesh: Mesh,
@@ -87,10 +74,16 @@ def shard_rows(
     single `jax.device_put` with a NamedSharding splits rows across chips.
     Returns (global sharded jax.Array, true row count before padding).
     """
-    if dtype is not None and arr.dtype != dtype:
-        arr = arr.astype(dtype)
-    ensure_x64(arr.dtype)
-    padded, n_valid = pad_rows(arr, mesh.devices.size)
+    dtype = np.dtype(dtype) if dtype is not None else arr.dtype
+    ensure_x64(dtype)
+    n_valid = arr.shape[0]
+    rem = (-n_valid) % mesh.devices.size
+    if rem or arr.dtype != dtype:
+        # single host copy fusing the dtype cast and the zero-padding
+        padded = np.zeros((n_valid + rem,) + arr.shape[1:], dtype)
+        padded[:n_valid] = arr
+    else:
+        padded = arr
     sharding = NamedSharding(mesh, data_pspec(padded.ndim))
     return jax.device_put(padded, sharding), n_valid
 
