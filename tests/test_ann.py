@@ -1,0 +1,127 @@
+#
+# ANN tests — the analog of reference tests/test_approximate_nearest_
+# neighbors.py: recall vs exact brute force (the reference benchmarks
+# recall via utils_knn.py), full-probe exactness, ivfpq smoke, joins.
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.neighbors import NearestNeighbors as SkNN
+
+from spark_rapids_ml_tpu.knn import (
+    ApproximateNearestNeighbors,
+    ApproximateNearestNeighborsModel,
+)
+
+
+def _recall(got_idx: np.ndarray, want_idx: np.ndarray) -> float:
+    hits = 0
+    for g, w in zip(got_idx, want_idx):
+        hits += len(set(g.tolist()) & set(w.tolist()))
+    return hits / want_idx.size
+
+
+@pytest.fixture
+def blobs(rng):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=500, n_features=16, centers=10, random_state=0)
+    return X.astype(np.float32)
+
+
+def test_ivfflat_full_probe_is_exact(blobs, num_workers):
+    k = 8
+    ann = ApproximateNearestNeighbors(
+        k=k, algoParams={"nlist": 10, "nprobe": 10}, num_workers=num_workers
+    )
+    model = ann.fit(blobs)
+    _, _, knn_df = model.kneighbors(blobs[:50])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(blobs)
+    want_dist, want_idx = sk.kneighbors(blobs[:50])
+    # probing every list == exact search
+    assert _recall(got_idx, want_idx) == 1.0
+    got_dist = np.stack(knn_df["distances"].to_numpy())
+    # f32 matmul-identity distances carry cancellation noise ~1e-2 at these
+    # norms (the reference's GPU path has the same property)
+    np.testing.assert_allclose(np.sort(got_dist), np.sort(want_dist), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_ivfflat_partial_probe_recall(blobs):
+    k = 8
+    model = ApproximateNearestNeighbors(
+        k=k, algoParams={"nlist": 16, "nprobe": 4}
+    ).fit(blobs)
+    _, _, knn_df = model.kneighbors(blobs[:100])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(blobs)
+    _, want_idx = sk.kneighbors(blobs[:100])
+    # blob data with 1/4 of lists probed: high recall expected
+    assert _recall(got_idx, want_idx) > 0.85
+
+
+def test_ivfpq_recall(blobs):
+    k = 5
+    model = ApproximateNearestNeighbors(
+        algorithm="ivfpq",
+        k=k,
+        algoParams={"nlist": 8, "nprobe": 8, "M": 4, "refine_ratio": 4},
+    ).fit(blobs)
+    _, _, knn_df = model.kneighbors(blobs[:100])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(blobs)
+    _, want_idx = sk.kneighbors(blobs[:100])
+    assert _recall(got_idx, want_idx) > 0.7
+
+
+def test_sqeuclidean_metric(blobs):
+    model = ApproximateNearestNeighbors(
+        k=3, metric="sqeuclidean", algoParams={"nlist": 4, "nprobe": 4}
+    ).fit(blobs[:60])
+    _, _, knn_df = model.kneighbors(blobs[:10])
+    d_sq = np.stack(knn_df["distances"].to_numpy())
+    model2 = ApproximateNearestNeighbors(
+        k=3, algoParams={"nlist": 4, "nprobe": 4}
+    ).fit(blobs[:60])
+    _, _, knn_df2 = model2.kneighbors(blobs[:10])
+    d_eu = np.stack(knn_df2["distances"].to_numpy())
+    np.testing.assert_allclose(np.sqrt(d_sq), d_eu, rtol=1e-3, atol=1e-3)
+
+
+def test_bad_n_bits_raises(blobs):
+    with pytest.raises(ValueError, match="n_bits"):
+        ApproximateNearestNeighbors(
+            algorithm="ivfpq", algoParams={"n_bits": 10}
+        ).fit(blobs)
+
+
+def test_unsupported_algorithm_raises(blobs):
+    with pytest.raises(ValueError, match="not supported"):
+        ApproximateNearestNeighbors(algorithm="cagra").fit(blobs)
+
+
+def test_approx_similarity_join(blobs):
+    model = ApproximateNearestNeighbors(
+        k=3, algoParams={"nlist": 4, "nprobe": 4}
+    ).fit(blobs[:50])
+    join_df = model.approxSimilarityJoin(blobs[:5], distCol="dist")
+    assert list(join_df.columns) == ["item_id", "query_id", "dist"]
+    assert len(join_df) == 15
+    # self-neighbors at distance ~0
+    self_rows = join_df[join_df["item_id"] == join_df["query_id"]]
+    assert np.allclose(self_rows["dist"], 0.0, atol=1e-3)
+
+
+def test_ann_save_load(tmp_path, blobs):
+    model = ApproximateNearestNeighbors(
+        k=4, algoParams={"nlist": 8, "nprobe": 8}
+    ).fit(blobs)
+    path = str(tmp_path / "ann")
+    model.save(path)
+    loaded = ApproximateNearestNeighborsModel.load(path)
+    _, _, a = model.kneighbors(blobs[:10])
+    _, _, b = loaded.kneighbors(blobs[:10])
+    assert np.array_equal(
+        np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
+    )

@@ -1,0 +1,108 @@
+#
+# Exact k-NN tests — the analog of reference tests/test_nearest_neighbors.py:
+# equivalence vs sklearn brute force across mesh sizes, feature layouts, and
+# id columns.
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.neighbors import NearestNeighbors as SkNN
+
+from spark_rapids_ml_tpu.knn import NearestNeighbors, NearestNeighborsModel
+
+
+def _make_data(rng, n_items=80, n_queries=23, d=8):
+    items = rng.normal(size=(n_items, d)).astype(np.float32)
+    queries = rng.normal(size=(n_queries, d)).astype(np.float32)
+    return items, queries
+
+
+def test_kneighbors_matches_sklearn(rng, num_workers):
+    items, queries = _make_data(rng)
+    k = 7
+    model = NearestNeighbors(k=k, num_workers=num_workers).fit(items)
+    _, _, knn_df = model.kneighbors(queries)
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    got_dist = np.stack(knn_df["distances"].to_numpy())
+
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(items)
+    want_dist, want_idx = sk.kneighbors(queries)
+
+    np.testing.assert_allclose(got_dist, want_dist, rtol=1e-4, atol=1e-4)
+    # index ties can differ; distances must agree exactly per slot
+    same = got_idx == want_idx
+    tie = np.isclose(got_dist, want_dist, rtol=1e-4, atol=1e-4)
+    assert np.all(same | tie)
+
+
+def test_kneighbors_pandas_and_id_col(rng):
+    items, queries = _make_data(rng, n_items=30, n_queries=5, d=4)
+    item_df = pd.DataFrame(
+        {"features": list(items), "id": np.arange(100, 130)}
+    )
+    query_df = pd.DataFrame({"features": list(queries)})
+    model = (
+        NearestNeighbors(k=3)
+        .setFeaturesCol("features")
+        .setIdCol("id")
+        .fit(item_df)
+    )
+    _, _, knn_df = model.kneighbors(query_df)
+    # ids come from the user id column, offset by 100
+    all_ids = np.concatenate(knn_df["indices"].to_numpy())
+    assert all_ids.min() >= 100 and all_ids.max() < 130
+
+    sk = SkNN(n_neighbors=3, algorithm="brute").fit(items)
+    _, want_idx = sk.kneighbors(queries)
+    got_idx = np.stack(knn_df["indices"].to_numpy()) - 100
+    assert np.array_equal(got_idx, want_idx)
+
+
+def test_multi_col_features(rng):
+    items, queries = _make_data(rng, n_items=20, n_queries=4, d=3)
+    cols = ["c0", "c1", "c2"]
+    item_df = pd.DataFrame(items, columns=cols)
+    query_df = pd.DataFrame(queries, columns=cols)
+    model = NearestNeighbors(k=2).setFeaturesCols(cols).fit(item_df)
+    _, _, knn_df = model.kneighbors(query_df)
+    sk = SkNN(n_neighbors=2, algorithm="brute").fit(items)
+    _, want_idx = sk.kneighbors(queries)
+    assert np.array_equal(np.stack(knn_df["indices"].to_numpy()), want_idx)
+
+
+def test_exact_nearest_neighbors_join(rng):
+    items, queries = _make_data(rng, n_items=15, n_queries=3, d=4)
+    model = NearestNeighbors(k=2).fit(items)
+    join_df = model.exactNearestNeighborsJoin(queries, distCol="dc")
+    assert list(join_df.columns) == ["item_id", "query_id", "dc"]
+    assert len(join_df) == 3 * 2
+
+
+def test_k_exceeds_items_raises(rng):
+    items, queries = _make_data(rng, n_items=4, n_queries=2, d=3)
+    model = NearestNeighbors(k=10).fit(items)
+    with pytest.raises(ValueError, match="exceeds"):
+        model.kneighbors(queries)
+
+
+def test_transform_unsupported(rng):
+    items, _ = _make_data(rng, n_items=5, n_queries=1, d=2)
+    model = NearestNeighbors(k=2).fit(items)
+    with pytest.raises(NotImplementedError):
+        model.transform(items)
+
+
+def test_save_load(tmp_path, rng):
+    items, queries = _make_data(rng, n_items=25, n_queries=6, d=5)
+    model = NearestNeighbors(k=4).fit(items)
+    path = str(tmp_path / "nn_model")
+    model.save(path)
+    loaded = NearestNeighborsModel.load(path)
+    _, _, a = model.kneighbors(queries)
+    _, _, b = loaded.kneighbors(queries)
+    np.testing.assert_allclose(
+        np.stack(a["distances"].to_numpy()), np.stack(b["distances"].to_numpy())
+    )
+    assert np.array_equal(
+        np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
+    )
