@@ -255,3 +255,188 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
         sk._n_threads = 1
         sk.n_features_in_ = self.n_cols
         return sk
+
+
+# ---------------------------------------------------------------------------
+# DBSCAN (reference clustering.py:729-1182)
+# ---------------------------------------------------------------------------
+
+
+class DBSCANClass:
+    """Param surface (reference DBSCANClass clustering.py:603-632: cuML-native
+    names — Spark MLlib has no DBSCAN, so there is no Spark param mapping)."""
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        # identity mapping: the API params ARE the backend params
+        return {"eps": "eps", "min_samples": "min_samples", "metric": "metric"}
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "eps": 0.5,
+            "min_samples": 5,
+            "metric": "euclidean",
+            "max_mbytes_per_batch": None,
+            "verbose": False,
+            "calc_core_sample_indices": False,
+        }
+
+
+class _DBSCANTpuParams(
+    _TpuParams, HasFeaturesCol, HasFeaturesCols, HasPredictionCol
+):
+    eps = Param("_", "eps",
+                "The maximum distance between two samples for one to be "
+                "considered in the neighborhood of the other.",
+                TypeConverters.toFloat)
+    min_samples = Param("_", "min_samples",
+                        "The number of samples in a neighborhood (including "
+                        "the point itself) for a point to be a core point.",
+                        TypeConverters.toInt)
+    metric = Param("_", "metric", "Distance metric: euclidean or cosine.",
+                   TypeConverters.toString)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(eps=0.5, min_samples=5, metric="euclidean")
+
+    def setFeaturesCol(self, value):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+    def setFeaturesCols(self, value: List[str]):
+        return self._set_params(featuresCols=value)
+
+    def setPredictionCol(self, value: str):
+        self._set(predictionCol=value)
+        return self
+
+    def setEps(self, value: float):
+        return self._set_params(eps=value)
+
+    def getEps(self) -> float:
+        return self.getOrDefault("eps")
+
+    def setMinSamples(self, value: int):
+        return self._set_params(min_samples=value)
+
+    def getMinSamples(self) -> int:
+        return self.getOrDefault("min_samples")
+
+    def setMetric(self, value: str):
+        return self._set_params(metric=value)
+
+    def getMetric(self) -> str:
+        return self.getOrDefault("metric")
+
+
+class DBSCAN(DBSCANClass, _TpuEstimator, _DBSCANTpuParams):
+    """Distributed DBSCAN on TPU (API parity: reference DBSCAN
+    clustering.py:729-931).
+
+    `fit` is deferred exactly like the reference (clustering.py:900-914
+    returns a param-copied model): clustering is density-based, so there is
+    no model to train — the work happens in `DBSCANModel.transform`, which
+    labels the given dataset.  The reference broadcasts the whole dataset
+    to every rank (clustering.py:1104-1155); here the dataset is replicated
+    per device and responsibility for rows is sharded, with cluster
+    expansion as min-label connected components (ops/dbscan.py).
+
+    Examples
+    --------
+    >>> import pandas as pd
+    >>> from spark_rapids_ml_tpu.clustering import DBSCAN
+    >>> df = pd.DataFrame({"features": [[0.0], [0.1], [0.2], [9.0], [9.1], [50.0]]})
+    >>> model = DBSCAN(eps=0.5, min_samples=2).setFeaturesCol("features").fit(df)
+    >>> model.transform(df)["prediction"].tolist()
+    [0, 0, 0, 1, 1, -1]
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._set_params(**kwargs)
+
+    def _fit(self, dataset) -> "DBSCANModel":
+        if str(self._tpu_params.get("metric", "euclidean")) not in (
+            "euclidean", "cosine"
+        ):
+            raise ValueError("DBSCAN metric must be euclidean or cosine")
+        model = DBSCANModel(
+            n_cols=0, dtype="float32"
+        )  # deferred: no attributes until transform
+        self._copyValues(model)
+        model._tpu_params = dict(self._tpu_params)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        return model
+
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:  # pragma: no cover
+        raise NotImplementedError("DBSCAN fit is deferred to transform")
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "DBSCANModel":  # pragma: no cover
+        return DBSCANModel(**attrs)
+
+
+class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
+    """Deferred-fit DBSCAN model (reference DBSCANModel clustering.py:933-1182):
+    `transform` runs the distributed fit_predict on the given dataset and
+    appends the cluster label column (-1 = noise, clusters renumbered to
+    consecutive ids by first occurrence, matching sklearn)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        super().__init__(**attrs)
+        self.n_cols = int(attrs.get("n_cols", 0))
+        self.dtype = str(attrs.get("dtype", "float32"))
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dbscan import dbscan_fit_predict
+        from ..parallel import TpuContext
+        from ..parallel.mesh import row_mask, shard_rows
+
+        eps = float(self._tpu_params["eps"])
+        if str(self._tpu_params.get("metric", "euclidean")) == "cosine":
+            # cosine_dist <= eps on unit vectors  <=>  ||u-v|| <= sqrt(2 eps)
+            # (||u-v||^2 = 2 (1 - cos) = 2 cosine_dist)
+            norms = np.linalg.norm(X, axis=1, keepdims=True)
+            X = X / np.maximum(norms, 1e-12)
+            eps = float(np.sqrt(2.0 * eps))
+        with TpuContext(self.num_workers, require_p2p=True) as ctx:
+            mesh = ctx.mesh
+        dtype = self._out_dtype(X)
+        Xs, n_valid = shard_rows(X, mesh, dtype=dtype)
+        valid = row_mask(n_valid, Xs.shape[0], mesh, dtype=dtype)
+        labels, _core = dbscan_fit_predict(
+            Xs, valid,
+            jnp.asarray(eps, dtype),
+            jnp.asarray(int(self._tpu_params["min_samples"]), jnp.int32),
+            mesh=mesh,
+        )
+        labels = np.asarray(jax.device_get(labels))[:n_valid]
+        # renumber representatives to consecutive ids by first occurrence
+        out = np.full(labels.shape, -1, np.int64)
+        next_id = 0
+        seen: Dict[int, int] = {}
+        for i, rep in enumerate(labels):
+            if rep < 0:
+                continue
+            if rep not in seen:
+                seen[rep] = next_id
+                next_id += 1
+            out[i] = seen[rep]
+        return {self.getOrDefault("predictionCol"): out}
+
+    def cpu(self):
+        from sklearn.cluster import DBSCAN as SkDBSCAN
+
+        return SkDBSCAN(
+            eps=float(self._tpu_params["eps"]),
+            min_samples=int(self._tpu_params["min_samples"]),
+            metric=str(self._tpu_params.get("metric", "euclidean")),
+        )

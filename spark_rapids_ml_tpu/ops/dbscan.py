@@ -1,0 +1,129 @@
+#
+# DBSCAN kernel — the TPU-native replacement for
+# `cuml.cluster.dbscan_mg.DBSCANMG.fit_predict` (called from reference
+# clustering.py:1058-1074).  The reference broadcasts the whole dataset to
+# every GPU in <=8GB chunks (clustering.py:1104-1155) and runs a CSR/BFS
+# cluster expansion; here the dataset is replicated per device (the same
+# memory contract), row *responsibility* is sharded, and cluster expansion
+# is min-label connected components:
+#
+#   - Core detection: one (m, N) block distance pass per shard -> degree
+#     counts (an MXU matmul via the ||a-b||^2 identity).
+#   - Expansion: labels start as the global row index on core points.  Each
+#     sweep takes, for every local row, the min label over its in-eps core
+#     neighbors; a pointer-jumping step (label <- label[label]) collapses
+#     chains so convergence is ~O(log N) sweeps instead of O(graph
+#     diameter).  Labels are replicated via all_gather after every sweep —
+#     N int32s over ICI, negligible next to the distance pass.
+#   - Border points attach to their minimum-label core neighbor after
+#     convergence; everything else is noise (-1), matching
+#     sklearn/cuML semantics (neighbor counts include the point itself).
+#
+# The in-eps adjacency of the local block is computed once and carried
+# through the while_loop (memory N^2/p bits-as-bools per device — the same
+# order as the reference's broadcast dataset; recompute-per-sweep is the
+# memory-lean alternative if this ever dominates).
+#
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+
+def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
+    a2 = (A * A).sum(axis=1, keepdims=True)
+    b2 = (B * B).sum(axis=1)
+    return jnp.maximum(a2 - 2.0 * (A @ B.T) + b2, 0.0)
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_sweeps"))
+def dbscan_fit_predict(
+    X_sharded: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
+    valid_sharded: jax.Array,  # (N_pad,) validity, sharded
+    eps: jax.Array,  # scalar
+    min_samples: jax.Array,  # scalar int
+    mesh=None,
+    max_sweeps: int = 64,
+):
+    """Returns (labels (N_pad,) int32 row-sharded, core_mask (N_pad,) bool).
+
+    Labels are min-row-index cluster representatives; -1 is noise.  The API
+    layer renumbers to consecutive ids on the host (the reference's labels
+    come back from rank 0 the same way, clustering.py:1160-1182).
+    """
+    n_shards = mesh.devices.size
+    N = X_sharded.shape[0]
+    SENT = jnp.int32(N)  # sentinel: "no label"
+    eps2 = eps * eps
+
+    def kernel(Xl, valid_l_f):
+        m = Xl.shape[0]
+        row0 = jax.lax.axis_index(DATA_AXIS) * m
+        local_idx = row0 + jnp.arange(m, dtype=jnp.int32)
+
+        # replicate the dataset on-device (the reference broadcasts it
+        # host-side, clustering.py:1148-1155; one all_gather over ICI here)
+        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)  # (N, d)
+        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)  # (N,)
+
+        d2 = _sqdist(Xl, Xf)  # (m, N)
+        adj = (d2 <= eps2) & (vf > 0)[None, :]
+        deg = adj.sum(axis=1)
+        valid_l = valid_l_f > 0
+        core_l = (deg >= min_samples) & valid_l
+        core_f = jax.lax.all_gather(core_l, DATA_AXIS, tiled=True)  # (N,)
+
+        labels0_l = jnp.where(core_l, local_idx, SENT)
+        labels0 = jax.lax.all_gather(labels0_l, DATA_AXIS, tiled=True)
+
+        def sweep(state):
+            labels, _, it = state
+            core_lab = jnp.where(core_f, labels, SENT)  # only core labels spread
+            cand = jnp.min(
+                jnp.where(adj, core_lab[None, :], SENT), axis=1
+            )  # (m,) min core label among in-eps neighbors
+            lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
+            new_l = jnp.where(core_l, jnp.minimum(lab_l, cand), lab_l)
+            new = jax.lax.all_gather(new_l, DATA_AXIS, tiled=True)
+            # pointer jumping: follow the representative one hop
+            safe = jnp.clip(new, 0, N - 1)
+            hop = jnp.where(new < SENT, jnp.take(new, safe), SENT)
+            new = jnp.minimum(new, hop)
+            changed = jnp.any(new != labels)
+            return new, changed, it + 1
+
+        def cond(state):
+            _, changed, it = state
+            return changed & (it < max_sweeps)
+
+        # pcast marks the loop carry as device-varying so its type is stable
+        # across collective-producing sweeps
+        init = (
+            labels0,
+            jax.lax.pcast(jnp.bool_(True), (DATA_AXIS,), to="varying"),
+            jax.lax.pcast(jnp.int32(0), (DATA_AXIS,), to="varying"),
+        )
+        labels, _, _ = jax.lax.while_loop(cond, sweep, init)
+
+        # border points: attach to the min-label in-eps core neighbor
+        core_lab = jnp.where(core_f, labels, SENT)
+        cand = jnp.min(jnp.where(adj, core_lab[None, :], SENT), axis=1)
+        lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
+        final_l = jnp.where(
+            core_l, lab_l, jnp.where(cand < SENT, cand, jnp.int32(-1))
+        )
+        final_l = jnp.where(valid_l, final_l, jnp.int32(-1))
+        return final_l, core_l
+
+    shard = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+    )
+    return shard(X_sharded, valid_sharded)

@@ -1,0 +1,98 @@
+#
+# DBSCAN tests — the analog of reference tests/test_dbscan.py: equivalence
+# vs sklearn.cluster.DBSCAN across mesh sizes, noise handling, metrics.
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.cluster import DBSCAN as SkDBSCAN
+from sklearn.datasets import make_blobs, make_moons
+from sklearn.metrics import adjusted_rand_score
+
+from spark_rapids_ml_tpu.clustering import DBSCAN, DBSCANModel
+
+
+def _labels(model, X):
+    df = pd.DataFrame({"features": list(np.asarray(X, dtype=np.float32))})
+    out = model.transform(df)
+    return out["prediction"].to_numpy()
+
+
+def test_blobs_matches_sklearn(rng, num_workers):
+    X, _ = make_blobs(n_samples=200, n_features=4, centers=4,
+                      cluster_std=0.4, random_state=7)
+    X = X.astype(np.float32)
+    eps, min_samples = 1.0, 5
+    model = DBSCAN(eps=eps, min_samples=min_samples,
+                   num_workers=num_workers).fit(X)
+    got = _labels(model, X)
+    want = SkDBSCAN(eps=eps, min_samples=min_samples).fit_predict(X)
+    assert adjusted_rand_score(got, want) == 1.0
+    assert np.array_equal(got == -1, want == -1)
+
+
+def test_moons_chain_clusters(rng):
+    # snake-shaped clusters stress the label-propagation convergence
+    X, _ = make_moons(n_samples=300, noise=0.05, random_state=0)
+    X = X.astype(np.float32)
+    model = DBSCAN(eps=0.2, min_samples=4).fit(X)
+    got = _labels(model, X)
+    want = SkDBSCAN(eps=0.2, min_samples=4).fit_predict(X)
+    assert adjusted_rand_score(got, want) == 1.0
+
+
+def test_all_noise(rng):
+    X = (rng.uniform(size=(40, 3)) * 100).astype(np.float32)
+    model = DBSCAN(eps=0.01, min_samples=3).fit(X)
+    got = _labels(model, X)
+    assert np.all(got == -1)
+
+
+def test_single_cluster(rng):
+    X = rng.normal(scale=0.05, size=(50, 2)).astype(np.float32)
+    model = DBSCAN(eps=1.0, min_samples=3).fit(X)
+    got = _labels(model, X)
+    assert np.all(got == 0)
+
+
+def test_border_points(rng):
+    # classic: a border point within eps of a core point but itself not core
+    X = np.array([[0.0], [0.4], [0.8], [1.2], [5.0]], dtype=np.float32)
+    model = DBSCAN(eps=0.5, min_samples=3).fit(X)
+    got = _labels(model, X)
+    want = SkDBSCAN(eps=0.5, min_samples=3).fit_predict(X)
+    assert adjusted_rand_score(got, want) == 1.0
+    assert np.array_equal(got == -1, want == -1)
+
+
+def test_cosine_metric(rng):
+    X = rng.normal(size=(60, 5)).astype(np.float32)
+    model = DBSCAN(eps=0.3, min_samples=4, metric="cosine").fit(X)
+    got = _labels(model, X)
+    want = SkDBSCAN(eps=0.3, min_samples=4, metric="cosine").fit_predict(X)
+    assert adjusted_rand_score(got, want) == 1.0
+    assert np.array_equal(got == -1, want == -1)
+
+
+def test_bad_metric_raises():
+    with pytest.raises(ValueError, match="metric"):
+        DBSCAN(metric="manhattan").fit(np.zeros((5, 2), np.float32))
+
+
+def test_deferred_fit_and_params(rng):
+    X = rng.normal(size=(30, 2)).astype(np.float32)
+    est = DBSCAN(eps=0.7, min_samples=4)
+    model = est.fit(X)
+    # fit is deferred: the model simply carries the params
+    assert model.getEps() == 0.7
+    assert model.getMinSamples() == 4
+    assert isinstance(model, DBSCANModel)
+
+
+def test_prediction_col_rename(rng):
+    X, _ = make_blobs(n_samples=50, n_features=2, centers=2, random_state=1)
+    model = DBSCAN(eps=1.5, min_samples=3).setPredictionCol("cluster").fit(
+        X.astype(np.float32)
+    )
+    df = pd.DataFrame({"features": list(X.astype(np.float32))})
+    assert "cluster" in model.transform(df).columns
