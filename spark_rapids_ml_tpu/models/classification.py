@@ -1,5 +1,5 @@
 #
-# Classification: LogisticRegression (+ RandomForestClassifier later) — the
+# Classification: LogisticRegression + RandomForestClassifier — the
 # analog of reference classification.py (1615 LoC).  The cuML
 # `LogisticRegressionMG` L-BFGS/OWL-QN distributed solver
 # (classification.py:1046-1081) is replaced by ops/logistic.py +
@@ -528,3 +528,185 @@ class LogisticRegressionModel(
             sk.classes_ = np.array(self.classes_)
         sk.n_features_in_ = self.n_cols
         return sk
+
+
+# ---------------------------------------------------------------------------
+# RandomForestClassifier (reference classification.py RandomForestClassifier
+# + tree.py shared layer)
+# ---------------------------------------------------------------------------
+
+
+from ..models.tree import (  # noqa: E402
+    _RandomForestEstimator,
+    _RandomForestModel,
+)
+
+
+class RandomForestClassifier(
+    _RandomForestEstimator, HasProbabilityCol, HasRawPredictionCol
+):
+    """Distributed random forest classifier on TPU (API parity: reference
+    RandomForestClassifier in classification.py + tree.py:314-528).
+
+    Ensemble parallelism matches the reference (tree.py:330-341): each mesh
+    device grows numTrees/num_workers trees on its local row shard with the
+    ops/forest.py histogram builder; no collectives are needed during
+    growth (the reference similarly uses no NCCL for RF, tree.py:523-524).
+
+    Examples
+    --------
+    >>> import numpy as np, pandas as pd
+    >>> from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    >>> df = pd.DataFrame({"features": [[0.0], [0.1], [0.9], [1.0]],
+    ...                    "label": [0.0, 0.0, 1.0, 1.0]})
+    >>> rf = RandomForestClassifier(numTrees=5, seed=7, num_workers=1)
+    >>> model = rf.setFeaturesCol("features").setLabelCol("label").fit(df)
+    >>> model.transform(df)["prediction"].tolist()
+    [0, 0, 1, 1]
+    """
+
+    def setProbabilityCol(self, value: str):
+        self._set(probabilityCol=value)
+        return self
+
+    def setRawPredictionCol(self, value: str):
+        self._set(rawPredictionCol=value)
+        return self
+
+    def _is_classification(self) -> bool:
+        return True
+
+    def _validate_input(self, batch: _ArrayBatch) -> None:
+        y = np.asarray(batch.y)
+        classes = np.unique(y)
+        if np.any(classes < 0) or not np.allclose(classes, np.round(classes)):
+            # reference error remap tree.py:415-421
+            raise ValueError(
+                "Labels must be non-negative integers 0..numClasses-1, got "
+                f"{classes[:10]}"
+            )
+
+    def _validate_device_input(self, ds) -> None:
+        # device-side label check for DeviceDataset fits (same contract as
+        # the host path; mirrors LogisticRegression's device validation)
+        import jax
+
+        global _label_check_jit
+        if _label_check_jit is None:
+            _label_check_jit = jax.jit(_label_check_kernel)
+        integral, mn = jax.device_get(_label_check_jit(ds.y, ds.weight))
+        if not bool(integral) or float(mn) < 0:
+            raise ValueError(
+                "Labels must be non-negative integers 0..numClasses-1"
+            )
+
+    def _num_stat_classes(self, fit_input: FitInput) -> int:
+        import jax
+
+        # labels are validated >= 0; padded rows are 0, so a plain max works
+        # (one scalar device->host fetch)
+        C = int(jax.device_get(fit_input.y.max())) + 1
+        self._n_classes_ = C
+        return C
+
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
+        attrs = super()._fit_array(fit_input)
+        attrs["num_classes"] = self._n_classes_
+        return attrs
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "RandomForestClassificationModel":
+        return RandomForestClassificationModel(**attrs)
+
+    def _cpu_fit(self, batch: _ArrayBatch) -> "RandomForestClassificationModel":
+        raise NotImplementedError(
+            "RandomForestClassifier has no CPU fallback; unset unsupported params"
+        )
+
+
+class RandomForestClassificationModel(
+    _RandomForestModel, HasProbabilityCol, HasRawPredictionCol
+):
+    """Random forest classification model (reference
+    RandomForestClassificationModel in classification.py)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        super().__init__(**attrs)
+        self.num_classes: int = int(attrs.get("num_classes",
+                                              self.leaf_stats.shape[-1]))
+
+    @property
+    def numClasses(self) -> int:
+        return self.num_classes
+
+    def _output_columns(self) -> List[str]:
+        return [
+            self.getOrDefault("predictionCol"),
+            self.getOrDefault("probabilityCol"),
+            self.getOrDefault("rawPredictionCol"),
+        ]
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        leaves = self._apply_trees(X)  # (T, n)
+        # per-tree leaf class-count distributions, normalized per tree then
+        # summed (Spark rawPrediction semantics)
+        counts = np.take_along_axis(
+            self.leaf_stats, leaves[:, :, None], axis=1
+        )  # (T, n, C)
+        sums = np.maximum(counts.sum(axis=2, keepdims=True), 1e-12)
+        raw = (counts / sums).sum(axis=0)  # (n, C)
+        probs = raw / self.numTrees
+        preds = np.argmax(raw, axis=1).astype(np.int32)
+        return {
+            self.getOrDefault("predictionCol"): preds,
+            self.getOrDefault("probabilityCol"): probs.astype(X.dtype),
+            self.getOrDefault("rawPredictionCol"): raw.astype(X.dtype),
+        }
+
+    def cpu(self):
+        """Pure-numpy predictor mirroring the fitted forest (the reference
+        converts treelite -> Spark model, utils.py:585-809; here the model
+        arrays themselves are the portable format)."""
+        return _NumpyForestPredictor(self, classification=True)
+
+
+class _NumpyForestPredictor:
+    """Host-side forest predictor over the portable model arrays."""
+
+    def __init__(self, model: _RandomForestModel, classification: bool) -> None:
+        self.feature = model.feature
+        self.threshold = model.threshold
+        self.leaf_stats = model.leaf_stats
+        self.max_depth = model.max_depth
+        self.classification = classification
+
+    def _leaves(self, X: np.ndarray) -> np.ndarray:
+        T, n = self.feature.shape[0], X.shape[0]
+        node = np.zeros((T, n), np.int64)
+        for _ in range(self.max_depth):
+            f = np.take_along_axis(self.feature, node, axis=1)
+            thr = np.take_along_axis(self.threshold, node, axis=1)
+            x = X[np.arange(n)[None, :], np.maximum(f, 0)]
+            child = 2 * node + 1 + (x > thr)
+            node = np.where(f < 0, node, child)
+        return node
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        leaves = self._leaves(np.asarray(X))
+        stats = np.take_along_axis(
+            self.leaf_stats, leaves[:, :, None], axis=1
+        )
+        if self.classification:
+            sums = np.maximum(stats.sum(axis=2, keepdims=True), 1e-12)
+            return np.argmax((stats / sums).sum(axis=0), axis=1)
+        w = np.maximum(stats[:, :, 0], 1e-12)
+        return (stats[:, :, 1] / w).mean(axis=0)
+
+    def predict_proba(self, X: np.ndarray) -> np.ndarray:
+        assert self.classification
+        leaves = self._leaves(np.asarray(X))
+        stats = np.take_along_axis(
+            self.leaf_stats, leaves[:, :, None], axis=1
+        )
+        sums = np.maximum(stats.sum(axis=2, keepdims=True), 1e-12)
+        probs = (stats / sums).sum(axis=0)
+        return probs / probs.sum(axis=1, keepdims=True)

@@ -1,5 +1,5 @@
 #
-# Regression: LinearRegression (+ RandomForestRegressor later) — the analog
+# Regression: LinearRegression + RandomForestRegressor — the analog
 # of reference regression.py (1148 LoC).  The three cuML distributed solvers
 # (LinearRegressionMG eig / RidgeMG / CDMG coordinate descent, dispatched at
 # regression.py:544-627) are replaced by ops/linear.py: one fused
@@ -298,3 +298,65 @@ class LinearRegressionModel(
         sk.intercept_ = float(self.intercept_)
         sk.n_features_in_ = self.n_cols
         return sk
+
+
+# ---------------------------------------------------------------------------
+# RandomForestRegressor (reference regression.py RandomForestRegressor +
+# tree.py shared layer)
+# ---------------------------------------------------------------------------
+
+
+from ..models.tree import (  # noqa: E402
+    _RandomForestEstimator,
+    _RandomForestModel,
+)
+
+
+class RandomForestRegressor(_RandomForestEstimator):
+    """Distributed random forest regressor on TPU (API parity: reference
+    RandomForestRegressor in regression.py:860-1000 + tree.py:314-528).
+    Variance-split histogram trees; ensemble parallelism over the mesh
+    (each device fits numTrees/num_workers trees on its local rows,
+    reference tree.py:330-341, docstring regression.py:895-899).
+
+    Examples
+    --------
+    >>> import numpy as np, pandas as pd
+    >>> from spark_rapids_ml_tpu.regression import RandomForestRegressor
+    >>> df = pd.DataFrame({"features": [[0.0], [0.1], [0.9], [1.0]],
+    ...                    "label": [0.0, 0.0, 10.0, 10.0]})
+    >>> rf = RandomForestRegressor(numTrees=5, seed=3, num_workers=1)
+    >>> model = rf.setFeaturesCol("features").setLabelCol("label").fit(df)
+    >>> [round(v, 1) for v in model.transform(df)["prediction"]]
+    [0.0, 0.0, 10.0, 10.0]
+    """
+
+    def _is_classification(self) -> bool:
+        return False
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "RandomForestRegressionModel":
+        return RandomForestRegressionModel(**attrs)
+
+    def _cpu_fit(self, batch: _ArrayBatch) -> "RandomForestRegressionModel":
+        raise NotImplementedError(
+            "RandomForestRegressor has no CPU fallback; unset unsupported params"
+        )
+
+
+class RandomForestRegressionModel(_RandomForestModel):
+    """Random forest regression model (reference
+    RandomForestRegressionModel in regression.py)."""
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        leaves = self._apply_trees(X)  # (T, n)
+        stats = np.take_along_axis(
+            self.leaf_stats, leaves[:, :, None], axis=1
+        )  # (T, n, 3): (weight, sum y, sum y^2)
+        w = np.maximum(stats[:, :, 0], 1e-12)
+        preds = (stats[:, :, 1] / w).mean(axis=0)
+        return {self.getOrDefault("predictionCol"): preds.astype(X.dtype)}
+
+    def cpu(self):
+        from .classification import _NumpyForestPredictor
+
+        return _NumpyForestPredictor(self, classification=False)

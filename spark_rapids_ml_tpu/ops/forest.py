@@ -1,0 +1,292 @@
+#
+# Random-forest kernels — the TPU-native replacement for the single-GPU
+# `cuml.RandomForestClassifier/Regressor` fits the reference dispatches per
+# worker (reference tree.py:383-447; ensemble parallelism: each worker fits
+# n_estimators/num_workers trees on its local rows, tree.py:330-341).
+#
+# There is no cuML to call into — this is a from-scratch histogram
+# (XGBoost-style binned) tree builder designed for XLA:
+#   - Quantile bin edges are computed per worker from the local shard (one
+#     sort per feature); rows are digitized once into int32 bin ids.
+#   - Trees grow LEVEL-WISE over a heap layout (node i -> children 2i+1,
+#     2i+2), so every level is a fixed-shape batch of nodes: one scatter-add
+#     builds the (stats, nodes, features, bins) histogram, cumulative sums
+#     over bins give every candidate split's left/right statistics, and an
+#     argmax picks the best (feature, bin) per node.  No recursion, no
+#     dynamic shapes, no host round-trips.
+#   - Per-node feature subsets (featureSubsetStrategy) use the Gumbel
+#     top-K trick; bootstrap resampling uses Poisson(rate) weights (the
+#     standard large-n approximation of multinomial bootstrap, also used
+#     by cuML's GPU forest).
+#   - A whole device's worth of trees builds under one vmap; across the
+#     mesh, trees are embarrassingly parallel (shard_map with no
+#     collectives — the analog of reference tree.py's barrier-allGather-
+#     only pattern).
+#
+# Samples that reach a node that does not split simply keep that node id;
+# deeper levels ignore them (their id falls outside the active range), and
+# the final leaf-statistics scatter reads each sample's resting node.
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+GINI, ENTROPY, VARIANCE = 0, 1, 2  # split criteria
+
+
+def compute_bin_edges(X: jax.Array, n_bins: int) -> jax.Array:
+    """(n_bins-1, d) interior quantile boundaries from the local rows."""
+    m, d = X.shape
+    Xs = jnp.sort(X, axis=0)
+    # edge j at quantile (j+1)/n_bins
+    qidx = jnp.clip(
+        ((jnp.arange(1, n_bins) * m) // n_bins).astype(jnp.int32), 0, m - 1
+    )
+    return Xs[qidx, :]  # (n_bins-1, d)
+
+
+def digitize(X: jax.Array, edges: jax.Array) -> jax.Array:
+    """Bin ids in [0, n_bins): number of interior edges strictly below x."""
+    # (m, d) vs (B-1, d) -> count over edges
+    return (X[:, None, :] > edges[None, :, :]).sum(axis=1).astype(jnp.int32)
+
+
+def _impurity(stats: jax.Array, criterion: int) -> jax.Array:
+    """Node impurity from per-channel statistics.
+
+    Classification (gini/entropy): stats[..., :C] are class counts.
+    Regression (variance): stats[..., 0:3] = (weight, sum y, sum y^2).
+    Returns (impurity, total_count) with impurity 0 for empty nodes.
+    """
+    if criterion == VARIANCE:
+        n = stats[..., 0]
+        safe_n = jnp.maximum(n, 1e-12)
+        mean = stats[..., 1] / safe_n
+        var = jnp.maximum(stats[..., 2] / safe_n - mean * mean, 0.0)
+        return jnp.where(n > 0, var, 0.0), n
+    n = stats.sum(axis=-1)
+    safe_n = jnp.maximum(n, 1e-12)
+    p = stats / safe_n[..., None]
+    if criterion == GINI:
+        imp = 1.0 - (p * p).sum(axis=-1)
+    else:  # entropy (Spark uses log2? MLlib uses natural log; sklearn ln)
+        imp = -(jnp.where(p > 0, p * jnp.log(p), 0.0)).sum(axis=-1)
+    return jnp.where(n > 0, imp, 0.0), n
+
+
+class TreeArrays(NamedTuple):
+    feature: jax.Array  # (T, max_nodes) int32 split feature, -1 = leaf
+    threshold: jax.Array  # (T, max_nodes) f32 raw-value threshold (go left if <=)
+    leaf_stats: jax.Array  # (T, max_nodes, S) per-leaf statistics
+    gain: jax.Array  # (T, max_nodes) impurity decrease of each split (0 = leaf)
+    count: jax.Array  # (T, max_nodes) weighted sample count reaching the node
+
+
+def _grow_one_tree(
+    key,
+    Xb: jax.Array,  # (m, d) int32 bin ids
+    edges: jax.Array,  # (B-1, d) raw edge values
+    stats: jax.Array,  # (m, S) per-sample statistic channels (pre-weighted)
+    valid: jax.Array,  # (m,) row validity * user weight
+    max_depth: int,
+    n_bins: int,
+    criterion: int,
+    max_features: int,  # features considered per node (Gumbel top-K)
+    min_instances: float,
+    min_info_gain: float,
+    bootstrap: bool,
+    subsample: float,
+):
+    m, d = Xb.shape
+    S = stats.shape[1]
+    max_nodes = 2 ** (max_depth + 1) - 1
+
+    kb, kf = jax.random.split(key)
+    # pcast marks the rate as device-varying to match the varying key inside
+    # jax.random's internal control flow under shard_map
+    rate = jax.lax.pcast(
+        jnp.asarray(subsample, jnp.float32), (DATA_AXIS,), to="varying"
+    )
+    if bootstrap:
+        w = jax.random.poisson(kb, rate, (m,)).astype(stats.dtype)
+    elif subsample < 1.0:
+        w = jax.random.bernoulli(kb, rate, (m,)).astype(stats.dtype)
+    else:
+        w = jnp.ones((m,), stats.dtype)
+    w = w * valid
+    wstats = stats * w[:, None]  # (m, S)
+
+    feature = jnp.full((max_nodes,), -1, jnp.int32)
+    threshold = jnp.zeros((max_nodes,), edges.dtype)
+    gain_arr = jnp.zeros((max_nodes,), stats.dtype)
+    count_arr = jnp.zeros((max_nodes,), stats.dtype)
+    node = jnp.zeros((m,), jnp.int32)
+
+    for level in range(max_depth):
+        start, n_l = 2**level - 1, 2**level
+        active = (node >= start) & (node < start + n_l) & (w > 0)
+        node_rel = jnp.where(active, node - start, 0)
+
+        # histogram: (n_l * B, d, S) via one batched scatter-add
+        idx = node_rel[:, None] * n_bins + Xb  # (m, d)
+        upd = jnp.where(active[:, None, None], wstats[:, None, :], 0.0)
+        upd = jnp.broadcast_to(upd, (m, d, S))
+        hist = jnp.zeros((n_l * n_bins, d, S), stats.dtype)
+        hist = hist.at[idx, jnp.arange(d)[None, :], :].add(upd)
+        hist = hist.reshape(n_l, n_bins, d, S).transpose(0, 2, 1, 3)
+        # (n_l, d, B, S)
+
+        cum = jnp.cumsum(hist, axis=2)
+        total = cum[:, :, -1, :]  # (n_l, d, S) same for every feature
+        left = cum[:, :, : n_bins - 1, :]  # (n_l, d, B-1, S)
+        right = total[:, :, None, :] - left
+
+        imp_parent, n_parent = _impurity(total[:, 0, :], criterion)  # (n_l,)
+        imp_l, n_left = _impurity(left, criterion)  # (n_l, d, B-1)
+        imp_r, n_right = _impurity(right, criterion)
+        safe_np = jnp.maximum(n_parent, 1e-12)[:, None, None]
+        gain = (
+            imp_parent[:, None, None]
+            - (n_left * imp_l + n_right * imp_r) / safe_np
+        )
+        ok = (n_left >= min_instances) & (n_right >= min_instances)
+        gain = jnp.where(ok, gain, -jnp.inf)
+
+        if max_features < d:
+            # per-node feature subset: Gumbel top-K mask over features
+            g = jax.random.gumbel(
+                jax.random.fold_in(kf, level), (n_l, d), stats.dtype
+            )
+            kth = jnp.sort(g, axis=1)[:, d - max_features]
+            fmask = g >= kth[:, None]  # exactly K True per node
+            gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
+
+        flat = gain.reshape(n_l, -1)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // (n_bins - 1)).astype(jnp.int32)  # (n_l,)
+        bb = (best % (n_bins - 1)).astype(jnp.int32)
+        can_split = jnp.isfinite(best_gain) & (best_gain > min_info_gain)
+
+        heap_ids = start + jnp.arange(n_l)
+        feature = feature.at[heap_ids].set(jnp.where(can_split, bf, -1))
+        threshold = threshold.at[heap_ids].set(
+            jnp.where(can_split, edges[bb, bf], 0.0)
+        )
+        gain_arr = gain_arr.at[heap_ids].set(
+            jnp.where(can_split, best_gain, 0.0)
+        )
+        count_arr = count_arr.at[heap_ids].set(n_parent)
+
+        # route samples: left child if bin id <= split bin
+        samp_f = bf[node_rel]
+        samp_b = bb[node_rel]
+        go_left = (
+            jnp.take_along_axis(Xb, samp_f[:, None], axis=1)[:, 0] <= samp_b
+        )
+        child = 2 * node + 1 + jnp.where(go_left, 0, 1)
+        node = jnp.where(active & can_split[node_rel], child, node)
+
+    leaf_stats = jnp.zeros((max_nodes, S), stats.dtype).at[node].add(wstats)
+    return TreeArrays(feature, threshold, leaf_stats, gain_arr, count_arr)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "trees_per_worker", "max_depth", "n_bins", "criterion", "n_classes",
+        "max_features", "bootstrap", "subsample", "mesh",
+    ),
+)
+def forest_fit(
+    X: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
+    y: jax.Array,  # (N_pad,) labels, sharded
+    valid: jax.Array,  # (N_pad,) validity * sample weight, sharded
+    seed,
+    trees_per_worker: int,
+    max_depth: int,
+    n_bins: int,
+    criterion: int,
+    n_classes: int,  # 0 for regression
+    max_features: int,
+    min_instances: float,
+    min_info_gain: float,
+    bootstrap: bool,
+    subsample: float,
+    mesh=None,
+):
+    """Fit the whole forest: each device grows `trees_per_worker` trees on
+    its local rows (reference `_estimators_per_worker` tree.py:330-341).
+    Returns TreeArrays with a leading (trees_per_worker * n_devices) axis."""
+
+    def kernel(Xl, yl, validl):
+        # histogram statistic channels, built on device (no host staging):
+        # classification -> one-hot class counts; regression -> moments
+        if criterion == VARIANCE:
+            yf = yl.astype(Xl.dtype)
+            statsl = jnp.stack([jnp.ones_like(yf), yf, yf * yf], axis=1)
+        else:
+            statsl = (
+                yl.astype(jnp.int32)[:, None] == jnp.arange(n_classes)[None, :]
+            ).astype(Xl.dtype)
+        edges = compute_bin_edges(Xl, n_bins)
+        Xb = digitize(Xl, edges)
+        widx = jax.lax.axis_index(DATA_AXIS)
+        base = jax.random.fold_in(jax.random.PRNGKey(seed), widx)
+        keys = jax.random.split(base, trees_per_worker)
+        grow = partial(
+            _grow_one_tree,
+            Xb=Xb,
+            edges=edges,
+            stats=statsl,
+            valid=validl,
+            max_depth=max_depth,
+            n_bins=n_bins,
+            criterion=criterion,
+            max_features=max_features,
+            min_instances=min_instances,
+            min_info_gain=min_info_gain,
+            bootstrap=bootstrap,
+            subsample=subsample,
+        )
+        return jax.vmap(lambda k: grow(k))(keys)
+
+    shard = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=TreeArrays(*([P(DATA_AXIS)] * 5)),
+    )
+    return shard(X, y, valid)
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def forest_apply(
+    X: jax.Array,  # (n, d) query rows
+    feature: jax.Array,  # (T, max_nodes)
+    threshold: jax.Array,  # (T, max_nodes)
+    max_depth: int,
+) -> jax.Array:
+    """Leaf heap index per (tree, row): vectorized heap traversal —
+    `max_depth` rounds of gather + select, all trees at once."""
+
+    def one_tree(feat, thr):
+        node = jnp.zeros((X.shape[0],), jnp.int32)
+        for _ in range(max_depth):
+            f = feat[node]  # (n,)
+            is_leaf = f < 0
+            x = jnp.take_along_axis(
+                X, jnp.maximum(f, 0)[:, None], axis=1
+            )[:, 0]
+            child = 2 * node + 1 + jnp.where(x <= thr[node], 0, 1)
+            node = jnp.where(is_leaf, node, child)
+        return node
+
+    return jax.vmap(one_tree)(feature, threshold)  # (T, n)
