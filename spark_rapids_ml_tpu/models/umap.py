@@ -1,2 +1,396 @@
-# Implemented progressively; see models/feature.py for the pattern.
-__all__: list = []
+#
+# UMAP — the analog of reference umap.py (1730 LoC).  The single-GPU
+# `cuml.manifold.UMAP` fit (umap.py:1016-1063) becomes ops/umap.py jit
+# kernels; the reference's fit strategy is kept exactly: fit on ONE worker
+# (optionally on a sample_fraction of rows, umap.py:926-948), then the
+# model (embedding + raw data) serves a distributed transform
+# (umap.py:1407-1450 broadcasts the model; here the query kNN against the
+# raw data runs on the sharded mesh via the ops/knn.py ring kernel).
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from ..core import FitInput, _TpuEstimator, _TpuModel
+from ..data import DatasetLike, _ensure_dense
+from ..params import (
+    HasFeaturesCol,
+    HasFeaturesCols,
+    HasLabelCol,
+    HasOutputCol,
+    Param,
+    TypeConverters,
+    _TpuParams,
+)
+
+
+class UMAPClass:
+    """Param surface (reference UMAPClass umap.py:110-143: cuML-native
+    names — there is no Spark UMAP, identity mapping)."""
+
+    @classmethod
+    def _param_mapping(cls) -> Dict[str, Optional[str]]:
+        return {
+            n: n
+            for n in (
+                "n_neighbors", "n_components", "metric", "n_epochs",
+                "learning_rate", "init", "min_dist", "spread",
+                "set_op_mix_ratio", "local_connectivity",
+                "repulsion_strength", "negative_sample_rate", "a", "b",
+                "random_state", "sample_fraction",
+            )
+        }
+
+    @classmethod
+    def _param_value_mapping(cls):
+        return {
+            "metric": lambda x: x if x in ("euclidean", "l2", "cosine") else None,
+            "init": lambda x: x if x in ("spectral", "random") else None,
+        }
+
+    @classmethod
+    def _get_tpu_params_default(cls) -> Dict[str, Any]:
+        return {
+            "n_neighbors": 15,
+            "n_components": 2,
+            "metric": "euclidean",
+            "n_epochs": None,
+            "learning_rate": 1.0,
+            "init": "spectral",
+            "min_dist": 0.1,
+            "spread": 1.0,
+            "set_op_mix_ratio": 1.0,
+            "local_connectivity": 1.0,
+            "repulsion_strength": 1.0,
+            "negative_sample_rate": 5,
+            "transform_queue_size": 4.0,
+            "a": None,
+            "b": None,
+            "precomputed_knn": None,
+            "random_state": None,
+            "sample_fraction": 1.0,
+            "verbose": False,
+        }
+
+
+class _UMAPParams(
+    _TpuParams, HasFeaturesCol, HasFeaturesCols, HasLabelCol, HasOutputCol
+):
+    n_neighbors = Param("_", "n_neighbors", "Neighborhood size.",
+                        TypeConverters.toFloat)
+    n_components = Param("_", "n_components", "Embedding dimension.",
+                         TypeConverters.toInt)
+    metric = Param("_", "metric", "Distance metric.", TypeConverters.toString)
+    n_epochs = Param("_", "n_epochs", "Training epochs (None = auto).",
+                     TypeConverters.identity)
+    learning_rate = Param("_", "learning_rate", "Initial learning rate.",
+                          TypeConverters.toFloat)
+    init = Param("_", "init", "Embedding init: spectral or random.",
+                 TypeConverters.toString)
+    min_dist = Param("_", "min_dist", "Minimum embedded distance.",
+                     TypeConverters.toFloat)
+    spread = Param("_", "spread", "Embedded scale.", TypeConverters.toFloat)
+    set_op_mix_ratio = Param("_", "set_op_mix_ratio",
+                             "Fuzzy union/intersection mix in [0,1].",
+                             TypeConverters.toFloat)
+    local_connectivity = Param("_", "local_connectivity",
+                               "Assumed local connectivity.",
+                               TypeConverters.toFloat)
+    repulsion_strength = Param("_", "repulsion_strength",
+                               "Negative-sample weighting.",
+                               TypeConverters.toFloat)
+    negative_sample_rate = Param("_", "negative_sample_rate",
+                                 "Negative samples per positive edge.",
+                                 TypeConverters.toInt)
+    sample_fraction = Param("_", "sample_fraction",
+                            "Fraction of rows used for the one-worker fit "
+                            "(reference umap.py:926-948).",
+                            TypeConverters.toFloat)
+    random_state = Param("_", "random_state", "Random seed.",
+                         TypeConverters.identity)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._setDefault(
+            n_neighbors=15.0,
+            n_components=2,
+            metric="euclidean",
+            n_epochs=None,
+            learning_rate=1.0,
+            init="spectral",
+            min_dist=0.1,
+            spread=1.0,
+            set_op_mix_ratio=1.0,
+            local_connectivity=1.0,
+            repulsion_strength=1.0,
+            negative_sample_rate=5,
+            sample_fraction=1.0,
+            random_state=None,
+            outputCol="embedding",
+        )
+
+    def setFeaturesCol(self, value: Union[str, List[str]]):
+        if isinstance(value, str):
+            self._set_params(featuresCol=value)
+        else:
+            self._set_params(featuresCols=value)
+        return self
+
+    def setFeaturesCols(self, value: List[str]):
+        return self._set_params(featuresCols=value)
+
+    def setLabelCol(self, value: str):
+        self._set(labelCol=value)
+        return self
+
+    def setOutputCol(self, value: str):
+        self._set(outputCol=value)
+        return self
+
+
+class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
+    """Uniform Manifold Approximation and Projection on TPU (API parity:
+    reference UMAP umap.py:681-1348).
+
+    Fit runs on one worker like the reference (umap.py:926-948), as three
+    jit kernels: exact kNN graph (ops/knn.py), fuzzy simplicial set with
+    smooth-knn bisection, and the umap-learn SGD recast as one compiled
+    epoch loop over all edges (ops/umap.py).  `init="spectral"` uses a
+    scaled PCA basis (the practical stand-in for graph-spectral init; cuML
+    defaults to spectral, umap.py:120).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from spark_rapids_ml_tpu.umap import UMAP
+    >>> X = np.random.default_rng(0).normal(size=(200, 8)).astype("float32")
+    >>> m = UMAP(n_neighbors=10, random_state=1, n_epochs=50).fit(X)
+    >>> m.embedding_.shape
+    (200, 2)
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._set_params(**kwargs)
+
+    def _fit(self, dataset: DatasetLike) -> "UMAPModel":
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import umap as umap_ops
+        from ..ops.knn import knn_topk_local
+
+        t0 = time.time()
+        batch = self._extract(dataset)
+        X = _ensure_dense(batch.X)
+        dtype = self._out_dtype(X)
+        X = np.ascontiguousarray(X, dtype=dtype)
+        p = self._tpu_params
+        rs = p.get("random_state")
+        seed = int(rs) if rs is not None else 42
+
+        frac = float(p.get("sample_fraction", 1.0))
+        if frac < 1.0:
+            rng = np.random.default_rng(seed)
+            X_fit = X[rng.random(X.shape[0]) < frac]
+        else:
+            X_fit = X
+        n, d = X_fit.shape
+        k = int(float(p["n_neighbors"]))
+        if k >= n:
+            raise ValueError(f"n_neighbors={k} must be < n_samples={n}")
+
+        metric = str(p.get("metric", "euclidean"))
+        X_graph = X_fit
+        if metric == "cosine":
+            X_graph = X_fit / np.maximum(
+                np.linalg.norm(X_fit, axis=1, keepdims=True), 1e-12
+            )
+
+        # 1. exact kNN graph on one device (self excluded)
+        Xd = jnp.asarray(X_graph)
+        ones = jnp.ones((n,), Xd.dtype)
+        ids = jnp.arange(n, dtype=jnp.int32)
+        d2, inds = knn_topk_local(Xd, ones, ids, Xd, k=k + 1)
+        knn_d = jnp.sqrt(jnp.maximum(d2[:, 1:], 0.0))
+        knn_i = inds[:, 1:]
+
+        # 2. fuzzy simplicial set
+        lc = max(1, int(float(p["local_connectivity"])))
+        rho, sigma = umap_ops.smooth_knn_dist(knn_d, local_connectivity=lc)
+        heads, tails, weights = umap_ops.fuzzy_simplicial_set(
+            knn_i, knn_d, rho, sigma,
+            set_op_mix_ratio=float(p["set_op_mix_ratio"]),
+        )
+
+        # 3. a/b curve parameters (host scipy, once)
+        a, b = p.get("a"), p.get("b")
+        if a is None or b is None:
+            a, b = umap_ops.find_ab_params(
+                float(p["spread"]), float(p["min_dist"])
+            )
+
+        # 4. init
+        dim = int(p["n_components"])
+        rng = np.random.default_rng(seed)
+        if str(p["init"]) == "random":
+            emb0 = rng.uniform(-10.0, 10.0, (n, dim)).astype(dtype)
+        else:  # "spectral" -> scaled PCA basis + jitter
+            Xc = X_fit - X_fit.mean(axis=0)
+            _, _, vt = np.linalg.svd(Xc, full_matrices=False)
+            pc = Xc @ vt[: min(dim, d)].T
+            pc = pc / max(np.abs(pc).max(), 1e-12) * 10.0
+            if dim > pc.shape[1]:  # fewer features than components: pad
+                pad = rng.uniform(-10.0, 10.0, (n, dim - pc.shape[1]))
+                pc = np.concatenate([pc, pad], axis=1)
+            emb0 = (pc + rng.normal(scale=1e-4, size=pc.shape)).astype(dtype)
+
+        # 5. SGD epochs (umap-learn auto rule; explicit 0 = init only)
+        n_epochs = p.get("n_epochs")
+        n_epochs = (
+            int(n_epochs) if n_epochs is not None
+            else (500 if n <= 10000 else 200)
+        )
+        if n_epochs > 0:
+            emb = umap_ops.optimize_embedding(
+                jnp.asarray(emb0),
+                heads,
+                tails,
+                weights,
+                seed,
+                n_epochs=n_epochs,
+                a=a,
+                b=b,
+                initial_alpha=float(p["learning_rate"]),
+                negative_sample_rate=int(p["negative_sample_rate"]),
+                repulsion_strength=float(p["repulsion_strength"]),
+            )
+        else:
+            emb = jnp.asarray(emb0)
+        rho_h, sigma_h, emb_h = jax.device_get((rho, sigma, emb))
+
+        model = UMAPModel(
+            embedding_=np.asarray(emb_h),
+            raw_data_=X_fit,
+            rho_=np.asarray(rho_h),
+            sigma_=np.asarray(sigma_h),
+            a_=float(a),
+            b_=float(b),
+            n_cols=d,
+            dtype=str(np.dtype(dtype).name),
+        )
+        self._copyValues(model)
+        model._tpu_params = dict(self._tpu_params)
+        model._num_workers = self._num_workers
+        model._float32_inputs = self._float32_inputs
+        self.logger.info(f"Finished UMAP fit in {time.time() - t0:.3f}s")
+        return model
+
+    def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:  # pragma: no cover
+        raise NotImplementedError("fit is overridden (single-worker strategy)")
+
+    def _create_model(self, attrs: Dict[str, Any]) -> "UMAPModel":  # pragma: no cover
+        return UMAPModel(**attrs)
+
+
+class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
+    """Fitted UMAP model (reference UMAPModel umap.py:1349-1729): holds the
+    embedding AND the raw training data (needed to embed new points);
+    transform shards query rows over the mesh for the kNN against the raw
+    data, then initializes each query point at the membership-weighted
+    average of its neighbors' embeddings (umap-learn transform init)."""
+
+    def __init__(self, **attrs: Any) -> None:
+        super().__init__(**attrs)
+        self.embedding_: np.ndarray = np.asarray(attrs["embedding_"])
+        self.raw_data_: np.ndarray = np.asarray(attrs["raw_data_"])
+        self.rho_: np.ndarray = np.asarray(attrs["rho_"])
+        self.sigma_: np.ndarray = np.asarray(attrs["sigma_"])
+        self.a_: float = float(attrs["a_"])
+        self.b_: float = float(attrs["b_"])
+        self.n_cols: int = int(attrs["n_cols"])
+        self.dtype: str = str(attrs.get("dtype", "float32"))
+
+    @property
+    def embedding(self) -> np.ndarray:
+        """pyspark-style accessor (reference umap.py:1380-1392)."""
+        return self.embedding_
+
+    @property
+    def rawData(self) -> np.ndarray:
+        return self.raw_data_
+
+    def _output_columns(self) -> List[str]:
+        return [self.getOrDefault("outputCol")]
+
+    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..ops.knn import knn_ring_topk, knn_topk_local
+        from ..ops.umap import transform_init
+        from ..parallel import TpuContext
+        from ..parallel.mesh import DATA_AXIS, shard_rows
+
+        k = int(float(self._tpu_params["n_neighbors"]))
+        Xq = np.ascontiguousarray(X, dtype=self._out_dtype(X))
+        items = self.raw_data_
+        if str(self._tpu_params.get("metric", "euclidean")) == "cosine":
+            items = items / np.maximum(
+                np.linalg.norm(items, axis=1, keepdims=True), 1e-12
+            )
+            Xq = Xq / np.maximum(np.linalg.norm(Xq, axis=1, keepdims=True), 1e-12)
+
+        with TpuContext(self.num_workers, require_p2p=True) as ctx:
+            mesh = ctx.mesh
+        dtype = Xq.dtype
+        Xi, n_items = shard_rows(items, mesh, dtype=dtype)
+        n_pad = Xi.shape[0]
+        valid = np.zeros((n_pad,), dtype)
+        valid[:n_items] = 1.0
+        ids = np.full((n_pad,), -1, np.int32)
+        ids[:n_items] = np.arange(n_items, dtype=np.int32)
+        spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+        validd = jax.device_put(valid, spec)
+        idsd = jax.device_put(ids, spec)
+        Qs, n_q = shard_rows(Xq, mesh, dtype=dtype)
+        if mesh.devices.size == 1:
+            d2, inds = knn_topk_local(Xi, validd, idsd, Qs, k=k)
+        else:
+            d2, inds = knn_ring_topk(Xi, validd, idsd, Qs, k=k, mesh=mesh)
+        knn_d = jnp.sqrt(jnp.maximum(d2, 0.0))
+        emb = transform_init(
+            inds,
+            knn_d,
+            jnp.asarray(self.rho_.astype(dtype)),
+            jnp.asarray(self.sigma_.astype(dtype)),
+            jnp.asarray(self.embedding_.astype(dtype)),
+        )
+        emb = np.asarray(jax.device_get(emb))[:n_q]
+        return {self.getOrDefault("outputCol"): emb}
+
+    def _get_model_attributes(self) -> Dict[str, Any]:
+        return {
+            "embedding_": self.embedding_,
+            "raw_data_": self.raw_data_,
+            "rho_": self.rho_,
+            "sigma_": self.sigma_,
+            "a_": self.a_,
+            "b_": self.b_,
+            "n_cols": self.n_cols,
+            "dtype": self.dtype,
+        }
+
+    def cpu(self):
+        raise NotImplementedError(
+            "umap-learn is not bundled; the model arrays (embedding_, "
+            "raw_data_) are directly consumable"
+        )
+
+
+__all__ = ["UMAP", "UMAPModel"]

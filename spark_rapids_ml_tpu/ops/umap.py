@@ -1,0 +1,203 @@
+#
+# UMAP kernels — the TPU-native replacement for `cuml.manifold.UMAP`
+# fit/transform (called from reference umap.py:1016-1063, 1452-1529).
+#
+# The reference fits UMAP on ONE worker (optionally on a sample_fraction,
+# umap.py:926-948) and distributes only the transform; the same strategy is
+# kept here, so the fit kernels are single-device jit programs:
+#
+#   - Fuzzy simplicial set: rho/sigma per point via a vectorized bisection
+#     (umap-learn's smooth_knn_dist), membership strengths, symmetrization
+#     with set_op_mix_ratio.
+#   - Embedding optimizer: umap-learn's SGD recast for XLA — every epoch
+#     processes ALL edges at once.  Edge activity follows the
+#     epochs_per_sample schedule (floor-crossing test, identical in
+#     expectation to umap-learn's per-edge countdown), attractive and
+#     repulsive (negative-sampled) gradients are one gather + segment
+#     scatter-add each, and the whole n_epochs loop is a lax.fori_loop in
+#     one compiled program.  Gradient clipping (+-4) matches umap-learn.
+#
+# find_ab_params is the standard least-squares fit of 1/(1+a d^{2b}) to the
+# min_dist/spread membership curve (host-side scipy, once per fit) — the
+# analog of cuml.manifold.umap.find_ab_params (reference umap.py:1452-1456).
+#
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def find_ab_params(spread: float, min_dist: float) -> Tuple[float, float]:
+    from scipy.optimize import curve_fit
+
+    def curve(x, a, b):
+        return 1.0 / (1.0 + a * x ** (2 * b))
+
+    xv = np.linspace(0, spread * 3, 300)
+    yv = np.zeros(xv.shape)
+    yv[xv < min_dist] = 1.0
+    mask = xv >= min_dist
+    yv[mask] = np.exp(-(xv[mask] - min_dist) / spread)
+    params, _ = curve_fit(curve, xv, yv)
+    return float(params[0]), float(params[1])
+
+
+@partial(jax.jit, static_argnames=("local_connectivity",))
+def smooth_knn_dist(
+    knn_dists: jax.Array,  # (n, k) sorted ascending, self excluded
+    local_connectivity: int = 1,
+    n_iter: int = 64,
+):
+    """Per-point (rho, sigma): rho = distance to the local_connectivity-th
+    neighbor; sigma solves sum_j exp(-(d_j - rho)/sigma) = log2(k)."""
+    n, k = knn_dists.shape
+    rho = knn_dists[:, local_connectivity - 1]
+    target = jnp.log2(k)
+
+    def psum(sigma):
+        d = jnp.maximum(knn_dists - rho[:, None], 0.0)
+        return jnp.exp(-d / sigma[:, None]).sum(axis=1)
+
+    def body(_, carry):
+        lo, hi, mid = carry
+        val = psum(mid)
+        hi = jnp.where(val > target, mid, hi)
+        lo = jnp.where(val > target, lo, mid)
+        mid = (lo + hi) / 2.0
+        return lo, hi, mid
+
+    lo = jnp.full((n,), 1e-10, knn_dists.dtype)
+    hi = jnp.full((n,), 1e4, knn_dists.dtype)
+    mid = jnp.ones((n,), knn_dists.dtype)
+    _, _, sigma = jax.lax.fori_loop(0, n_iter, body, (lo, hi, mid))
+    # umap-learn floors sigma at a fraction of the mean neighbor distance
+    mean_d = jnp.maximum(knn_dists.mean(), 1e-10)
+    sigma = jnp.maximum(sigma, 1e-3 * mean_d)
+    return rho, sigma
+
+
+@partial(jax.jit, static_argnames=("set_op_mix_ratio",))
+def fuzzy_simplicial_set(
+    knn_inds: jax.Array,  # (n, k) neighbor row indices
+    knn_dists: jax.Array,  # (n, k)
+    rho: jax.Array,
+    sigma: jax.Array,
+    set_op_mix_ratio: float = 1.0,
+):
+    """Directed membership strengths + symmetrization.  Returns the dense
+    edge list of the symmetric graph as (heads (n*k,), tails (n*k,),
+    weights (n*k,)) — each directed edge (i -> knn[i,j]) carries the
+    symmetrized weight w_ij = mix*(a+b-ab) + (1-mix)*ab where a = w(i->j),
+    b = w(j->i)."""
+    n, k = knn_inds.shape
+    w = jnp.exp(-jnp.maximum(knn_dists - rho[:, None], 0.0) / sigma[:, None])
+    # build dense (n, n) would blow memory; instead compute w(j->i) by
+    # scatter into a (n, n)-free lookup: for each directed edge (i, j)
+    # find the reverse weight by scanning j's neighbor list for i.
+    heads = jnp.repeat(jnp.arange(n, dtype=knn_inds.dtype), k)
+    tails = knn_inds.reshape(-1)
+    w_fwd = w.reshape(-1)
+    # reverse lookup: does j list i among its neighbors, with what weight
+    j_neighbors = knn_inds[tails]  # (n*k, k)
+    j_weights = w[tails]  # (n*k, k)
+    match = j_neighbors == heads[:, None]
+    w_rev = jnp.where(match, j_weights, 0.0).max(axis=1)
+    sym = (
+        set_op_mix_ratio * (w_fwd + w_rev - w_fwd * w_rev)
+        + (1.0 - set_op_mix_ratio) * (w_fwd * w_rev)
+    )
+    return heads, tails, sym
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_epochs", "negative_sample_rate"),
+)
+def optimize_embedding(
+    emb0: jax.Array,  # (n, dim) initial embedding
+    heads: jax.Array,  # (E,) int
+    tails: jax.Array,  # (E,) int
+    weights: jax.Array,  # (E,)
+    seed,
+    n_epochs: int,
+    a,
+    b,
+    initial_alpha,
+    negative_sample_rate: int = 5,
+    repulsion_strength: float = 1.0,
+):
+    """umap-learn SGD, one compiled fori_loop over epochs; all edges are
+    processed per epoch with the epochs_per_sample activity schedule."""
+    n, dim = emb0.shape
+    E = heads.shape[0]
+    key = jax.random.PRNGKey(seed)
+    a = jnp.asarray(a, emb0.dtype)
+    b = jnp.asarray(b, emb0.dtype)
+    # umap-learn: edges with weight < max/n_epochs are never sampled
+    wmax = jnp.maximum(weights.max(), 1e-12)
+    freq = weights / wmax  # samples-per-epoch fraction in (0, 1]
+    freq = jnp.where(weights >= wmax / n_epochs, freq, 0.0)
+
+    def epoch(e, carry):
+        emb, key = carry
+        ef = e.astype(emb.dtype)
+        alpha = initial_alpha * (1.0 - ef / n_epochs)
+        # floor-crossing schedule == umap-learn's epochs_per_sample countdown
+        active = jnp.floor((ef + 1.0) * freq) > jnp.floor(ef * freq)
+        act = active.astype(emb.dtype)
+
+        h = emb[heads]  # (E, dim)
+        t = emb[tails]
+        diff = h - t
+        d2 = (diff * diff).sum(axis=1)
+        # attractive gradient coefficient: -2ab d^{2(b-1)} / (1 + a d^{2b})
+        grad_coeff = (-2.0 * a * b * d2 ** (b - 1.0)) / (1.0 + a * d2**b)
+        grad_coeff = jnp.where(d2 > 0.0, grad_coeff, 0.0)
+        g = jnp.clip(grad_coeff[:, None] * diff, -4.0, 4.0) * act[:, None]
+        emb = emb.at[heads].add(alpha * g)
+        emb = emb.at[tails].add(-alpha * g)
+
+        # negative samples: for each active edge, nsr random points repel
+        key, sub = jax.random.split(key)
+        neg = jax.random.randint(sub, (E, negative_sample_rate), 0, n)
+        h2 = emb[heads]  # re-gather after attract update
+        nt = emb[neg]  # (E, nsr, dim)
+        diff_n = h2[:, None, :] - nt
+        d2n = (diff_n * diff_n).sum(axis=2)
+        rep = (2.0 * repulsion_strength * b) / (
+            (0.001 + d2n) * (1.0 + a * d2n**b)
+        )
+        gn = jnp.clip(rep[:, :, None] * diff_n, -4.0, 4.0)
+        # coincident-but-distinct points get the max push; a self-collision
+        # (neg == head) is skipped like umap-learn's `j == k: continue`
+        gn = jnp.where(d2n[:, :, None] > 0.0, gn, 4.0)
+        gn = jnp.where((neg == heads[:, None])[:, :, None], 0.0, gn)
+        gn = gn * act[:, None, None]
+        emb = emb.at[heads].add(alpha * gn.sum(axis=1))
+        return emb, key
+
+    emb, _ = jax.lax.fori_loop(0, n_epochs, epoch, (emb0, key))
+    return emb
+
+
+@jax.jit
+def transform_init(
+    knn_inds: jax.Array,  # (q, k) neighbor indices into training rows
+    knn_dists: jax.Array,  # (q, k)
+    rho: jax.Array,  # (n,) training rho
+    sigma: jax.Array,  # (n,) training sigma
+    train_emb: jax.Array,  # (n, dim)
+):
+    """New-point embedding init: membership-weighted average of the
+    training neighbors' embeddings (umap-learn transform init)."""
+    q, k = knn_inds.shape
+    # memberships computed with each NEIGHBOR's smooth-knn parameters
+    rho_n = rho[knn_inds]
+    sigma_n = sigma[knn_inds]
+    w = jnp.exp(-jnp.maximum(knn_dists - rho_n, 0.0) / sigma_n)
+    w = w / jnp.maximum(w.sum(axis=1, keepdims=True), 1e-12)
+    return jnp.einsum("qk,qkd->qd", w, train_emb[knn_inds])

@@ -1,0 +1,113 @@
+#
+# UMAP tests — the analog of reference tests/test_umap.py, which scores
+# embeddings with sklearn trustworthiness rather than exact equality
+# (stochastic optimizer).
+#
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.datasets import make_blobs
+from sklearn.manifold import trustworthiness
+
+from spark_rapids_ml_tpu.umap import UMAP, UMAPModel
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    X, y = make_blobs(
+        n_samples=400, n_features=10, centers=5, cluster_std=0.8,
+        random_state=10,
+    )
+    return X.astype(np.float32), y
+
+
+def test_fit_embedding_trustworthy(blobs):
+    X, _ = blobs
+    model = UMAP(n_neighbors=12, random_state=0, n_epochs=150).fit(X)
+    assert model.embedding_.shape == (400, 2)
+    t = trustworthiness(X, model.embedding_, n_neighbors=12)
+    assert t > 0.85, f"trustworthiness {t}"
+
+
+def test_blob_separation(blobs):
+    # well-separated blobs should stay separated in the embedding
+    X, y = blobs
+    model = UMAP(n_neighbors=10, random_state=0, n_epochs=200).fit(X)
+    emb = model.embedding_
+    centroids = np.stack([emb[y == c].mean(axis=0) for c in range(5)])
+    spread = np.stack([emb[y == c].std(axis=0).mean() for c in range(5)])
+    from scipy.spatial.distance import pdist
+
+    assert pdist(centroids).min() > 2.0 * spread.mean()
+
+
+def test_transform_new_points(blobs, num_workers):
+    X, y = blobs
+    model = UMAP(
+        n_neighbors=10, random_state=0, n_epochs=100, num_workers=num_workers
+    ).fit(X[:300])
+    df = pd.DataFrame({"features": list(X[300:])})
+    out = model.transform(df)
+    emb_new = np.stack(out["embedding"].to_numpy())
+    assert emb_new.shape == (100, 2)
+    # new points of a class land near the training embedding of that class
+    train_emb = model.embedding_
+    for c in range(5):
+        tr = train_emb[y[:300] == c].mean(axis=0)
+        nw = emb_new[y[300:] == c].mean(axis=0)
+        assert np.linalg.norm(tr - nw) < 3.0
+
+
+def test_random_init_and_components(blobs):
+    X, _ = blobs
+    model = UMAP(
+        n_components=3, init="random", n_neighbors=8, random_state=1,
+        n_epochs=80,
+    ).fit(X)
+    assert model.embedding_.shape == (400, 3)
+    t = trustworthiness(X, model.embedding_, n_neighbors=8)
+    assert t > 0.8
+
+
+def test_sample_fraction(blobs):
+    X, _ = blobs
+    model = UMAP(
+        n_neighbors=8, sample_fraction=0.5, random_state=7, n_epochs=60
+    ).fit(X)
+    # roughly half the rows used for the fit (reference umap.py:926-948)
+    assert 120 < model.raw_data_.shape[0] < 280
+    assert model.embedding_.shape[0] == model.raw_data_.shape[0]
+
+
+def test_cosine_metric(blobs):
+    X, _ = blobs
+    model = UMAP(
+        metric="cosine", n_neighbors=8, random_state=2, n_epochs=60
+    ).fit(X)
+    t = trustworthiness(
+        X / np.linalg.norm(X, axis=1, keepdims=True),
+        model.embedding_, n_neighbors=8,
+    )
+    assert t > 0.75
+
+
+def test_bad_params(blobs):
+    X, _ = blobs
+    with pytest.raises(ValueError, match="n_neighbors"):
+        UMAP(n_neighbors=1000).fit(X)
+    with pytest.raises(ValueError, match="not supported"):
+        UMAP(metric="mahalanobis")
+    with pytest.raises(ValueError, match="not supported"):
+        UMAP(init="pca")
+
+
+def test_save_load(tmp_path, blobs):
+    X, _ = blobs
+    model = UMAP(n_neighbors=8, random_state=0, n_epochs=50).fit(X)
+    path = str(tmp_path / "umap")
+    model.save(path)
+    loaded = UMAPModel.load(path)
+    np.testing.assert_allclose(loaded.embedding_, model.embedding_)
+    a = model._transform_array(X[:20])["embedding"]
+    b = loaded._transform_array(X[:20])["embedding"]
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
