@@ -546,13 +546,59 @@ class _TpuModel(Model, _TpuCaller):
     # -- multi-model single-pass evaluation (reference core.py:1572-1753) ----
 
     @classmethod
-    def _combine(cls, models: List["_TpuModel"]) -> "_TpuModel":
-        raise NotImplementedError
+    def _combine(cls, models: List["_TpuModel"]) -> "_CombinedModel":
+        """Merge N models (one per param map) into one multi-model for
+        single-pass eval (reference `_CumlModel._combine` core.py:1750-1753)."""
+        return _CombinedModel(models)
 
     def _transformEvaluate(self, dataset: DatasetLike, evaluator: Any) -> List[float]:
-        raise NotImplementedError
+        """Transform + metric in one logical pass (reference
+        `_transformEvaluate` core.py:1725-1748)."""
+        return [evaluator.evaluate(self.transform(dataset))]
 
     def cpu(self):
         """Equivalent sklearn model (the reference returns the pyspark.ml
         model, e.g. utils.py:585-809 tree translation)."""
         raise NotImplementedError
+
+
+class _CombinedModel:
+    """N models evaluated against one dataset staging (the analog of the
+    reference's multi-model `_transform_evaluate_internal` pass with
+    model_index partial-metric rows, core.py:1572-1693).  The input frame is
+    materialized once; each member model's (compile-cached) transform runs
+    over the same host arrays."""
+
+    def __init__(self, models: List[_TpuModel]) -> None:
+        if not models:
+            raise ValueError("_combine requires at least one model")
+        self.models = list(models)
+
+    def _transformEvaluate(self, dataset: DatasetLike, evaluator: Any) -> List[float]:
+        import pandas as pd
+
+        if not isinstance(dataset, pd.DataFrame):
+            return [evaluator.evaluate(m.transform(dataset)) for m in self.models]
+        # extract the feature matrix ONCE; every member model transforms the
+        # same resident arrays (kernel compilations are shared)
+        m0 = self.models[0]
+        features_col, features_cols = _resolve_feature_params(m0)
+        batch = extract_arrays(
+            dataset,
+            features_col=features_col,
+            features_cols=features_cols,
+            dtype=None,
+            supervised=False,
+        )
+        X = _ensure_dense(batch.X)
+        results = []
+        for m in self.models:
+            outputs = m._transform_array(np.asarray(X, dtype=m._out_dtype(X)))
+            out_df = dataset.copy()
+            for col, values in outputs.items():
+                vals: Any = values
+                if isinstance(values, np.ndarray) and values.ndim == 2:
+                    vals = list(values)
+                out_df[col] = vals
+            results.append(evaluator.evaluate(out_df))
+        return results

@@ -1,0 +1,175 @@
+#
+# Evaluators — pyspark.ml.evaluation-compatible surface consumed by
+# CrossValidator (the reference CV is driven by Spark's
+# MulticlassClassificationEvaluator / RegressionEvaluator /
+# BinaryClassificationEvaluator, tuning.py:97-130; without Spark the
+# equivalent evaluators live here, computing on the metrics/ subsystem).
+#
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .metrics import MulticlassMetrics, RegressionMetrics
+from .params import Param, Params, TypeConverters
+
+
+class Evaluator(Params):
+    def evaluate(self, dataset: Any) -> float:
+        raise NotImplementedError
+
+    def isLargerBetter(self) -> bool:
+        return True
+
+    def _col(self, df, name: str) -> np.ndarray:
+        if name not in df.columns:
+            raise ValueError(f"Column '{name}' not found in dataset")
+        col = df[name]
+        first = col.iloc[0]
+        if np.isscalar(first):
+            return col.to_numpy()
+        return np.stack([np.asarray(v) for v in col])
+
+
+class MulticlassClassificationEvaluator(Evaluator):
+    """pyspark.ml.evaluation.MulticlassClassificationEvaluator parity."""
+
+    metricName = Param("_", "metricName", "metric name.", TypeConverters.toString)
+    labelCol = Param("_", "labelCol", "label column.", TypeConverters.toString)
+    predictionCol = Param("_", "predictionCol", "prediction column.",
+                          TypeConverters.toString)
+    probabilityCol = Param("_", "probabilityCol", "probability column.",
+                           TypeConverters.toString)
+    weightCol = Param("_", "weightCol", "weight column.", TypeConverters.toString)
+    metricLabel = Param("_", "metricLabel", "class for *ByLabel metrics.",
+                        TypeConverters.toFloat)
+    beta = Param("_", "beta", "beta for weightedFMeasure.", TypeConverters.toFloat)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="f1",
+            labelCol="label",
+            predictionCol="prediction",
+            probabilityCol="probability",
+            metricLabel=0.0,
+            beta=1.0,
+        )
+        self._set(**kwargs)
+
+    def setMetricName(self, value: str) -> "MulticlassClassificationEvaluator":
+        self._set(metricName=value)
+        return self
+
+    def setLabelCol(self, value: str) -> "MulticlassClassificationEvaluator":
+        self._set(labelCol=value)
+        return self
+
+    def setPredictionCol(self, value: str) -> "MulticlassClassificationEvaluator":
+        self._set(predictionCol=value)
+        return self
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def isLargerBetter(self) -> bool:
+        return self.getOrDefault("metricName") not in ("logLoss", "hammingLoss")
+
+    def evaluate(self, dataset: Any) -> float:
+        name = self.getOrDefault("metricName")
+        labels = self._col(dataset, self.getOrDefault("labelCol"))
+        preds = self._col(dataset, self.getOrDefault("predictionCol"))
+        probs = None
+        if name == "logLoss":
+            probs = self._col(dataset, self.getOrDefault("probabilityCol"))
+        weights = None
+        if self.isSet("weightCol"):
+            weights = self._col(dataset, self.getOrDefault("weightCol"))
+        m = MulticlassMetrics.from_predictions(labels, preds, weights, probs)
+        return m.evaluate(
+            name,
+            metric_label=self.getOrDefault("metricLabel"),
+            beta=self.getOrDefault("beta"),
+        )
+
+
+class RegressionEvaluator(Evaluator):
+    """pyspark.ml.evaluation.RegressionEvaluator parity."""
+
+    metricName = Param("_", "metricName", "metric name (rmse/mse/mae/r2/var).",
+                       TypeConverters.toString)
+    labelCol = Param("_", "labelCol", "label column.", TypeConverters.toString)
+    predictionCol = Param("_", "predictionCol", "prediction column.",
+                          TypeConverters.toString)
+    weightCol = Param("_", "weightCol", "weight column.", TypeConverters.toString)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(metricName="rmse", labelCol="label",
+                         predictionCol="prediction")
+        self._set(**kwargs)
+
+    def setMetricName(self, value: str) -> "RegressionEvaluator":
+        self._set(metricName=value)
+        return self
+
+    def setLabelCol(self, value: str) -> "RegressionEvaluator":
+        self._set(labelCol=value)
+        return self
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def isLargerBetter(self) -> bool:
+        return self.getOrDefault("metricName") in ("r2", "var")
+
+    def evaluate(self, dataset: Any) -> float:
+        labels = self._col(dataset, self.getOrDefault("labelCol"))
+        preds = self._col(dataset, self.getOrDefault("predictionCol"))
+        weights = None
+        if self.isSet("weightCol"):
+            weights = self._col(dataset, self.getOrDefault("weightCol"))
+        m = RegressionMetrics.from_predictions(labels, preds, weights)
+        return m.evaluate(self.getOrDefault("metricName"))
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    """pyspark.ml.evaluation.BinaryClassificationEvaluator parity
+    (areaUnderROC / areaUnderPR from raw scores)."""
+
+    metricName = Param("_", "metricName", "areaUnderROC or areaUnderPR.",
+                       TypeConverters.toString)
+    labelCol = Param("_", "labelCol", "label column.", TypeConverters.toString)
+    rawPredictionCol = Param("_", "rawPredictionCol", "raw prediction column.",
+                             TypeConverters.toString)
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__()
+        self._setDefault(
+            metricName="areaUnderROC",
+            labelCol="label",
+            rawPredictionCol="rawPrediction",
+        )
+        self._set(**kwargs)
+
+    def getMetricName(self) -> str:
+        return self.getOrDefault("metricName")
+
+    def evaluate(self, dataset: Any) -> float:
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        labels = self._col(dataset, self.getOrDefault("labelCol"))
+        raw = self._col(dataset, self.getOrDefault("rawPredictionCol"))
+        scores = raw[:, 1] if raw.ndim == 2 else raw
+        if self.getOrDefault("metricName") == "areaUnderPR":
+            return float(average_precision_score(labels, scores))
+        return float(roc_auc_score(labels, scores))
+
+
+__all__ = [
+    "Evaluator",
+    "MulticlassClassificationEvaluator",
+    "RegressionEvaluator",
+    "BinaryClassificationEvaluator",
+]

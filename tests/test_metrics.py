@@ -1,0 +1,116 @@
+#
+# Metrics subsystem tests — the analog of the reference's evaluator
+# comparisons (each algo test compares MulticlassMetrics/RegressionMetrics
+# against Spark evaluators; here sklearn is the oracle).
+#
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from spark_rapids_ml_tpu.evaluation import (
+    BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.metrics import MulticlassMetrics, RegressionMetrics
+
+
+@pytest.fixture
+def clf_results(rng):
+    y = rng.integers(0, 3, 200).astype(float)
+    p = y.copy()
+    flip = rng.random(200) < 0.25
+    p[flip] = rng.integers(0, 3, flip.sum()).astype(float)
+    return y, p
+
+
+def test_multiclass_metrics_vs_sklearn(clf_results):
+    y, p = clf_results
+    m = MulticlassMetrics.from_predictions(y, p)
+    assert m.accuracy == pytest.approx(skm.accuracy_score(y, p))
+    assert m.evaluate("f1") == pytest.approx(
+        skm.f1_score(y, p, average="weighted")
+    )
+    assert m.weighted_precision == pytest.approx(
+        skm.precision_score(y, p, average="weighted")
+    )
+    assert m.weighted_recall == pytest.approx(
+        skm.recall_score(y, p, average="weighted")
+    )
+    assert m.evaluate("hammingLoss") == pytest.approx(
+        1.0 - skm.accuracy_score(y, p)
+    )
+    for c in (0.0, 1.0, 2.0):
+        assert m.precision(c) == pytest.approx(
+            skm.precision_score(y, p, labels=[c], average="macro",
+                                zero_division=0.0)
+        )
+        assert m.recall(c) == pytest.approx(
+            skm.recall_score(y, p, labels=[c], average="macro",
+                             zero_division=0.0)
+        )
+
+
+def test_log_loss_vs_sklearn(rng):
+    y = rng.integers(0, 3, 100).astype(float)
+    probs = rng.dirichlet(np.ones(3), 100)
+    m = MulticlassMetrics.from_predictions(
+        y, probs.argmax(axis=1).astype(float), probabilities=probs
+    )
+    assert m.log_loss == pytest.approx(
+        skm.log_loss(y, probs, labels=[0.0, 1.0, 2.0]), rel=1e-6
+    )
+
+
+def test_weighted_confusion(rng):
+    y = np.array([0.0, 0.0, 1.0, 1.0])
+    p = np.array([0.0, 1.0, 1.0, 1.0])
+    w = np.array([2.0, 1.0, 1.0, 1.0])
+    m = MulticlassMetrics.from_predictions(y, p, weights=w)
+    assert m.accuracy == pytest.approx(4.0 / 5.0)
+
+
+def test_regression_metrics_vs_sklearn(rng):
+    y = rng.normal(size=150) * 10
+    p = y + rng.normal(size=150)
+    m = RegressionMetrics.from_predictions(y, p)
+    assert m.evaluate("mse") == pytest.approx(skm.mean_squared_error(y, p))
+    assert m.evaluate("rmse") == pytest.approx(
+        np.sqrt(skm.mean_squared_error(y, p))
+    )
+    assert m.evaluate("mae") == pytest.approx(skm.mean_absolute_error(y, p))
+    assert m.evaluate("r2") == pytest.approx(skm.r2_score(y, p))
+
+
+def test_explained_variance_spark_formula():
+    # Spark: var = sum((pred - mean_label)^2)/n — biased constant predictor
+    y = np.array([4.0, 5.0, 6.0])
+    p = np.zeros(3)
+    m = RegressionMetrics.from_predictions(y, p)
+    assert m.evaluate("var") == pytest.approx(25.0)
+
+
+def test_evaluators_on_dataframe(rng):
+    import pandas as pd
+
+    y = rng.integers(0, 2, 100).astype(float)
+    p = y.copy()
+    p[:10] = 1.0 - p[:10]
+    probs = np.stack([1.0 - p * 0.8 - 0.1, p * 0.8 + 0.1], axis=1)
+    df = pd.DataFrame({
+        "label": y, "prediction": p,
+        "probability": list(probs), "rawPrediction": list(probs),
+    })
+    acc = MulticlassClassificationEvaluator(metricName="accuracy").evaluate(df)
+    assert acc == pytest.approx(0.9)
+    auc = BinaryClassificationEvaluator().evaluate(df)
+    assert auc == pytest.approx(skm.roc_auc_score(y, probs[:, 1]))
+
+    df_r = pd.DataFrame({"label": y, "prediction": p})
+    rmse = RegressionEvaluator().evaluate(df_r)
+    assert rmse == pytest.approx(np.sqrt(skm.mean_squared_error(y, p)))
+    assert not RegressionEvaluator(metricName="rmse").isLargerBetter()
+    assert RegressionEvaluator(metricName="r2").isLargerBetter()
+    assert not MulticlassClassificationEvaluator(
+        metricName="logLoss"
+    ).isLargerBetter()

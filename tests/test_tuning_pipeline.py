@@ -1,0 +1,174 @@
+#
+# CrossValidator + Pipeline tests — the analog of reference
+# tests/test_tuning.py and tests/test_pipeline.py: single-pass CV picks the
+# right hyperparameter, pipeline assembler bypass produces identical
+# results to explicit assembly.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.evaluation import (
+    MulticlassClassificationEvaluator,
+    RegressionEvaluator,
+)
+from spark_rapids_ml_tpu.pipeline import (
+    NoOpTransformer,
+    Pipeline,
+    PipelineModel,
+    VectorAssembler,
+)
+from spark_rapids_ml_tpu.regression import LinearRegression
+from spark_rapids_ml_tpu.tuning import (
+    CrossValidator,
+    CrossValidatorModel,
+    ParamGridBuilder,
+)
+
+
+@pytest.fixture
+def clf_df(rng):
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=300) > 0)
+    return pd.DataFrame({"features": list(X), "label": y.astype(float)})
+
+
+@pytest.fixture
+def reg_df(rng):
+    X = rng.normal(size=(300, 4))
+    y = X @ np.array([1.0, -2.0, 0.5, 3.0]) + rng.normal(scale=0.1, size=300)
+    return pd.DataFrame({"features": list(X), "label": y})
+
+
+def test_param_grid_builder():
+    lr = LogisticRegression()
+    grid = (
+        ParamGridBuilder()
+        .addGrid(lr.regParam, [0.0, 0.1])
+        .addGrid(lr.maxIter, [10, 20])
+        .build()
+    )
+    assert len(grid) == 4
+    values = {(pm[lr.regParam], pm[lr.maxIter]) for pm in grid}
+    assert values == {(0.0, 10), (0.0, 20), (0.1, 10), (0.1, 20)}
+
+
+def test_cv_logistic_regression(clf_df):
+    lr = LogisticRegression(maxIter=50)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 10.0]).build()
+    cv = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=3,
+        seed=7,
+    )
+    model = cv.fit(clf_df)
+    assert len(model.avgMetrics) == 2
+    # huge regularization must lose to none
+    assert model.avgMetrics[0] > model.avgMetrics[1]
+    assert model.bestIndex == 0
+    preds = model.transform(clf_df)
+    assert (preds["prediction"] == clf_df["label"]).mean() > 0.9
+
+
+def test_cv_regression_minimizes_rmse(reg_df):
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 100.0]).build()
+    cv = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=grid,
+        evaluator=RegressionEvaluator(metricName="rmse"),
+        numFolds=3,
+        seed=1,
+    )
+    model = cv.fit(reg_df)
+    assert model.bestIndex == 0  # rmse smaller-is-better
+    assert model.avgMetrics[0] < model.avgMetrics[1]
+
+
+def test_cv_save_load(tmp_path, clf_df):
+    lr = LogisticRegression(maxIter=30)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 0.5]).build()
+    cv = CrossValidator(
+        estimator=lr,
+        estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2,
+    )
+    model = cv.fit(clf_df)
+    path = str(tmp_path / "cv")
+    model.save(path)
+    loaded = CrossValidatorModel.load(path)
+    assert loaded.avgMetrics == model.avgMetrics
+    a = model.transform(clf_df)["prediction"]
+    b = loaded.transform(clf_df)["prediction"]
+    assert (a == b).all()
+
+
+def test_cv_tuple_input(rng):
+    X = rng.normal(size=(150, 3))
+    y = (X[:, 0] > 0).astype(float)
+    lr = LogisticRegression(maxIter=30)
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0]).build()
+    cv = CrossValidator(
+        estimator=lr, estimatorParamMaps=grid,
+        evaluator=MulticlassClassificationEvaluator(metricName="accuracy"),
+        numFolds=2,
+    )
+    model = cv.fit((X, y))
+    assert len(model.avgMetrics) == 1
+
+
+def test_vector_assembler(rng):
+    df = pd.DataFrame({"a": [1.0, 2.0], "b": [3.0, 4.0]})
+    out = VectorAssembler(inputCols=["a", "b"], outputCol="v").transform(df)
+    assert np.array_equal(np.stack(out["v"].to_numpy()), [[1, 3], [2, 4]])
+
+
+def test_pipeline_assembler_bypass_matches_explicit(rng):
+    df = pd.DataFrame({
+        "a": rng.normal(size=200), "b": rng.normal(size=200),
+        "c": rng.normal(size=200),
+    })
+    df["label"] = (df["a"] - df["b"] > 0).astype(float)
+
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["a", "b", "c"], outputCol="features"),
+        LogisticRegression(maxIter=50),
+    ])
+    model = pipe.fit(df)
+    # bypass happened: first fitted stage is a NoOp
+    assert isinstance(model.stages[0], NoOpTransformer)
+    preds = model.transform(df)["prediction"]
+
+    # explicit path: assemble, then fit on the array column
+    assembled = VectorAssembler(
+        inputCols=["a", "b", "c"], outputCol="features"
+    ).transform(df)
+    direct = LogisticRegression(maxIter=50).fit(assembled)
+    np.testing.assert_array_equal(
+        preds.to_numpy(), direct.transform(assembled)["prediction"].to_numpy()
+    )
+
+
+def test_pipeline_no_bypass_when_cols_differ(rng):
+    df = pd.DataFrame({"a": rng.normal(size=50), "b": rng.normal(size=50)})
+    df["label"] = (df["a"] > 0).astype(float)
+    pipe = Pipeline(stages=[
+        VectorAssembler(inputCols=["a", "b"], outputCol="other_col"),
+        LogisticRegression(maxIter=20),  # featuresCol stays "features"
+    ])
+    # assembler output doesn't feed the estimator -> no bypass, and the
+    # estimator fails to find its features column
+    with pytest.raises(ValueError, match="features"):
+        pipe.fit(df)
+
+
+def test_pipeline_model_stages_roundtrip(clf_df):
+    pipe = Pipeline(stages=[LogisticRegression(maxIter=30)])
+    model = pipe.fit(clf_df)
+    assert isinstance(model, PipelineModel)
+    out = model.transform(clf_df)
+    assert "prediction" in out.columns
