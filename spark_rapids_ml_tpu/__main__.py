@@ -1,0 +1,45 @@
+#
+# `python -m spark_rapids_ml_tpu script.py [args...]` — the analog of
+# reference __main__.py (63 LoC, cudf.pandas-style runner): installs the
+# zero-import-change accelerator, then executes the target script (or -m
+# module) unmodified with TPU-backed estimators in place of sklearn's.
+#
+from __future__ import annotations
+
+import runpy
+import sys
+
+
+_USAGE = (
+    "usage: python -m spark_rapids_ml_tpu (script.py | -m module) [args...]\n"
+    "Run a Python script with sklearn transparently accelerated by "
+    "spark_rapids_ml_tpu (reference: python -m spark_rapids_ml)."
+)
+
+
+def main() -> None:
+    # manual parsing (argparse would claim the target's own -x/--x options)
+    argv = sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print(_USAGE)
+        raise SystemExit(0 if argv else 2)
+
+    from .install import install
+
+    install()
+
+    if argv[0] == "-m":
+        if len(argv) < 2:
+            print(_USAGE)
+            raise SystemExit(2)
+        module, rest = argv[1], argv[2:]
+        sys.argv[:] = [module] + rest
+        runpy.run_module(module, run_name="__main__", alter_sys=True)
+    else:
+        script, rest = argv[0], argv[1:]
+        sys.argv[:] = [script] + rest
+        runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+#
+# Zero-import-change accelerator hook — the analog of reference install.py
+# (81 LoC): the reference replaces pyspark.ml.{feature,clustering,...}
+# attributes with accelerated classes behind a caller-path guard
+# (install.py:51-77); here the host ML library is scikit-learn, and the
+# same capability swaps sklearn module attributes for the TPU-backed
+# sklearn_api facades.  `uninstall()` restores the originals.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .utils import get_logger
+
+# (sklearn module, attribute) -> sklearn_api facade name
+_PATCHES: List[Tuple[str, str, str]] = [
+    ("sklearn.cluster", "KMeans", "KMeans"),
+    ("sklearn.cluster", "DBSCAN", "DBSCAN"),
+    ("sklearn.decomposition", "PCA", "PCA"),
+    ("sklearn.linear_model", "LinearRegression", "LinearRegression"),
+    ("sklearn.linear_model", "LogisticRegression", "LogisticRegression"),
+    ("sklearn.ensemble", "RandomForestClassifier", "RandomForestClassifier"),
+    ("sklearn.ensemble", "RandomForestRegressor", "RandomForestRegressor"),
+    ("sklearn.neighbors", "NearestNeighbors", "NearestNeighbors"),
+]
+
+_originals: Dict[Tuple[str, str], Any] = {}
+
+
+def install() -> None:
+    """Patch sklearn with TPU-accelerated estimators (idempotent)."""
+    import importlib
+
+    from . import sklearn_api
+
+    logger = get_logger("spark_rapids_ml_tpu.install")
+    for module_name, attr, facade in _PATCHES:
+        module = importlib.import_module(module_name)
+        key = (module_name, attr)
+        current = getattr(module, attr)
+        replacement = getattr(sklearn_api, facade)
+        if current is replacement:
+            continue
+        _originals[key] = current
+        setattr(module, attr, replacement)
+    logger.info(
+        "TPU acceleration installed for "
+        + ", ".join(f"{m}.{a}" for m, a, _ in _PATCHES)
+    )
+
+
+def uninstall() -> None:
+    """Restore the original sklearn classes."""
+    import importlib
+
+    for (module_name, attr), original in list(_originals.items()):
+        module = importlib.import_module(module_name)
+        setattr(module, attr, original)
+        del _originals[(module_name, attr)]
+
+
+__all__ = ["install", "uninstall"]

@@ -1,0 +1,125 @@
+#
+# Zero-import-change acceptance tests — the analog of reference
+# tests_no_import_change/test_no_import_change.py: an unmodified sklearn
+# script runs against the TPU backend after install(), and the __main__
+# runner executes scripts end to end.
+#
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.install import install, uninstall
+
+
+@pytest.fixture
+def patched():
+    install()
+    yield
+    uninstall()
+
+
+def test_install_uninstall_roundtrip():
+    import sklearn.cluster
+
+    original = sklearn.cluster.KMeans
+    install()
+    import spark_rapids_ml_tpu.sklearn_api as api
+
+    assert sklearn.cluster.KMeans is api.KMeans
+    uninstall()
+    assert sklearn.cluster.KMeans is original
+
+
+def test_sklearn_script_unmodified(patched, rng):
+    # this block is plain sklearn code
+    from sklearn.cluster import KMeans
+    from sklearn.linear_model import LogisticRegression
+
+    X = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(float)
+
+    km = KMeans(n_clusters=3, random_state=0).fit(X)
+    assert km.cluster_centers_.shape == (3, 4)
+    assert len(km.labels_) == 120
+
+    lr = LogisticRegression(max_iter=50).fit(X, y)
+    assert lr.score(X, y) > 0.9
+    assert lr.predict_proba(X).shape == (120, 2)
+
+
+def test_facade_rf_and_knn(patched, rng):
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.neighbors import NearestNeighbors
+
+    X = rng.normal(size=(600, 5)).astype(np.float32)
+    y = (X[:, 1] > 0).astype(float)
+    rf = RandomForestClassifier(n_estimators=8, max_depth=6, random_state=0)
+    assert rf.fit(X, y).score(X, y) > 0.85
+
+    nn = NearestNeighbors(n_neighbors=3).fit(X)
+    dist, idx = nn.kneighbors(X[:5])
+    assert dist.shape == (5, 3)
+    assert np.array_equal(idx[:, 0], np.arange(5))
+
+
+def test_main_runner(tmp_path):
+    script = tmp_path / "user_script.py"
+    script.write_text(textwrap.dedent("""
+        import numpy as np
+        from sklearn.cluster import KMeans
+        import spark_rapids_ml_tpu.sklearn_api as api
+        assert KMeans is api.KMeans, "accelerator not installed"
+        X = np.random.default_rng(0).normal(size=(50, 3)).astype("float32")
+        km = KMeans(n_clusters=2, random_state=0).fit(X)
+        print("OK", km.cluster_centers_.shape)
+    """))
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu", str(script)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": __import__("os").path.dirname(
+                __import__("os").path.dirname(__file__)
+            ),
+        },
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK (2, 3)" in out.stdout
+
+
+def test_facades_are_cloneable(patched):
+    from sklearn.base import clone
+    from sklearn.cluster import KMeans
+    from sklearn.linear_model import LogisticRegression
+
+    km = KMeans(n_clusters=4, random_state=3)
+    km2 = clone(km)
+    assert km2.n_clusters == 4 and km2.random_state == 3
+    lr = clone(LogisticRegression(C=0.5, penalty="l1"))
+    assert lr.C == 0.5 and lr.penalty == "l1"
+
+
+def test_main_runner_propagates_failure(tmp_path):
+    script = tmp_path / "failing.py"
+    script.write_text("raise SystemExit(3)")
+    out = subprocess.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu", str(script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={
+            **__import__("os").environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": __import__("os").path.dirname(
+                __import__("os").path.dirname(__file__)
+            ),
+        },
+    )
+    # non-zero exit must propagate (reference run_test.sh:27-46 checks this)
+    assert out.returncode == 3
