@@ -1,0 +1,65 @@
+#
+# Benchmark infrastructure — the analog of reference python/benchmark/
+# base.py (BenchmarkBase: timing via with_benchmark, CSV report,
+# base.py:43-295).
+#
+from __future__ import annotations
+
+import csv
+import json
+import os
+import subprocess
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+def with_benchmark(name: str, fn: Callable[[], Any]) -> Tuple[Any, float]:
+    """Run fn, return (result, elapsed_seconds); prints like the reference
+    benchmark/utils.py with_benchmark."""
+    t0 = time.perf_counter()
+    result = fn()
+    elapsed = time.perf_counter() - t0
+    print(f"{name}: {elapsed:.3f}s")
+    return result, elapsed
+
+
+def git_revision() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+class Report:
+    """Accumulates benchmark rows and writes a CSV report (reference
+    base.py:177-187, 259-282 report with git hash)."""
+
+    FIELDS = ["benchmark", "mode", "num_rows", "num_cols", "fit_sec",
+              "transform_sec", "score_name", "score", "git_rev", "extra"]
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self.rows: List[Dict[str, Any]] = []
+
+    def add(self, **row: Any) -> None:
+        row.setdefault("git_rev", git_revision())
+        if isinstance(row.get("extra"), dict):
+            row["extra"] = json.dumps(row["extra"])
+        self.rows.append(row)
+        print(json.dumps(row))
+
+    def write(self) -> None:
+        if not self.path:
+            return
+        exists = os.path.exists(self.path)
+        with open(self.path, "a", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=self.FIELDS, extrasaction="ignore")
+            if not exists:
+                w.writeheader()
+            for row in self.rows:
+                w.writerow(row)

@@ -1,0 +1,114 @@
+#
+# Synthetic dataset generation — the analog of reference
+# python/benchmark/gen_data.py (sklearn-based Blobs/LowRankMatrix/
+# Regression/Classification/Default generators, gen_data.py:49-471).
+# Generates parquet with either an array-valued "features" column or
+# per-feature scalar columns (the two input layouts the estimators take).
+#
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def gen_blobs(n_rows: int, n_cols: int, *, centers: int = 20, cluster_std: float = 1.0,
+              seed: int = 0):
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(
+        n_samples=n_rows, n_features=n_cols, centers=centers,
+        cluster_std=cluster_std, random_state=seed,
+    )
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def gen_low_rank_matrix(n_rows: int, n_cols: int, *, effective_rank: Optional[int] = None,
+                        seed: int = 0):
+    from sklearn.datasets import make_low_rank_matrix
+
+    X = make_low_rank_matrix(
+        n_samples=n_rows, n_features=n_cols,
+        effective_rank=effective_rank or max(1, n_cols // 10),
+        random_state=seed,
+    )
+    return X.astype(np.float32), None
+
+
+def gen_regression(n_rows: int, n_cols: int, *, n_informative: Optional[int] = None,
+                   noise: float = 1.0, seed: int = 0):
+    from sklearn.datasets import make_regression
+
+    X, y = make_regression(
+        n_samples=n_rows, n_features=n_cols,
+        n_informative=n_informative or max(1, n_cols // 2),
+        noise=noise, random_state=seed,
+    )
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def gen_classification(n_rows: int, n_cols: int, *, n_classes: int = 2,
+                       n_informative: Optional[int] = None, seed: int = 0):
+    from sklearn.datasets import make_classification
+
+    ninf = n_informative or max(int(np.ceil(np.log2(n_classes))) + 2, n_cols // 2)
+    X, y = make_classification(
+        n_samples=n_rows, n_features=n_cols, n_informative=min(ninf, n_cols),
+        n_redundant=0, n_classes=n_classes, random_state=seed,
+    )
+    return X.astype(np.float32), y.astype(np.float64)
+
+
+def gen_default(n_rows: int, n_cols: int, *, seed: int = 0):
+    """Uniform random (reference DefaultDataGen)."""
+    rng = np.random.default_rng(seed)
+    return rng.random((n_rows, n_cols), dtype=np.float32), None
+
+
+GENERATORS = {
+    "blobs": gen_blobs,
+    "low_rank_matrix": gen_low_rank_matrix,
+    "regression": gen_regression,
+    "classification": gen_classification,
+    "default": gen_default,
+}
+
+
+def write_parquet(X: np.ndarray, y: Optional[np.ndarray], path: str,
+                  feature_layout: str = "array") -> None:
+    import pandas as pd
+
+    if feature_layout == "array":
+        df = pd.DataFrame({"features": list(X)})
+    else:  # scalar columns (HasFeaturesCols layout)
+        df = pd.DataFrame(X, columns=[f"c{i}" for i in range(X.shape[1])])
+    if y is not None:
+        df["label"] = y
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    df.to_parquet(path)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="Generate synthetic benchmark data")
+    p.add_argument("kind", choices=sorted(GENERATORS))
+    p.add_argument("--num_rows", type=int, default=5000)
+    p.add_argument("--num_cols", type=int, default=3000)
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--feature_layout", choices=["array", "scalar"], default="array")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--n_classes", type=int, default=2)
+    args = p.parse_args()
+
+    kwargs = {"seed": args.seed}
+    if args.kind == "classification":
+        kwargs["n_classes"] = args.n_classes
+    X, y = GENERATORS[args.kind](args.num_rows, args.num_cols, **kwargs)
+    out = os.path.join(args.output_dir, f"{args.kind}.parquet")
+    write_parquet(X, y, out, args.feature_layout)
+    print(f"wrote {args.num_rows}x{args.num_cols} {args.kind} -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#
+# Benchmark smoke tests — the analog of reference tests/test_benchmark.py:
+# every registered benchmark runs end to end at toy sizes in both modes.
+#
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark import gen_data
+from benchmark.benchmark_runner import BENCHMARKS, main
+
+
+@pytest.mark.parametrize("name", sorted(BENCHMARKS))
+def test_benchmark_smoke_tpu(name, tmp_path):
+    report = str(tmp_path / "report.csv")
+    main([
+        name, "--num_rows", "300", "--num_cols", "8", "--mode", "tpu",
+        "--num_workers", "2", "--max_iter", "5", "--num_trees", "4",
+        "--max_depth", "4", "--report", report,
+    ])
+    assert os.path.exists(report)
+
+
+def test_benchmark_smoke_cpu(tmp_path):
+    report = str(tmp_path / "report.csv")
+    main([
+        "kmeans", "--num_rows", "300", "--num_cols", "8", "--mode", "cpu",
+        "--report", report,
+    ])
+    with open(report) as f:
+        content = f.read()
+    assert "kmeans" in content and "cpu" in content
+
+
+def test_gen_data_parquet(tmp_path):
+    X, y = gen_data.gen_classification(100, 6, n_classes=3, seed=1)
+    assert X.shape == (100, 6) and set(np.unique(y)) == {0.0, 1.0, 2.0}
+    path = str(tmp_path / "d.parquet")
+    gen_data.write_parquet(X, y, path)
+    import pandas as pd
+
+    df = pd.read_parquet(path)
+    assert len(df) == 100 and "label" in df.columns
+
+    # scalar layout
+    path2 = str(tmp_path / "d2.parquet")
+    gen_data.write_parquet(X, None, path2, feature_layout="scalar")
+    df2 = pd.read_parquet(path2)
+    assert list(df2.columns) == [f"c{i}" for i in range(6)]
