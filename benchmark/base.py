@@ -24,15 +24,23 @@ def with_benchmark(name: str, fn: Callable[[], Any]) -> Tuple[Any, float]:
     return result, elapsed
 
 
+_git_revision_cache: Optional[str] = None
+
+
 def git_revision() -> str:
-    try:
-        return subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        ).stdout.strip()
-    except Exception:
-        return "unknown"
+    global _git_revision_cache
+    if _git_revision_cache is None:
+        try:
+            proc = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            rev = proc.stdout.strip() if proc.returncode == 0 else ""
+            _git_revision_cache = rev or "unknown"
+        except Exception:
+            _git_revision_cache = "unknown"
+    return _git_revision_cache
 
 
 class Report:

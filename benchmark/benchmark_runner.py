@@ -67,8 +67,11 @@ def bench_kmeans(args, report: Report) -> None:
         est = SkKMeans(n_clusters=k, n_init=1, max_iter=args.max_iter,
                        random_state=args.seed)
         _, fit_s = with_benchmark("cpu fit", lambda: est.fit(X))
+        _, tr_s = with_benchmark(
+            "cpu transform", lambda: est.predict(X[:100_000])
+        )
         report.add(benchmark="kmeans", mode="cpu", num_rows=args.num_rows,
-                   num_cols=args.num_cols, fit_sec=fit_s, transform_sec=0.0,
+                   num_cols=args.num_cols, fit_sec=fit_s, transform_sec=tr_s,
                    score_name="inertia", score=float(est.inertia_))
         return
     from spark_rapids_ml_tpu.clustering import KMeans
@@ -235,26 +238,31 @@ def bench_nearest_neighbors(args, report: Report) -> None:
                               seed=args.seed)
     n_q = min(args.num_rows, 10_000)
     k = args.k or 16
+    # column semantics match ANN below: fit_sec = index/fit time,
+    # transform_sec = search time
     if args.mode == "cpu":
         from sklearn.neighbors import NearestNeighbors as SkNN
 
-        est = SkNN(n_neighbors=k, algorithm="brute").fit(X)
-        _, fit_s = with_benchmark(
+        est, fit_s = with_benchmark(
+            "cpu fit", lambda: SkNN(n_neighbors=k, algorithm="brute").fit(X)
+        )
+        _, search_s = with_benchmark(
             "cpu kneighbors", lambda: est.kneighbors(X[:n_q])
         )
-        score = 1.0
     else:
         from spark_rapids_ml_tpu.knn import NearestNeighbors
 
-        model = NearestNeighbors(k=k, num_workers=args.num_workers).fit(X)
+        model, fit_s = with_benchmark(
+            "tpu fit",
+            lambda: NearestNeighbors(k=k, num_workers=args.num_workers).fit(X),
+        )
         model._search(X[:n_q], k)  # warmup compile
-        _, fit_s = with_benchmark(
+        _, search_s = with_benchmark(
             "tpu kneighbors", lambda: model._search(X[:n_q], k)
         )
-        score = 1.0  # exact
     report.add(benchmark="nearest_neighbors", mode=args.mode,
                num_rows=args.num_rows, num_cols=args.num_cols, fit_sec=fit_s,
-               transform_sec=0.0, score_name="recall", score=score,
+               transform_sec=search_s, score_name="recall", score=1.0,
                extra={"k": k, "num_queries": n_q})
 
 
@@ -366,12 +374,23 @@ def main(argv: Optional[list] = None) -> None:
 
     report = Report(args.report)
     names = sorted(BENCHMARKS) if args.benchmark == "all" else [args.benchmark]
-    for name in names:
-        print(f"=== {name} ({args.mode}, {args.num_rows}x{args.num_cols}) ===")
-        t0 = time.perf_counter()
-        BENCHMARKS[name](args, report)
-        print(f"=== {name} done in {time.perf_counter() - t0:.1f}s ===")
-    report.write()
+    failed = []
+    try:
+        for name in names:
+            print(f"=== {name} ({args.mode}, {args.num_rows}x{args.num_cols}) ===")
+            t0 = time.perf_counter()
+            try:
+                BENCHMARKS[name](args, report)
+            except Exception as e:  # keep collected rows on partial failure
+                if args.benchmark != "all":
+                    raise
+                failed.append(name)
+                print(f"!!! {name} failed: {e}")
+            print(f"=== {name} done in {time.perf_counter() - t0:.1f}s ===")
+    finally:
+        report.write()
+    if failed:
+        raise SystemExit(f"benchmarks failed: {failed}")
 
 
 if __name__ == "__main__":

@@ -54,9 +54,18 @@ def gen_classification(n_rows: int, n_cols: int, *, n_classes: int = 2,
     from sklearn.datasets import make_classification
 
     ninf = n_informative or max(int(np.ceil(np.log2(n_classes))) + 2, n_cols // 2)
+    ninf = min(ninf, n_cols)
+    # sklearn requires n_classes * n_clusters_per_class <= 2**n_informative
+    clusters_per_class = 2 if n_classes * 2 <= 2**ninf else 1
+    if n_classes > 2**ninf:
+        raise ValueError(
+            f"n_classes={n_classes} needs more informative features than "
+            f"num_cols={n_cols} allows (n_classes <= 2**{ninf})"
+        )
     X, y = make_classification(
-        n_samples=n_rows, n_features=n_cols, n_informative=min(ninf, n_cols),
-        n_redundant=0, n_classes=n_classes, random_state=seed,
+        n_samples=n_rows, n_features=n_cols, n_informative=ninf,
+        n_redundant=0, n_classes=n_classes,
+        n_clusters_per_class=clusters_per_class, random_state=seed,
     )
     return X.astype(np.float32), y.astype(np.float64)
 
