@@ -1,0 +1,167 @@
+//
+// Host staging kernels — the native analog of the reference's device/memory
+// layer hot loops (reference utils.py:358-522: `_concat_and_free`,
+// `_concat_with_reserved_gpu_mem` preallocate-then-stream staging, and
+// numpy_allocator.py's C allocator hooks).  On TPU the HBM side belongs to
+// XLA; what remains host-side — and measurably single-thread-bound in
+// numpy — is assembling the padded, dtype-cast, C-contiguous feature
+// matrix that `jax.device_put` ships to the mesh:
+//
+//   - pad_cast_*: fused zero-pad + dtype cast (the `padded[:n] = arr` copy
+//     in mesh.shard_rows), parallelized over rows with OpenMP.
+//   - pack_rows_*: gather N row pointers (a pandas object column of
+//     per-row arrays) into one contiguous matrix — the np.stack
+//     replacement for the VectorUDT-analog input layout.
+//   - csr_densify_*: CSR -> padded dense block (the TPU sparse strategy
+//     densifies per block; scipy's .toarray() is single-threaded).
+//
+// Build: g++ -O3 -fopenmp -shared -fPIC (see spark_rapids_ml_tpu/native.py
+// lazy builder).  Plain C ABI for ctypes.
+//
+#include <cstdint>
+#include <cstring>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---- fused zero-pad + cast ------------------------------------------------
+
+void pad_cast_f64_f32(const double* src, int64_t n, int64_t d, int64_t n_pad,
+                      float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    if (i < n) {
+      const double* in = src + i * d;
+      for (int64_t j = 0; j < d; ++j) out[j] = static_cast<float>(in[j]);
+    } else {
+      std::memset(out, 0, sizeof(float) * d);
+    }
+  }
+}
+
+void pad_copy_f32(const float* src, int64_t n, int64_t d, int64_t n_pad,
+                  float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    if (i < n) {
+      std::memcpy(out, src + i * d, sizeof(float) * d);
+    } else {
+      std::memset(out, 0, sizeof(float) * d);
+    }
+  }
+}
+
+void pad_copy_f64(const double* src, int64_t n, int64_t d, int64_t n_pad,
+                  double* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    double* out = dst + i * d;
+    if (i < n) {
+      std::memcpy(out, src + i * d, sizeof(double) * d);
+    } else {
+      std::memset(out, 0, sizeof(double) * d);
+    }
+  }
+}
+
+void pad_cast_f32_f64(const float* src, int64_t n, int64_t d, int64_t n_pad,
+                      double* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    double* out = dst + i * d;
+    if (i < n) {
+      const float* in = src + i * d;
+      for (int64_t j = 0; j < d; ++j) out[j] = static_cast<double>(in[j]);
+    } else {
+      std::memset(out, 0, sizeof(double) * d);
+    }
+  }
+}
+
+// ---- object-column row packing -------------------------------------------
+// srcs: array of n row pointers (each a contiguous vector of length d).
+
+void pack_rows_f64_f32(const double* const* srcs, int64_t n, int64_t d,
+                       int64_t n_pad, float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    if (i < n) {
+      const double* in = srcs[i];
+      for (int64_t j = 0; j < d; ++j) out[j] = static_cast<float>(in[j]);
+    } else {
+      std::memset(out, 0, sizeof(float) * d);
+    }
+  }
+}
+
+void pack_rows_f32_f32(const float* const* srcs, int64_t n, int64_t d,
+                       int64_t n_pad, float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    if (i < n) {
+      std::memcpy(out, srcs[i], sizeof(float) * d);
+    } else {
+      std::memset(out, 0, sizeof(float) * d);
+    }
+  }
+}
+
+void pack_rows_f64_f64(const double* const* srcs, int64_t n, int64_t d,
+                       int64_t n_pad, double* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    double* out = dst + i * d;
+    if (i < n) {
+      std::memcpy(out, srcs[i], sizeof(double) * d);
+    } else {
+      std::memset(out, 0, sizeof(double) * d);
+    }
+  }
+}
+
+// ---- CSR densify ----------------------------------------------------------
+
+void csr_densify_f32(const int64_t* indptr, const int32_t* indices,
+                     const float* data, int64_t n, int64_t d, int64_t n_pad,
+                     float* dst) {
+#pragma omp parallel for schedule(dynamic, 1024)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    std::memset(out, 0, sizeof(float) * d);
+    if (i < n) {
+      for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p)
+        out[indices[p]] = data[p];
+    }
+  }
+}
+
+void csr_densify_f64_f32(const int64_t* indptr, const int32_t* indices,
+                         const double* data, int64_t n, int64_t d,
+                         int64_t n_pad, float* dst) {
+#pragma omp parallel for schedule(dynamic, 1024)
+  for (int64_t i = 0; i < n_pad; ++i) {
+    float* out = dst + i * d;
+    std::memset(out, 0, sizeof(float) * d);
+    if (i < n) {
+      for (int64_t p = indptr[i]; p < indptr[i + 1]; ++p)
+        out[indices[p]] = static_cast<float>(data[p]);
+    }
+  }
+}
+
+int staging_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

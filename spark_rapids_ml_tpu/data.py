@@ -36,7 +36,10 @@ def _ensure_dense(X: Any) -> np.ndarray:
     lands, CSR inputs densify on the host (the reference's LogReg similarly
     switches representations at staging, classification.py:960-966)."""
     if _is_sparse(X):
-        return np.ascontiguousarray(X.toarray())
+        from .native import densify_csr
+
+        csr = X.tocsr()
+        return densify_csr(csr, csr.shape[0], csr.dtype)
     return X
 
 
@@ -85,9 +88,16 @@ def _features_from_pandas(
     first = col.iloc[0]
     if np.isscalar(first):
         return np.ascontiguousarray(col.to_numpy(dtype=dtype).reshape(-1, 1))
-    if dtype is None:
-        return np.ascontiguousarray(np.stack([np.asarray(v) for v in col]))
-    return np.ascontiguousarray(np.stack([np.asarray(v, dtype=dtype) for v in col]))
+    rows = col.to_numpy()
+    first_arr = np.asarray(first)
+    out_dtype = dtype if dtype is not None else (
+        first_arr.dtype
+        if np.issubdtype(first_arr.dtype, np.floating)
+        else np.float64
+    )
+    from .native import pack_rows
+
+    return pack_rows(rows, len(rows), out_dtype)
 
 
 def extract_arrays(

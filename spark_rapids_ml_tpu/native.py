@@ -1,0 +1,253 @@
+#
+# Native host-staging bindings — loads native/staging.cpp (the analog of
+# the reference's native memory layer: `_concat_and_free`/reserved-memory
+# staging utils.py:358-522 and numpy_allocator.py's C hooks) via ctypes,
+# building the shared library on first use with the baked-in g++.  Every
+# entry point has a numpy fallback, so the package works without a
+# compiler; the native path parallelizes the pad/cast/pack/densify loops
+# that feed `jax.device_put`.
+#
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .utils import get_logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO_ROOT, "native", "staging.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libstaging.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    # compile to a process-unique temp path and rename into place so
+    # concurrent builders never dlopen a half-written library
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        "-std=c++17", _SRC, "-o", tmp_path,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except Exception as e:  # g++ missing etc.
+        get_logger("spark_rapids_ml_tpu.native").warning(
+            f"native staging build unavailable ({e}); using numpy fallback"
+        )
+        return False
+    if proc.returncode != 0:
+        get_logger("spark_rapids_ml_tpu.native").warning(
+            f"native staging build failed; using numpy fallback:\n{proc.stderr[-500:]}"
+        )
+        return False
+    os.replace(tmp_path, _LIB_PATH)
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            get_logger("spark_rapids_ml_tpu.native").warning(
+                f"native staging load failed ({e}); using numpy fallback"
+            )
+            _load_failed = True
+            return None
+        i64, f32p, f64p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float), \
+            ctypes.POINTER(ctypes.c_double)
+        pp = ctypes.POINTER(ctypes.c_void_p)
+        for name, argtypes in {
+            "pad_cast_f64_f32": [f64p, i64, i64, i64, f32p],
+            "pad_copy_f32": [f32p, i64, i64, i64, f32p],
+            "pad_copy_f64": [f64p, i64, i64, i64, f64p],
+            "pad_cast_f32_f64": [f32p, i64, i64, i64, f64p],
+            "pack_rows_f64_f32": [pp, i64, i64, i64, f32p],
+            "pack_rows_f32_f32": [pp, i64, i64, i64, f32p],
+            "pack_rows_f64_f64": [pp, i64, i64, i64, f64p],
+            "csr_densify_f32": [ctypes.POINTER(i64),
+                                ctypes.POINTER(ctypes.c_int32), f32p, i64,
+                                i64, i64, f32p],
+            "csr_densify_f64_f32": [ctypes.POINTER(i64),
+                                    ctypes.POINTER(ctypes.c_int32), f64p,
+                                    i64, i64, i64, f32p],
+        }.items():
+            getattr(lib, name).argtypes = argtypes
+            getattr(lib, name).restype = None
+        lib.staging_num_threads.restype = ctypes.c_int
+        _lib = lib
+        get_logger("spark_rapids_ml_tpu.native").info(
+            f"native staging library loaded ({lib.staging_num_threads()} threads)"
+        )
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# Below ~64MB the numpy copy is already fast; skip ctypes overhead.
+_MIN_NATIVE_BYTES = 1 << 26
+
+
+# set True in tests to exercise the native kernels regardless of size and
+# thread-count gates
+_FORCE_NATIVE = False
+
+# pack_rows wins even single-threaded; this only amortizes the ctypes setup
+_MIN_PACK_ROWS = 16384
+
+
+def _parallel_lib():
+    """The library, but only when OpenMP has real parallelism: numpy's
+    SIMD copy/cast loops already saturate a single core, so the bandwidth-
+    bound pad/densify paths only win multi-threaded."""
+    lib = _load()
+    if lib is not None and (_FORCE_NATIVE or lib.staging_num_threads() > 1):
+        return lib
+    return None
+
+
+def pad_cast(arr: np.ndarray, n_pad: int, dtype: np.dtype) -> np.ndarray:
+    """Zero-padded, dtype-cast, C-contiguous copy of a 2-D array — the
+    staging step of mesh.shard_rows, parallelized when large."""
+    dtype = np.dtype(dtype)
+    n, d = arr.shape
+    lib = _parallel_lib() if arr.nbytes >= _MIN_NATIVE_BYTES else None
+    pair = (str(arr.dtype), str(dtype))
+    fn = None
+    if lib is not None and arr.flags.c_contiguous:
+        fn = {
+            ("float64", "float32"): ("pad_cast_f64_f32", ctypes.c_double),
+            ("float32", "float32"): ("pad_copy_f32", ctypes.c_float),
+            ("float64", "float64"): ("pad_copy_f64", ctypes.c_double),
+            ("float32", "float64"): ("pad_cast_f32_f64", ctypes.c_float),
+        }.get(pair)
+    if fn is not None:
+        out = np.empty((n_pad, d), dtype)
+        name, src_ct = fn
+        dst_ct = ctypes.c_float if dtype == np.float32 else ctypes.c_double
+        getattr(lib, name)(_ptr(arr, src_ct), n, d, n_pad, _ptr(out, dst_ct))
+        return out
+    out = np.zeros((n_pad, d), dtype)
+    out[:n] = arr
+    return out
+
+
+def pack_rows(rows: np.ndarray, n_pad: int, dtype: np.dtype) -> np.ndarray:
+    """Pack an object array of n per-row vectors into a padded (n_pad, d)
+    matrix — the np.stack replacement for array-valued feature columns."""
+    dtype = np.dtype(dtype)
+    n = len(rows)
+    first = np.asarray(rows[0])
+    d = first.shape[0]
+    # wins even single-threaded (np.stack pays per-row Python overhead),
+    # so gate only on the row count amortizing the ctypes setup
+    lib = _load() if (n >= _MIN_PACK_ROWS or _FORCE_NATIVE) else None
+    if lib is not None and dtype in (np.float32, np.float64):
+        name = {
+            ("float64", "float32"): "pack_rows_f64_f32",
+            ("float32", "float32"): "pack_rows_f32_f32",
+            ("float64", "float64"): "pack_rows_f64_f64",
+        }.get((str(first.dtype), str(dtype)))
+        if name is not None:
+            ptrs = (ctypes.c_void_p * n)()
+            ok = True
+            for i in range(n):
+                r = rows[i]
+                if (
+                    not isinstance(r, np.ndarray)
+                    or r.dtype != first.dtype
+                    or r.shape != (d,)
+                    or not r.flags.c_contiguous
+                ):
+                    ok = False
+                    break
+                ptrs[i] = r.ctypes.data
+            if ok:
+                out = np.empty((n_pad, d), dtype)
+                dst_ct = (
+                    ctypes.c_float if dtype == np.float32 else ctypes.c_double
+                )
+                getattr(lib, name)(
+                    ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                    n, d, n_pad, _ptr(out, dst_ct),
+                )
+                return out
+    stacked = np.ascontiguousarray(
+        np.stack([np.asarray(v, dtype=dtype) for v in rows])
+    )
+    if n_pad == n:
+        return stacked
+    out = np.zeros((n_pad, d), dtype)
+    out[:n] = stacked
+    return out
+
+
+def densify_csr(csr, n_pad: int, dtype: np.dtype) -> np.ndarray:
+    """CSR -> padded dense (n_pad, d) block (the per-block densify of the
+    TPU sparse strategy), parallelized over rows."""
+    dtype = np.dtype(dtype)
+    n, d = csr.shape
+    lib = (
+        _parallel_lib()
+        if (n * d * dtype.itemsize >= _MIN_NATIVE_BYTES or _FORCE_NATIVE)
+        else None
+    )
+    if lib is not None and dtype == np.float32:
+        if not csr.has_canonical_format:
+            # the native kernel assigns (last write wins); scipy's toarray
+            # SUMS duplicate entries — canonicalize to match
+            csr.sum_duplicates()
+        indptr = np.ascontiguousarray(csr.indptr, dtype=np.int64)
+        indices = np.ascontiguousarray(csr.indices, dtype=np.int32)
+        data = np.ascontiguousarray(csr.data)
+        name = {
+            "float32": "csr_densify_f32",
+            "float64": "csr_densify_f64_f32",
+        }.get(str(data.dtype))
+        if name is not None:
+            out = np.empty((n_pad, d), np.float32)
+            getattr(lib, name)(
+                _ptr(indptr, ctypes.c_int64),
+                _ptr(indices, ctypes.c_int32),
+                _ptr(data, ctypes.c_float if data.dtype == np.float32
+                     else ctypes.c_double),
+                n, d, n_pad, _ptr(out, ctypes.c_float),
+            )
+            return out
+    dense = csr.toarray()
+    if n_pad == n:
+        return np.ascontiguousarray(dense.astype(dtype, copy=False))
+    out = np.zeros((n_pad, d), dtype)
+    out[:n] = dense
+    return out
+
+
+__all__ = ["available", "pad_cast", "pack_rows", "densify_csr"]

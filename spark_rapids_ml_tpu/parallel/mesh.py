@@ -79,9 +79,15 @@ def shard_rows(
     n_valid = arr.shape[0]
     rem = (-n_valid) % mesh.devices.size
     if rem or arr.dtype != dtype:
-        # single host copy fusing the dtype cast and the zero-padding
-        padded = np.zeros((n_valid + rem,) + arr.shape[1:], dtype)
-        padded[:n_valid] = arr
+        if arr.ndim == 2:
+            # single host copy fusing the dtype cast and the zero-padding;
+            # OpenMP-parallel via the native staging library when large
+            from ..native import pad_cast
+
+            padded = pad_cast(arr, n_valid + rem, dtype)
+        else:
+            padded = np.zeros((n_valid + rem,) + arr.shape[1:], dtype)
+            padded[:n_valid] = arr
     else:
         padded = arr
     sharding = NamedSharding(mesh, data_pspec(padded.ndim))
