@@ -239,6 +239,13 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
     def _fit_label_dtype(self) -> Optional[np.dtype]:
         return np.dtype(np.float32)
 
+    def _use_sparse_kernel(self, batch: _ArrayBatch) -> bool:
+        """Whether a sparse host batch should stage as ELL for a sparse
+        kernel instead of densifying (the analog of `_use_sparse_in_cuml`,
+        reference core.py:183-216).  Estimators with sparse kernels
+        override; default densifies."""
+        return False
+
     def _stage_fit_input(
         self,
         batch: _ArrayBatch,
@@ -248,12 +255,29 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
         staging loop + CumlContext entry (reference core.py:886-994)."""
         import jax
 
+        from .data import _is_sparse
+
         with TpuContext(self.num_workers, require_p2p=self._require_p2p()) as ctx:
             mesh = ctx.mesh
         n_dev = mesh.devices.size
-        X_host = _ensure_dense(batch.X)
-        dtype = self._out_dtype(X_host)
-        Xs, n_valid = shard_rows(X_host, mesh, dtype=dtype)
+        extra: Dict[str, Any] = {}
+        if self._use_sparse_kernel(batch):
+            import scipy.sparse as sp
+
+            from .ops.sparse import ell_from_csr
+
+            csr = (
+                batch.X if _is_sparse(batch.X) else sp.csr_matrix(batch.X)
+            )  # enable_sparse_data_optim=True forces sparse staging
+            vals_host, cols_host = ell_from_csr(csr)
+            dtype = self._out_dtype(vals_host)
+            Xs, n_valid = shard_rows(vals_host, mesh, dtype=dtype)
+            cols_dev, _ = shard_rows(cols_host, mesh, dtype=np.int32)
+            extra = {"ell_cols": cols_dev}
+        else:
+            X_host = _ensure_dense(batch.X)
+            dtype = self._out_dtype(X_host)
+            Xs, n_valid = shard_rows(X_host, mesh, dtype=dtype)
         n_padded = Xs.shape[0]
         w_host = np.zeros((n_padded,), dtype=dtype)
         if batch.weight is not None:
@@ -272,7 +296,7 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
             y_host[:n_valid] = batch.y.astype(ldt)
             y = jax.device_put(y_host, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
         per_shard = [n_padded // n_dev] * n_dev
-        pdesc = PartitionDescriptor.build(per_shard, X_host.shape[1])
+        pdesc = PartitionDescriptor.build(per_shard, int(batch.X.shape[1]))
         return FitInput(
             mesh=mesh,
             X=Xs,
@@ -282,6 +306,7 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
             dtype=dtype,
             n_valid=n_valid,
             params=dict(self._tpu_params),
+            extra=extra,
         )
 
     def _stage_from_device(self, ds: DeviceDataset) -> FitInput:

@@ -263,6 +263,19 @@ class LogisticRegression(
     def _fit_label_dtype(self):
         return np.dtype(np.int32)
 
+    def _use_sparse_kernel(self, batch: _ArrayBatch) -> bool:
+        # None (auto) -> sparse inputs stay sparse; True forces the sparse
+        # kernel even for dense inputs; False forces densify (reference
+        # _use_sparse_in_cuml, core.py:183-216)
+        opt = self.getOrDefault("enable_sparse_data_optim")
+        if opt is True:
+            return True
+        if opt is False:
+            return False
+        from ..data import _is_sparse
+
+        return _is_sparse(batch.X)
+
     def _validate_input(self, batch: _ArrayBatch) -> None:
         classes = np.unique(batch.y)
         if not np.all(classes == classes.astype(np.int64)):
@@ -335,11 +348,10 @@ class LogisticRegression(
         tol = float(p["tol"])
         max_iter = int(p["max_iter"])
 
-        X = fit_input.X
+        import jax
+
         w = fit_input.w
-        if standardization:
-            mean, std, _ = weighted_moments(X, w)
-            X = standardize(X, w, mean, std)
+        sparse = "ell_cols" in fit_input.extra
         kwargs = dict(
             l2=l2,
             l1=l1,
@@ -349,19 +361,58 @@ class LogisticRegression(
             history=int(p.get("lbfgs_memory", 10)),
             ls_max=int(p.get("linesearch_max_iter", 20)),
         )
-        import jax
+        mean = std = None
+        if sparse:
+            # ELL sparse path (the analog of the reference's CSR
+            # LogisticRegressionMG, classification.py:1054-1055).
+            # Standardization is std-scaling only — no centering, which
+            # preserves sparsity and (with an intercept) the same optimum.
+            from ..ops.logistic import logreg_fit_binary_ell, logreg_fit_ell
+            from ..ops.sparse import ell_scale_columns, ell_weighted_moments
 
-        if binomial:
-            coef, b, loss, n_iter = logreg_fit_binary(X, w, fit_input.y, **kwargs)
+            vals, cols = fit_input.X, fit_input.extra["ell_cols"]
+            d = fit_input.pdesc.n
+            if standardization:
+                _, std = ell_weighted_moments(vals, cols, w, d=d)
+                vals = ell_scale_columns(vals, cols, 1.0 / std)
+            if binomial:
+                coef, b, loss, n_iter = logreg_fit_binary_ell(
+                    vals, cols, w, fit_input.y, d=d, **kwargs
+                )
+            else:
+                coef, b, loss, n_iter = logreg_fit_ell(
+                    vals, cols, w, fit_input.y, n_classes=n_classes, d=d,
+                    **kwargs
+                )
         else:
-            coef, b, loss, n_iter = logreg_fit(
-                X, w, fit_input.y, n_classes=n_classes, **kwargs
-            )
+            X = fit_input.X
+            if standardization:
+                mean, std, _ = weighted_moments(X, w)
+                if fit_intercept:
+                    X = standardize(X, w, mean, std)
+                else:
+                    # no intercept to absorb a centering shift: scale only
+                    # (Spark's aggregators never center; this keeps the
+                    # optimum identical to the sparse path as well)
+                    X = standardize(
+                        X, w, jnp.zeros_like(mean), std
+                    )
+                    mean = None
+            if binomial:
+                coef, b, loss, n_iter = logreg_fit_binary(
+                    X, w, fit_input.y, **kwargs
+                )
+            else:
+                coef, b, loss, n_iter = logreg_fit(
+                    X, w, fit_input.y, n_classes=n_classes, **kwargs
+                )
         # ONE batched device->host fetch for every output (each separate
         # np.asarray/float() would pay a full host sync)
         fetch = {"coef": coef, "b": b, "loss": loss, "n_iter": n_iter}
         if standardization:
-            fetch["mean"], fetch["std"] = mean, std
+            fetch["std"] = std
+            if mean is not None:
+                fetch["mean"] = mean
         host = jax.device_get(fetch)
         loss, n_iter = host["loss"], host["n_iter"]
         if binomial:
@@ -372,10 +423,12 @@ class LogisticRegression(
             intercept = np.asarray(host["b"], np.float64)
 
         if standardization:
-            mean = np.asarray(host["mean"], np.float64)
             std = np.asarray(host["std"], np.float64)
             coef = np.where(std > 0, coef / std, coef)
-            if fit_intercept:
+            if fit_intercept and "mean" in host:
+                # dense path centers features; undo the shift (the sparse
+                # path never centers, so its intercept is already correct)
+                mean = np.asarray(host["mean"], np.float64)
                 intercept = intercept - coef @ mean
         # Spark centers multinomial intercepts (softmax shift-invariance;
         # reference classification.py:1135-1147)

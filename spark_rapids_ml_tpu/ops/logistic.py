@@ -2,9 +2,10 @@
 # Logistic regression kernel — the TPU-native replacement for
 # `LogisticRegressionMG` (L-BFGS/OWL-QN, reference classification.py:
 # 1046-1081).  The loss/grad evaluate over the row-sharded global arrays
-# (logits are one MXU matmul; XLA psums the gradient over ICI — the NCCL
-# allreduce inside the cuML kernel), and ops/lbfgs.py runs the whole solver
-# as one compiled while_loop.
+# (logits are one MXU matmul for dense rows, a gather-contract for ELL
+# sparse rows; XLA psums the gradient over ICI — the NCCL allreduce inside
+# the cuML kernel), and ops/lbfgs.py runs the whole solver as one compiled
+# while_loop.
 #
 # Spark objective (matched): 1/Σw · Σᵢ wᵢ·logloss(xᵢ,yᵢ) +
 #   regParam·[α‖β‖₁ + (1-α)/2‖β‖²], intercepts unpenalized; with
@@ -16,12 +17,108 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .lbfgs import lbfgs_minimize
+
+
+def _solve_binary(
+    margin_fn: Callable,  # beta (d,) -> margins (N_pad,)
+    d: int,
+    dtype,
+    w: jax.Array,
+    y: jax.Array,
+    l2: float,
+    l1: float,
+    fit_intercept: bool,
+    tol: float,
+    max_iter: int,
+    history: int,
+    ls_max: int,
+):
+    """Spark binomial-family solver body shared by the dense and ELL
+    kernels: a single coefficient vector β with margin m(x)+b and penalty
+    on β (NOT the softmax-2 form, whose L2 optimum differs by a factor of
+    2 in the penalty)."""
+    wsum = w.sum()
+    sgn = 2.0 * y.astype(dtype) - 1.0  # {-1, +1}
+    n_param = d + (1 if fit_intercept else 0)
+
+    def unpack(theta):
+        beta = theta[:d]
+        b = theta[d] if fit_intercept else jnp.asarray(0.0, dtype)
+        return beta, b
+
+    def loss_fn(theta):
+        beta, b = unpack(theta)
+        margin = margin_fn(beta) + b
+        # log(1 + exp(-sgn*margin)), numerically stable via softplus
+        nll = jax.nn.softplus(-sgn * margin)
+        data_loss = (nll * w).sum() / wsum
+        reg = 0.5 * l2 * (beta * beta).sum()
+        return data_loss + reg
+
+    l1_mask = jnp.concatenate(
+        [jnp.ones((d,), dtype)] + ([jnp.zeros((1,), dtype)] if fit_intercept else [])
+    )
+    theta0 = jnp.zeros((n_param,), dtype)
+    res = lbfgs_minimize(
+        loss_fn, theta0, max_iter=max_iter, tol=tol, history=history,
+        l1=l1, l1_mask=l1_mask, ls_max=ls_max,
+    )
+    beta, b = unpack(res.w)
+    return beta, b, res.f, res.n_iter
+
+
+def _solve_multinomial(
+    logits_fn: Callable,  # W (C,d) -> logits (N_pad, C)
+    C: int,
+    d: int,
+    dtype,
+    w: jax.Array,
+    y: jax.Array,
+    l2: float,
+    l1: float,
+    fit_intercept: bool,
+    tol: float,
+    max_iter: int,
+    history: int,
+    ls_max: int,
+):
+    """Softmax multinomial solver body shared by the dense and ELL kernels."""
+    wsum = w.sum()
+    y1h = jax.nn.one_hot(y, C, dtype=dtype)
+    n_coef = C * d
+    n_param = n_coef + (C if fit_intercept else 0)
+
+    def unpack(theta):
+        Wm = theta[:n_coef].reshape(C, d)
+        b = theta[n_coef:] if fit_intercept else jnp.zeros((C,), dtype)
+        return Wm, b
+
+    def loss_fn(theta):
+        Wm, b = unpack(theta)
+        logits = logits_fn(Wm) + b
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -(y1h * logp).sum(axis=1)  # padding rows weighted 0
+        data_loss = (nll * w).sum() / wsum
+        reg = 0.5 * l2 * (Wm * Wm).sum()
+        return data_loss + reg
+
+    l1_mask = jnp.concatenate(
+        [jnp.ones((n_coef,), dtype)]
+        + ([jnp.zeros((C,), dtype)] if fit_intercept else [])
+    )
+    theta0 = jnp.zeros((n_param,), dtype)
+    res = lbfgs_minimize(
+        loss_fn, theta0, max_iter=max_iter, tol=tol, history=history,
+        l1=l1, l1_mask=l1_mask, ls_max=ls_max,
+    )
+    Wm, b = unpack(res.w)
+    return Wm, b, res.f, res.n_iter
 
 
 @partial(
@@ -44,52 +141,14 @@ def logreg_fit(
     """Multinomial (n_classes>=2) logistic regression via L-BFGS/OWL-QN.
 
     X (N_pad,d) row-sharded (already standardized if requested); w validity*
-    sample weights; y int class ids (0 on padding).  Binary uses the same
-    softmax-with-2-classes parameterization internally; the caller converts
-    to Spark's binomial single-vector form.
+    sample weights; y int class ids (0 on padding).
 
     Returns (W (n_classes,d), b (n_classes,), loss, n_iter).
     """
-    n_pad, d = X.shape
-    C = n_classes
-    dtype = X.dtype
-    wsum = w.sum()
-    y1h = jax.nn.one_hot(y, C, dtype=dtype)
-
-    n_coef = C * d
-    n_param = n_coef + (C if fit_intercept else 0)
-
-    def unpack(theta):
-        Wm = theta[:n_coef].reshape(C, d)
-        b = theta[n_coef:] if fit_intercept else jnp.zeros((C,), dtype)
-        return Wm, b
-
-    def loss_fn(theta):
-        Wm, b = unpack(theta)
-        logits = X @ Wm.T + b  # (N_pad, C) — MXU
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -(y1h * logp).sum(axis=1)  # padding rows weighted 0
-        data_loss = (nll * w).sum() / wsum
-        reg = 0.5 * l2 * (Wm * Wm).sum()
-        return data_loss + reg
-
-    l1_mask = jnp.concatenate(
-        [jnp.ones((n_coef,), dtype)]
-        + ([jnp.zeros((C,), dtype)] if fit_intercept else [])
+    return _solve_multinomial(
+        lambda Wm: X @ Wm.T, n_classes, X.shape[1], X.dtype, w, y,
+        l2, l1, fit_intercept, tol, max_iter, history, ls_max,
     )
-    theta0 = jnp.zeros((n_param,), dtype)
-    res = lbfgs_minimize(
-        loss_fn,
-        theta0,
-        max_iter=max_iter,
-        tol=tol,
-        history=history,
-        l1=l1,
-        l1_mask=l1_mask,
-        ls_max=ls_max,
-    )
-    Wm, b = unpack(res.w)
-    return Wm, b, res.f, res.n_iter
 
 
 @partial(
@@ -107,49 +166,70 @@ def logreg_fit_binary(
     history: int = 10,
     ls_max: int = 20,
 ):
-    """Spark binomial-family parameterization: a single coefficient vector β
-    with margin x·β + b and penalty on β (NOT the softmax-2 form, whose L2
-    optimum differs by a factor of 2 in the penalty).
-
-    Returns (coef (d,), intercept, loss, n_iter).
-    """
-    n_pad, d = X.shape
-    dtype = X.dtype
-    wsum = w.sum()
-    sgn = 2.0 * y.astype(dtype) - 1.0  # {-1, +1}
-
-    n_param = d + (1 if fit_intercept else 0)
-
-    def unpack(theta):
-        beta = theta[:d]
-        b = theta[d] if fit_intercept else jnp.asarray(0.0, dtype)
-        return beta, b
-
-    def loss_fn(theta):
-        beta, b = unpack(theta)
-        margin = X @ beta + b
-        # log(1 + exp(-sgn*margin)), numerically stable via softplus
-        nll = jax.nn.softplus(-sgn * margin)
-        data_loss = (nll * w).sum() / wsum
-        reg = 0.5 * l2 * (beta * beta).sum()
-        return data_loss + reg
-
-    l1_mask = jnp.concatenate(
-        [jnp.ones((d,), dtype)] + ([jnp.zeros((1,), dtype)] if fit_intercept else [])
+    """Dense binary fit; returns (coef (d,), intercept, loss, n_iter)."""
+    return _solve_binary(
+        lambda beta: X @ beta, X.shape[1], X.dtype, w, y,
+        l2, l1, fit_intercept, tol, max_iter, history, ls_max,
     )
-    theta0 = jnp.zeros((n_param,), dtype)
-    res = lbfgs_minimize(
-        loss_fn,
-        theta0,
-        max_iter=max_iter,
-        tol=tol,
-        history=history,
-        l1=l1,
-        l1_mask=l1_mask,
-        ls_max=ls_max,
+
+
+@partial(
+    jax.jit,
+    static_argnames=("d", "fit_intercept", "max_iter", "history", "ls_max"),
+)
+def logreg_fit_binary_ell(
+    vals: jax.Array,  # (N_pad, K) ELL values, row-sharded
+    cols: jax.Array,  # (N_pad, K) int32 column ids
+    w: jax.Array,
+    y: jax.Array,
+    l2: float,
+    l1: float,
+    d: int = 0,
+    fit_intercept: bool = True,
+    tol: float = 1e-6,
+    max_iter: int = 100,
+    history: int = 10,
+    ls_max: int = 20,
+):
+    """Binary logistic regression over ELL sparse features (the analog of
+    the reference's CSR LogisticRegressionMG path, classification.py:
+    1054-1055).  The margin is a gather-contract; autodiff turns its
+    transpose into the scatter-add gradient, psum'd across shards."""
+    from .sparse import ell_matvec
+
+    return _solve_binary(
+        lambda beta: ell_matvec(vals, cols, beta), d, vals.dtype, w, y,
+        l2, l1, fit_intercept, tol, max_iter, history, ls_max,
     )
-    beta, b = unpack(res.w)
-    return beta, b, res.f, res.n_iter
+
+
+@partial(
+    jax.jit,
+    static_argnames=("n_classes", "d", "fit_intercept", "max_iter", "history",
+                     "ls_max"),
+)
+def logreg_fit_ell(
+    vals: jax.Array,
+    cols: jax.Array,
+    w: jax.Array,
+    y: jax.Array,
+    n_classes: int,
+    l2: float,
+    l1: float,
+    d: int = 0,
+    fit_intercept: bool = True,
+    tol: float = 1e-6,
+    max_iter: int = 100,
+    history: int = 10,
+    ls_max: int = 20,
+):
+    """Multinomial logistic regression over ELL sparse features."""
+    from .sparse import ell_matmat
+
+    return _solve_multinomial(
+        lambda Wm: ell_matmat(vals, cols, Wm), n_classes, d, vals.dtype, w, y,
+        l2, l1, fit_intercept, tol, max_iter, history, ls_max,
+    )
 
 
 @jax.jit
