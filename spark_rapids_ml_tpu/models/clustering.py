@@ -419,17 +419,19 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
             mesh=mesh,
         )
         labels = np.asarray(jax.device_get(labels))[:n_valid]
-        # renumber representatives to consecutive ids by first occurrence
+        # renumber representatives to consecutive ids by first occurrence,
+        # vectorized (a Python loop here costs seconds at benchmark scale)
         out = np.full(labels.shape, -1, np.int64)
-        next_id = 0
-        seen: Dict[int, int] = {}
-        for i, rep in enumerate(labels):
-            if rep < 0:
-                continue
-            if rep not in seen:
-                seen[rep] = next_id
-                next_id += 1
-            out[i] = seen[rep]
+        clustered = labels >= 0
+        if clustered.any():
+            uniq, first_pos, inverse = np.unique(
+                labels[clustered], return_index=True, return_inverse=True
+            )
+            # rank unique reps by first occurrence in the row order
+            order = np.argsort(first_pos, kind="stable")
+            rank = np.empty_like(order)
+            rank[order] = np.arange(order.size)
+            out[clustered] = rank[inverse]
         return {self.getOrDefault("predictionCol"): out}
 
     def cpu(self):

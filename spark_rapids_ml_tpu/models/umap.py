@@ -338,6 +338,14 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         from ..parallel.mesh import DATA_AXIS, shard_rows
 
         k = int(float(self._tpu_params["n_neighbors"]))
+        if k > self.raw_data_.shape[0]:
+            # beyond the valid items the ring kernel emits id -1, which JAX's
+            # clamped gathers would silently turn into wrong embeddings —
+            # raise like NearestNeighborsModel._search does
+            raise ValueError(
+                f"n_neighbors={k} exceeds the {self.raw_data_.shape[0]} "
+                f"training rows in the model"
+            )
         Xq = np.ascontiguousarray(X, dtype=self._out_dtype(X))
         items = self.raw_data_
         if str(self._tpu_params.get("metric", "euclidean")) == "cosine":

@@ -41,15 +41,29 @@ from ..parallel.mesh import DATA_AXIS
 GINI, ENTROPY, VARIANCE = 0, 1, 2  # split criteria
 
 
-def compute_bin_edges(X: jax.Array, n_bins: int) -> jax.Array:
-    """(n_bins-1, d) interior quantile boundaries from the local rows."""
+def compute_bin_edges(
+    X: jax.Array, n_bins: int, valid: jax.Array | None = None
+) -> jax.Array:
+    """(n_bins-1, d) interior quantile boundaries from the local rows.
+
+    Zero-padding and zero-weight rows are pushed past the last quantile
+    (+inf before the sort) so they cannot skew the edges toward 0; the
+    quantile positions index over the *valid* row count."""
     m, d = X.shape
+    if valid is not None:
+        ok = valid > 0
+        X = jnp.where(ok[:, None], X, jnp.inf)
+        n_eff = ok.sum().astype(jnp.int32)
+    else:
+        n_eff = jnp.int32(m)
     Xs = jnp.sort(X, axis=0)
-    # edge j at quantile (j+1)/n_bins
+    # edge j at quantile (j+1)/n_bins of the valid rows
     qidx = jnp.clip(
-        ((jnp.arange(1, n_bins) * m) // n_bins).astype(jnp.int32), 0, m - 1
+        ((jnp.arange(1, n_bins) * n_eff) // n_bins).astype(jnp.int32), 0, m - 1
     )
-    return Xs[qidx, :]  # (n_bins-1, d)
+    edges = Xs[qidx, :]  # (n_bins-1, d)
+    # guard against inf edges when a shard is mostly padding
+    return jnp.where(jnp.isfinite(edges), edges, jnp.finfo(X.dtype).max)
 
 
 def digitize(X: jax.Array, edges: jax.Array) -> jax.Array:
@@ -236,7 +250,7 @@ def forest_fit(
             statsl = (
                 yl.astype(jnp.int32)[:, None] == jnp.arange(n_classes)[None, :]
             ).astype(Xl.dtype)
-        edges = compute_bin_edges(Xl, n_bins)
+        edges = compute_bin_edges(Xl, n_bins, valid=validl)
         Xb = digitize(Xl, edges)
         widx = jax.lax.axis_index(DATA_AXIS)
         base = jax.random.fold_in(jax.random.PRNGKey(seed), widx)

@@ -37,9 +37,34 @@ class _FacadeBase:
         }
 
     def set_params(self, **params: Any):
+        known = set(self._param_names())
+        unknown = {k: v for k, v in params.items() if k not in known}
+        if unknown:
+            # real sklearn raises here; the facade warns so grid searches
+            # over unsupported params are at least visibly no-ops
+            self._warn_ignored(unknown)
         for k, v in params.items():
-            setattr(self, k, v)
+            if k in known:
+                setattr(self, k, v)
         return self
+
+    def _warn_ignored(self, ignored: dict) -> None:
+        """Unknown sklearn kwargs are accepted (so drop-in scripts run) but
+        announced: silently diverging from sklearn behavior (class_weight=,
+        dual=, solver=, ...) is worse than a warning."""
+        # sklearn passes defaults explicitly through clone(); only values
+        # that differ from "unset" are worth flagging
+        noisy = {k: v for k, v in ignored.items() if v is not None}
+        if noisy:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: ignoring unsupported sklearn "
+                f"parameters {sorted(noisy)}; results may differ from "
+                f"sklearn if these were set deliberately.",
+                UserWarning,
+                stacklevel=3,
+            )
 
 
 def _max_features_to_strategy(mf: Any) -> str:
@@ -66,6 +91,7 @@ class KMeans(_FacadeBase):
         random_state: Optional[int] = None,
         **_ignored: Any,
     ) -> None:
+        self._warn_ignored(_ignored)
         if not isinstance(init, str):
             raise NotImplementedError(
                 "explicit initial centers (ndarray init) are not supported; "
@@ -123,6 +149,7 @@ class DBSCAN(_FacadeBase):
         metric: str = "euclidean",
         **_ignored: Any,
     ) -> None:
+        self._warn_ignored(_ignored)
         self.eps = eps
         self.min_samples = min_samples
         self.metric = metric
@@ -146,6 +173,7 @@ class PCA(_FacadeBase):
     """sklearn.decomposition.PCA-style facade over models.feature.PCA."""
 
     def __init__(self, n_components: Any = None, **_ignored: Any) -> None:
+        self._warn_ignored(_ignored)
         if n_components == "mle":
             raise NotImplementedError(
                 "n_components='mle' is not supported; pass an int or a "
@@ -191,6 +219,7 @@ class LinearRegression(_FacadeBase):
     """sklearn.linear_model.LinearRegression-style facade."""
 
     def __init__(self, *, fit_intercept: bool = True, **_ignored: Any) -> None:
+        self._warn_ignored(_ignored)
         self.fit_intercept = fit_intercept
 
     def fit(self, X, y, sample_weight=None) -> "LinearRegression":
@@ -217,7 +246,7 @@ class LogisticRegression(_FacadeBase):
     def __init__(
         self,
         *,
-        penalty: Optional[str] = "l2",
+        penalty: Optional[str] = "deprecated",  # sklearn 1.9's unset sentinel
         C: float = 1.0,
         l1_ratio: Optional[float] = None,
         fit_intercept: bool = True,
@@ -225,6 +254,7 @@ class LogisticRegression(_FacadeBase):
         tol: float = 1e-4,
         **_ignored: Any,
     ) -> None:
+        self._warn_ignored(_ignored)
         self.penalty = penalty
         self.C = C
         self.l1_ratio = l1_ratio
@@ -235,16 +265,36 @@ class LogisticRegression(_FacadeBase):
     def fit(self, X, y, sample_weight=None) -> "LogisticRegression":
         from .models.classification import LogisticRegression as TpuLogReg
 
-        # sklearn penalty -> (regParam, elasticNetParam)
+        # sklearn penalty -> (regParam, elasticNetParam).  sklearn minimizes
+        # C·Σᵢ logloss + penalty(β) while the backend objective
+        # (ops/logistic.py) is (Σ wᵢ logloss)/W + regParam·penalty(β) with
+        # W = Σ wᵢ; dividing sklearn's objective by C·W shows the equivalent
+        # regParam is 1/(C·W), not 1/C.
+        W = (
+            float(np.sum(sample_weight))
+            if sample_weight is not None
+            else float(np.shape(X)[0])
+        )
+        inv_cw = 1.0 / (self.C * W) if self.C > 0 and W > 0 else 0.0
         if self.penalty is None or self.penalty == "none":
             reg, l1r = 0.0, 0.0
+        elif self.penalty == "deprecated":
+            # sklearn 1.9's unset sentinel: the l1_ratio-only API governs
+            # (l1_ratio=1 == l1, 0/None == l2)
+            reg = inv_cw
+            l1r = float(self.l1_ratio) if self.l1_ratio is not None else 0.0
         elif self.penalty == "l2":
-            reg, l1r = 1.0 / self.C if self.C > 0 else 0.0, 0.0
+            # an explicitly named penalty wins over l1_ratio, matching
+            # released sklearn (which ignores l1_ratio unless elasticnet)
+            reg, l1r = inv_cw, 0.0
         elif self.penalty == "l1":
-            reg, l1r = 1.0 / self.C if self.C > 0 else 0.0, 1.0
+            reg, l1r = inv_cw, 1.0
         elif self.penalty == "elasticnet":
-            reg = 1.0 / self.C if self.C > 0 else 0.0
-            l1r = self.l1_ratio or 0.0
+            if self.l1_ratio is None:
+                raise ValueError(
+                    "l1_ratio must be specified when penalty is elasticnet"
+                )
+            reg, l1r = inv_cw, float(self.l1_ratio)
         else:
             raise ValueError(f"Unsupported penalty: {self.penalty}")
         est = TpuLogReg(
@@ -286,8 +336,14 @@ class RandomForestClassifier(_FacadeBase):
         random_state: Optional[int] = None,
         **_ignored: Any,
     ) -> None:
+        self._warn_ignored(_ignored)
         self.n_estimators = n_estimators
-        self.max_depth = max_depth if max_depth is not None else 16
+        # sklearn's max_depth=None means unbounded; the level-wise histogram
+        # builder allocates a (2^level · n_bins, d, S) histogram per level
+        # under vmap, so depth is capped at 12 here (≈4096·n_bins leaf slots)
+        # to keep sklearn-default calls inside HBM.  Pass max_depth explicitly
+        # for deeper trees.
+        self.max_depth = max_depth if max_depth is not None else 12
         self.criterion = criterion
         self.max_features = max_features
         self.bootstrap = bootstrap
@@ -336,8 +392,10 @@ class RandomForestRegressor(_FacadeBase):
         random_state: Optional[int] = None,
         **_ignored: Any,
     ) -> None:
+        self._warn_ignored(_ignored)
         self.n_estimators = n_estimators
-        self.max_depth = max_depth if max_depth is not None else 16
+        # depth-capped default: see RandomForestClassifier.__init__
+        self.max_depth = max_depth if max_depth is not None else 12
         self.max_features = max_features
         self.bootstrap = bootstrap
         self.random_state = random_state
@@ -369,6 +427,7 @@ class NearestNeighbors(_FacadeBase):
     """sklearn.neighbors.NearestNeighbors-style facade."""
 
     def __init__(self, *, n_neighbors: int = 5, **_ignored: Any) -> None:
+        self._warn_ignored(_ignored)
         self.n_neighbors = n_neighbors
 
     def fit(self, X, y=None) -> "NearestNeighbors":

@@ -65,6 +65,62 @@ def test_facade_rf_and_knn(patched, rng):
     assert np.array_equal(idx[:, 0], np.arange(5))
 
 
+@pytest.mark.parametrize("penalty,C", [("l2", 1.0), ("l2", 0.1), ("l1", 1.0)])
+def test_facade_logreg_matches_sklearn_regularization(patched, rng, penalty, C):
+    # ADVICE r1 (high): the facade must map sklearn C to regParam=1/(C*n),
+    # not 1/C — the backend normalizes the data loss by sum of weights.
+    # Compare coefficients against real sklearn at matched settings.
+    from sklearn.linear_model import LogisticRegression  # patched facade
+    from sklearn.linear_model._logistic import (
+        LogisticRegression as SkLogReg,  # the real sklearn class
+    )
+
+    X = rng.normal(size=(400, 6)).astype(np.float64)
+    beta = np.array([1.5, -2.0, 0.7, 0.0, 0.0, 1.0])
+    y = (X @ beta + 0.3 * rng.normal(size=400) > 0).astype(float)
+
+    ours = LogisticRegression(penalty=penalty, C=C, max_iter=200, tol=1e-8)
+    ref = SkLogReg(
+        penalty=penalty,
+        C=C,
+        max_iter=2000,
+        tol=1e-10,
+        solver="liblinear" if penalty == "l1" else "lbfgs",
+    )
+    ours.fit(X, y)
+    ref.fit(X, y)
+    assert np.allclose(ours.coef_.ravel(), ref.coef_.ravel(), atol=0.08), (
+        ours.coef_.ravel(),
+        ref.coef_.ravel(),
+    )
+
+
+def test_facade_logreg_l1_ratio_only_api(patched, rng):
+    # sklearn 1.9 deprecates penalty= in favor of l1_ratio-only; the facade
+    # must honor l1_ratio=1.0 (pure l1) without penalty='elasticnet'
+    from sklearn.linear_model import LogisticRegression
+
+    X = rng.normal(size=(300, 8)).astype(np.float64)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    m = LogisticRegression(l1_ratio=1.0, C=0.02, max_iter=200).fit(X, y)
+    coef = m.coef_.ravel()
+    # strong l1 at small C must zero out the 6 irrelevant features
+    assert (np.abs(coef[2:]) < 1e-3).all(), coef
+
+
+def test_facade_warns_on_ignored_kwargs(patched):
+    from sklearn.linear_model import LogisticRegression
+
+    with pytest.warns(UserWarning, match="class_weight"):
+        LogisticRegression(class_weight="balanced")
+    with pytest.warns(UserWarning, match="solver"):
+        LogisticRegression().set_params(solver="saga")
+    with pytest.raises(ValueError, match="l1_ratio must be specified"):
+        LogisticRegression(penalty="elasticnet").fit(
+            np.zeros((4, 2)), np.array([0.0, 1.0, 0.0, 1.0])
+        )
+
+
 def test_main_runner(tmp_path):
     script = tmp_path / "user_script.py"
     script.write_text(textwrap.dedent("""
