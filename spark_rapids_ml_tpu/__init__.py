@@ -25,6 +25,7 @@ __version__ = "0.1.0"
 from . import config  # noqa: F401
 from . import evaluation, metrics, pipeline, tuning  # noqa: F401
 from .data import DeviceDataset  # noqa: F401
+from .parallel import init_distributed  # noqa: F401
 
 # Re-export algorithm modules at the top level so imports mirror the
 # reference package layout (`spark_rapids_ml.feature` etc., reference

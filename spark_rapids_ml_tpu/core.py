@@ -24,8 +24,7 @@ import numpy as np
 
 from .data import DatasetLike, DeviceDataset, _ensure_dense, extract_arrays
 from .params import Param, Params, _TpuParams
-from .parallel import TpuContext, get_mesh, replicate, shard_rows
-from .parallel.mesh import row_mask
+from .parallel import TpuContext
 from .utils import PartitionDescriptor, _ArrayBatch, get_logger
 
 
@@ -252,10 +251,14 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
         paramMaps: Optional[Sequence[Dict[str, Any]]] = None,
     ) -> FitInput:
         """Stage host arrays onto the mesh — the analog of the executor-side
-        staging loop + CumlContext entry (reference core.py:886-994)."""
-        import jax
+        staging loop + CumlContext entry (reference core.py:886-994).
 
+        In multi-process (pod) mode, `batch` holds only this process's LOCAL
+        rows; the `RowStager` assembles the global sharded arrays without
+        any process materializing the full dataset (the analog of each
+        Spark barrier task staging its own partition)."""
         from .data import _is_sparse
+        from .parallel.mesh import RowStager
 
         with TpuContext(self.num_workers, require_p2p=self._require_p2p()) as ctx:
             mesh = ctx.mesh
@@ -270,31 +273,40 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
                 batch.X if _is_sparse(batch.X) else sp.csr_matrix(batch.X)
             )  # enable_sparse_data_optim=True forces sparse staging
             vals_host, cols_host = ell_from_csr(csr)
+            import jax
+
+            if jax.process_count() > 1:
+                # the ELL width K is the LOCAL max nnz/row; processes must
+                # agree on the global array shape, so widen to the global max
+                from jax.experimental import multihost_utils
+
+                k_all = np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray(vals_host.shape[1], np.int64)
+                    )
+                ).reshape(-1)
+                k_max = int(k_all.max())
+                if vals_host.shape[1] < k_max:
+                    # widen with the (0.0, col 0) no-op entries ell_from_csr
+                    # uses for its own padding
+                    pad = k_max - vals_host.shape[1]
+                    vals_host = np.pad(vals_host, ((0, 0), (0, pad)))
+                    cols_host = np.pad(cols_host, ((0, 0), (0, pad)))
             dtype = self._out_dtype(vals_host)
-            Xs, n_valid = shard_rows(vals_host, mesh, dtype=dtype)
-            cols_dev, _ = shard_rows(cols_host, mesh, dtype=np.int32)
-            extra = {"ell_cols": cols_dev}
+            st = RowStager(vals_host.shape[0], mesh)
+            Xs = st.stage(vals_host, dtype)
+            extra = {"ell_cols": st.stage(cols_host, np.int32)}
         else:
             X_host = _ensure_dense(batch.X)
             dtype = self._out_dtype(X_host)
-            Xs, n_valid = shard_rows(X_host, mesh, dtype=dtype)
+            st = RowStager(X_host.shape[0], mesh)
+            Xs = st.stage(X_host, dtype)
         n_padded = Xs.shape[0]
-        w_host = np.zeros((n_padded,), dtype=dtype)
-        if batch.weight is not None:
-            w_host[:n_valid] = batch.weight.astype(dtype)
-        else:
-            w_host[:n_valid] = 1.0
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        from .parallel.mesh import DATA_AXIS
-
-        w = jax.device_put(w_host, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+        w = st.mask(dtype, weights=batch.weight)
         y = None
         if batch.y is not None:
             ldt = self._fit_label_dtype() or dtype
-            y_host = np.zeros((n_padded,), dtype=ldt)
-            y_host[:n_valid] = batch.y.astype(ldt)
-            y = jax.device_put(y_host, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+            y = st.stage(np.asarray(batch.y).reshape(-1).astype(ldt), ldt)
         per_shard = [n_padded // n_dev] * n_dev
         pdesc = PartitionDescriptor.build(per_shard, int(batch.X.shape[1]))
         return FitInput(
@@ -304,7 +316,7 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
             y=y,
             pdesc=pdesc,
             dtype=dtype,
-            n_valid=n_valid,
+            n_valid=st.n_valid,
             params=dict(self._tpu_params),
             extra=extra,
         )

@@ -169,12 +169,14 @@ class DeviceDataset:
     `DeviceDataset.persist(dataset, ...)`.
     """
 
-    def __init__(self, mesh, X, n_valid: int, y=None, weight=None) -> None:
+    def __init__(self, mesh, X, n_valid: int, y=None, weight=None,
+                 stager=None) -> None:
         self.mesh = mesh
         self.X = X  # jax.Array (N_pad, d), rows sharded over DATA_AXIS
         self.y = y  # jax.Array (N_pad,) or None
         self.weight = weight  # jax.Array (N_pad,) validity * sample weight
         self.n_valid = int(n_valid)
+        self._stager = stager  # RowStager used at staging (padding layout)
 
     @property
     def shape(self) -> Tuple[int, int]:
@@ -184,6 +186,21 @@ class DeviceDataset:
         """Pull the valid rows back to host (used by CPU-fallback fits)."""
         import jax
 
+        if self._stager is not None:
+            # honors the staging layout: multi-process padding interleaves
+            # at each process-block tail, and sharded arrays are not fully
+            # addressable from one process — RowStager.fetch handles both
+            st = self._stager
+            return _ArrayBatch(
+                X=st.fetch(self.X),
+                y=st.fetch(self.y) if self.y is not None else None,
+                weight=st.fetch(self.weight) if self.weight is not None else None,
+            )
+        if jax.process_count() > 1:
+            raise RuntimeError(
+                "to_host_batch on a directly-constructed DeviceDataset is "
+                "single-process only; build via DeviceDataset.from_host"
+            )
         fetch = {"X": self.X}
         if self.y is not None:
             fetch["y"] = self.y
@@ -207,28 +224,20 @@ class DeviceDataset:
         dtype: Union[np.dtype, type] = np.float32,
         label_dtype: Union[np.dtype, type, None] = None,
     ) -> "DeviceDataset":
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-
         from .parallel import get_mesh
-        from .parallel.mesh import DATA_AXIS, shard_rows
+        from .parallel.mesh import RowStager
 
         dtype = np.dtype(dtype)
         mesh = get_mesh(num_workers)
         X = _ensure_dense(np.asarray(X))
-        Xs, n_valid = shard_rows(X, mesh, dtype=dtype)
-        n_padded = Xs.shape[0]
-        pspec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
-        w_host = np.zeros((n_padded,), dtype=dtype)
-        w_host[:n_valid] = 1.0 if weight is None else np.asarray(weight, dtype)
-        w = jax.device_put(w_host, pspec)
+        st = RowStager(X.shape[0], mesh)
+        Xs = st.stage(X, dtype)
+        w = st.mask(dtype, weights=weight)
         yd = None
         if y is not None:
             ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
-            y_host = np.zeros((n_padded,), dtype=ldt)
-            y_host[:n_valid] = np.asarray(y).reshape(-1).astype(ldt)
-            yd = jax.device_put(y_host, pspec)
-        return cls(mesh, Xs, n_valid, y=yd, weight=w)
+            yd = st.stage(np.asarray(y).reshape(-1).astype(ldt), ldt)
+        return cls(mesh, Xs, st.n_valid, y=yd, weight=w, stager=st)
 
     @classmethod
     def persist(

@@ -398,7 +398,6 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
 
         from ..ops.dbscan import dbscan_fit_predict
         from ..parallel import TpuContext
-        from ..parallel.mesh import row_mask, shard_rows
 
         eps = float(self._tpu_params["eps"])
         if str(self._tpu_params.get("metric", "euclidean")) == "cosine":
@@ -410,15 +409,18 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
         with TpuContext(self.num_workers, require_p2p=True) as ctx:
             mesh = ctx.mesh
         dtype = self._out_dtype(X)
-        Xs, n_valid = shard_rows(X, mesh, dtype=dtype)
-        valid = row_mask(n_valid, Xs.shape[0], mesh, dtype=dtype)
+        from ..parallel.mesh import RowStager
+
+        st = RowStager.for_replicated(X.shape[0], mesh)
+        Xs = st.stage(X, dtype)
+        valid = st.mask(dtype)
         labels, _core = dbscan_fit_predict(
             Xs, valid,
             jnp.asarray(eps, dtype),
             jnp.asarray(int(self._tpu_params["min_samples"]), jnp.int32),
             mesh=mesh,
         )
-        labels = np.asarray(jax.device_get(labels))[:n_valid]
+        labels = st.fetch(labels)
         # renumber representatives to consecutive ids by first occurrence,
         # vectorized (a Python loop here costs seconds at benchmark scale)
         out = np.full(labels.shape, -1, np.int64)

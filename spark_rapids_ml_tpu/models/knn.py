@@ -64,7 +64,9 @@ class _KNNParams(_TpuParams, HasFeaturesCol, HasFeaturesCols, HasIDCol):
         return self._set_params(idCol=value)
 
 
-def _extract_with_ids(inst, dataset: DatasetLike) -> Tuple[np.ndarray, np.ndarray, Any]:
+def _extract_with_ids(
+    inst, dataset: DatasetLike
+) -> Tuple[np.ndarray, np.ndarray, Any, bool]:
     """Extract (X, ids, source_frame).  The analog of `_ensureIdCol`
     (reference params.py:91-129): when the user names an idCol it is read
     from the dataset, otherwise monotonically-increasing row ids are
@@ -88,10 +90,27 @@ def _extract_with_ids(inst, dataset: DatasetLike) -> Tuple[np.ndarray, np.ndarra
     X = _ensure_dense(batch.X)
     if batch.row_id is not None:
         ids = np.asarray(batch.row_id)
+        auto_ids = False
     else:
         ids = np.arange(X.shape[0], dtype=np.int64)
+        auto_ids = True
     df = dataset if isinstance(dataset, pd.DataFrame) else None
-    return X, ids, df
+    return X, ids, df, auto_ids
+
+
+def _gather_items(X: np.ndarray, ids: np.ndarray, auto_ids: bool):
+    """Multi-process item gather for the replicated-model contract.  Auto-
+    generated ids are LOCAL positions per process; regenerate them as global
+    positions after the gather so they match single-process numbering
+    (user-provided idCol values pass through untouched)."""
+    from ..parallel.mesh import allgather_host_rows
+
+    X = allgather_host_rows(X)
+    if auto_ids:
+        ids = np.arange(X.shape[0], dtype=np.int64)
+    else:
+        ids = allgather_host_rows(ids)
+    return X, ids
 
 
 def _assemble_knn_df(q_ids, indices, dist, sort_by_query_id: bool):
@@ -162,7 +181,7 @@ class _NNModelBase(_TpuModel):
         slots are id -1 at distance inf)."""
         import pandas as pd
 
-        Q, q_ids, q_df = _extract_with_ids(self, query_df)
+        Q, q_ids, q_df, _ = _extract_with_ids(self, query_df)
         k = int(self._tpu_params.get("n_neighbors", self.getOrDefault("k")))
         dist, pos = self._search(np.asarray(Q), k)
         indices = np.where(pos >= 0, self.item_ids[np.maximum(pos, 0)], -1)
@@ -222,7 +241,11 @@ class NearestNeighbors(_NNClass, _TpuEstimator, _KNNParams):
         self._set_params(**kwargs)
 
     def _fit(self, dataset: DatasetLike) -> "NearestNeighborsModel":
-        X, ids, df = _extract_with_ids(self, dataset)
+        X, ids, df, auto_ids = _extract_with_ids(self, dataset)
+        # multi-process: each process fit() sees its local items; the model
+        # holds the replicated full item set (the framework contract: model
+        # attributes are identical host state on every process)
+        X, ids = _gather_items(np.asarray(X), np.asarray(ids), auto_ids)
         model = NearestNeighborsModel(
             item_features=np.asarray(X),
             item_ids=ids,
@@ -252,37 +275,28 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
 
     def _staged_items(self, mesh, dtype):
         """Item rows + validity + positional ids staged onto the mesh once
-        and reused across kneighbors calls."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        from ..parallel.mesh import DATA_AXIS, shard_rows
+        and reused across kneighbors calls.  The item arrays are replicated
+        host state (model attributes), so `RowStager.for_replicated` shards
+        them without duplication across processes; positional ids
+        (remapped to user ids on the host afterwards, as the reference
+        remaps cuml row ids, knn.py:787-801) come from the same layout."""
+        from ..parallel.mesh import RowStager
 
         key = (id(mesh), str(dtype))
         if self._device_items is not None and self._device_items[0] == key:
             return self._device_items[1]
-        items, n_items = shard_rows(self.item_features, mesh, dtype=dtype)
-        n_pad = items.shape[0]
-        valid_host = np.zeros((n_pad,), dtype=dtype)
-        valid_host[:n_items] = 1.0
-        # int32 positional ids; remapped to user ids on the host afterwards
-        # (the reference remaps cuml row ids the same way, knn.py:787-801)
-        ids_host = np.full((n_pad,), -1, dtype=np.int32)
-        ids_host[:n_items] = np.arange(n_items, dtype=np.int32)
-        spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
-        staged = (items, jax.device_put(valid_host, spec),
-                  jax.device_put(ids_host, spec))
+        st = RowStager.for_replicated(self.item_features.shape[0], mesh)
+        staged = (st.stage(self.item_features, dtype), st.mask(dtype),
+                  st.row_ids())
         self._device_items = (key, staged)
         return staged
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Distributed ring brute force; (metric distances, positional
         indices) trimmed of padding."""
-        import jax
-
         from ..ops.knn import knn_ring_topk, knn_topk_local
         from ..parallel import TpuContext
-        from ..parallel.mesh import shard_rows
+        from ..parallel.mesh import RowStager
 
         n_items = self.item_features.shape[0]
         if k > n_items:
@@ -291,13 +305,13 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
             mesh = ctx.mesh
         dtype = self._out_dtype(self.item_features)
         items, valid, ids = self._staged_items(mesh, dtype)
-        queries, n_q = shard_rows(np.asarray(Q), mesh, dtype=dtype)
+        qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
+        queries = qst.stage(np.asarray(Q), dtype)
         if mesh.devices.size == 1:
             d2, idx = knn_topk_local(items, valid, ids, queries, k=k)
         else:
             d2, idx = knn_ring_topk(items, valid, ids, queries, k=k, mesh=mesh)
-        d2, idx = jax.device_get((d2, idx))
-        return self._apply_metric(np.asarray(d2)[:n_q]), np.asarray(idx)[:n_q]
+        return self._apply_metric(qst.fetch(d2)), qst.fetch(idx)
 
     def exactNearestNeighborsJoin(self, query_df: DatasetLike, distCol: str = "distCol"):
         """Flattened (item_id, query_id, distance) join — reference
@@ -401,7 +415,10 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
     def _fit(self, dataset: DatasetLike) -> "ApproximateNearestNeighborsModel":
         from ..ops import ivf as ivf_ops
 
-        X, ids, df = _extract_with_ids(self, dataset)
+        X, ids, df, auto_ids = _extract_with_ids(self, dataset)
+        # replicated-model contract in multi-process mode (see
+        # NearestNeighbors._fit); each process builds the identical index
+        X, ids = _gather_items(np.asarray(X), np.asarray(ids), auto_ids)
         X = np.ascontiguousarray(X, dtype=np.float32)
         algo = str(self._tpu_params.get("algorithm", "ivfflat")).lower()
         if algo not in _SUPPORTED_ANN_ALGOS:
@@ -485,16 +502,15 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         return self._device_index[1]
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
-        import jax
-
         from ..ops import ivf as ivf_ops
         from ..parallel import TpuContext
-        from ..parallel.mesh import shard_rows
+        from ..parallel.mesh import RowStager
 
         with TpuContext(self.num_workers) as ctx:
             mesh = ctx.mesh
         Q = np.ascontiguousarray(Q, dtype=np.float32)
-        Qs, n_q = shard_rows(Q, mesh, dtype=np.float32)
+        qst = RowStager.for_replicated(Q.shape[0], mesh)
+        Qs = qst.stage(Q, np.float32)
         ap = dict(self._tpu_params.get("algo_params") or {})
         nprobe = int(ap.get("nprobe", 20))
         nprobe = max(1, min(nprobe, self.nlist_))
@@ -518,8 +534,7 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
             )
             if k2 > k:  # exact re-rank of the PQ shortlist (cuVS `refine`,
                 # reference knn.py:1627-1657)
-                d2, pos = jax.device_get((d2, pos))
-                d2, pos = d2[:n_q], pos[:n_q]
+                d2, pos = qst.fetch(d2), qst.fetch(pos)
                 safe = np.maximum(pos, 0)
                 cand = self.item_features[safe]  # (q, k2, d)
                 diff = cand - Q[:, None, :]
@@ -530,8 +545,7 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
                     self._apply_metric(np.take_along_axis(exact, order, axis=1)),
                     np.take_along_axis(pos, order, axis=1),
                 )
-        d2, pos = jax.device_get((d2, pos))
-        return self._apply_metric(np.asarray(d2)[:n_q]), np.asarray(pos)[:n_q]
+        return self._apply_metric(qst.fetch(d2)), qst.fetch(pos)
 
     def approxSimilarityJoin(self, query_df: DatasetLike, distCol: str = "distCol"):
         """Flattened approximate join (reference knn.py:1671-1729); slots

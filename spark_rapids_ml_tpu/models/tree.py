@@ -304,7 +304,12 @@ class _RandomForestEstimator(
             subsample=float(p["max_samples"]),
             mesh=mesh,
         )
-        host = jax.device_get(trees)
+        from ..parallel.mesh import fetch_replicated
+
+        # the tree axis is sharded over the mesh (trees_per_worker blocks);
+        # fetch_replicated also handles the multi-process case where the
+        # sharded array is not fully addressable from one process
+        host = type(trees)(*(fetch_replicated(t, mesh) for t in trees))
         return {
             "feature": np.asarray(host.feature)[:n_trees],
             "threshold": np.asarray(host.threshold)[:n_trees],

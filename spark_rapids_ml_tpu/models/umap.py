@@ -193,6 +193,12 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         rs = p.get("random_state")
         seed = int(rs) if rs is not None else 42
 
+        from ..parallel.mesh import allgather_host_rows
+
+        # single-worker fit strategy (the reference forces UMAP fit onto one
+        # worker, umap.py:926-948): in multi-process mode every process
+        # gathers the full sample and computes the identical model
+        X = allgather_host_rows(X)
         frac = float(p.get("sample_fraction", 1.0))
         if frac < 1.0:
             rng = np.random.default_rng(seed)
@@ -328,14 +334,11 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         return [self.getOrDefault("outputCol")]
 
     def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        import jax
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec
 
         from ..ops.knn import knn_ring_topk, knn_topk_local
         from ..ops.umap import transform_init
         from ..parallel import TpuContext
-        from ..parallel.mesh import DATA_AXIS, shard_rows
 
         k = int(float(self._tpu_params["n_neighbors"]))
         if k > self.raw_data_.shape[0]:
@@ -357,16 +360,14 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         with TpuContext(self.num_workers, require_p2p=True) as ctx:
             mesh = ctx.mesh
         dtype = Xq.dtype
-        Xi, n_items = shard_rows(items, mesh, dtype=dtype)
-        n_pad = Xi.shape[0]
-        valid = np.zeros((n_pad,), dtype)
-        valid[:n_items] = 1.0
-        ids = np.full((n_pad,), -1, np.int32)
-        ids[:n_items] = np.arange(n_items, dtype=np.int32)
-        spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
-        validd = jax.device_put(valid, spec)
-        idsd = jax.device_put(ids, spec)
-        Qs, n_q = shard_rows(Xq, mesh, dtype=dtype)
+        from ..parallel.mesh import RowStager
+
+        ist = RowStager.for_replicated(items.shape[0], mesh)
+        Xi = ist.stage(items, dtype)
+        validd = ist.mask(dtype)
+        idsd = ist.row_ids()
+        qst = RowStager.for_replicated(Xq.shape[0], mesh)
+        Qs = qst.stage(Xq, dtype)
         if mesh.devices.size == 1:
             d2, inds = knn_topk_local(Xi, validd, idsd, Qs, k=k)
         else:
@@ -379,8 +380,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             jnp.asarray(self.sigma_.astype(dtype)),
             jnp.asarray(self.embedding_.astype(dtype)),
         )
-        emb = np.asarray(jax.device_get(emb))[:n_q]
-        return {self.getOrDefault("outputCol"): emb}
+        return {self.getOrDefault("outputCol"): qst.fetch(emb)}
 
     def _get_model_attributes(self) -> Dict[str, Any]:
         return {

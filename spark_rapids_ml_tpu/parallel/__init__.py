@@ -9,10 +9,11 @@
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
+    RowStager,
     get_mesh,
     replicate,
     shard_rows,
     data_pspec,
     replicated_pspec,
 )
-from .context import TpuContext  # noqa: F401
+from .context import TpuContext, init_distributed  # noqa: F401

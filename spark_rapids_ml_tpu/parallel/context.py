@@ -24,6 +24,75 @@ from ..config import get_config
 from ..utils import get_logger
 from .mesh import get_mesh
 
+_distributed_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bootstrap `jax.distributed` for multi-host (pod) fits — the analog of
+    the reference's NCCL-uid allGather bootstrap (cuml_context.py:96-102).
+
+    Resolution order for the coordinator:
+      1. explicit arguments,
+      2. library config (`set_config(coordinator_address=..., ...)` or the
+         `SPARK_RAPIDS_ML_TPU_COORDINATOR_ADDRESS` env tier),
+      3. ambient cluster environment (TPU pod metadata / `JAX_COORDINATOR_*`
+         / SLURM / OMPI vars), which `jax.distributed.initialize()` reads
+         with no arguments.
+
+    Call this before any other JAX use on each process.  Returns True if
+    distributed mode was (already) initialized, False when no coordinator
+    could be resolved (single-host mode).  Idempotent.
+    """
+    global _distributed_initialized
+    # NB: do not touch jax.process_count()/jax.devices() here — they
+    # initialize the XLA backend, after which distributed init is rejected
+    if _distributed_initialized or jax.distributed.is_initialized():
+        _distributed_initialized = True
+        return True
+    coord = coordinator_address or get_config("coordinator_address")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=(
+                num_processes
+                if num_processes is not None
+                else get_config("num_processes")
+            ),
+            process_id=(
+                process_id if process_id is not None else get_config("process_id")
+            ),
+        )
+        _distributed_initialized = True
+        return True
+    import os
+
+    env_indicated = any(
+        v in os.environ
+        for v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
+    )
+    try:
+        # cluster auto-detection: jax resolves the coordinator itself on
+        # TPU pods (metadata server), GKE, SLURM and OMPI; on plain
+        # single-host machines it raises, which means single-host mode
+        jax.distributed.initialize()
+    except (ValueError, RuntimeError) as e:
+        if env_indicated:
+            # the environment names a coordinator: a bootstrap failure here
+            # is a real error, not "no cluster" — silently degrading would
+            # fit a different model on every host
+            raise
+        get_logger("spark_rapids_ml_tpu.init_distributed").debug(
+            f"no cluster auto-detected ({type(e).__name__}: {e}); "
+            "running single-host"
+        )
+        return False
+    _distributed_initialized = True
+    return True
+
 
 class TpuContext:
     """Context manager wrapping one distributed fit.
@@ -34,8 +103,6 @@ class TpuContext:
     num_processes) the first time, mirroring CumlContext's lazy NCCL init on
     __enter__ (reference cuml_context.py:121-161).
     """
-
-    _distributed_initialized = False
 
     def __init__(
         self,
@@ -58,20 +125,17 @@ class TpuContext:
         return jax.process_count()
 
     def __enter__(self) -> "TpuContext":
-        coord = get_config("coordinator_address")
-        if coord and not TpuContext._distributed_initialized:
-            # Multi-host bootstrap — the analog of the NCCL-uid allGather
-            # (reference cuml_context.py:96-102).
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=get_config("num_processes"),
-                process_id=get_config("process_id"),
-            )
-            TpuContext._distributed_initialized = True
-            self._logger.info(
-                f"jax.distributed initialized: process {jax.process_index()}"
-                f"/{jax.process_count()}"
-            )
+        if get_config("coordinator_address") and not _distributed_initialized:
+            # Lazy multi-host bootstrap from config — the analog of
+            # CumlContext's lazy NCCL init on __enter__
+            # (reference cuml_context.py:121-161).  Processes that used JAX
+            # before this point should call `init_distributed()` early
+            # instead.
+            if init_distributed():
+                self._logger.info(
+                    f"jax.distributed initialized: process "
+                    f"{jax.process_index()}/{jax.process_count()}"
+                )
         self.mesh = get_mesh(self._num_workers)
         return self
 

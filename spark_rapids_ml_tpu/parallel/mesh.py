@@ -62,6 +62,271 @@ def replicated_pspec() -> PartitionSpec:
     return PartitionSpec()
 
 
+class RowStager:
+    """Stages host arrays onto the mesh with one consistent padded row
+    layout, so X / y / weights / masks / row-ids always line up.
+
+    Single-process (the common case): the caller holds the full dataset;
+    rows 0..n_valid-1 are real, zero-padding sits at the global tail.
+
+    Multi-process (pods): every process holds only its LOCAL rows — the
+    analog of the reference's per-partition data loading (each Spark barrier
+    task stages its partition, core.py:886-957).  Each process pads its
+    local block to a common per-process size (so shards stay equal and
+    static-shaped) and `jax.make_array_from_process_local_data` assembles
+    the global array without any process ever materializing the full
+    dataset.  Padding is therefore *interleaved* at each process-block tail,
+    which is why masks/labels must be staged through the same object.
+    """
+
+    def __init__(self, n_local_rows: int, mesh: Mesh) -> None:
+        _ensure_distributed()
+        self.mesh = mesh
+        self.n_proc = jax.process_count()
+        self._replicated_input = False
+        if self.n_proc == 1:
+            n_dev = mesh.devices.size
+            self.n_local = int(n_local_rows)
+            self.n_valid = self.n_local
+            self.local_padded = self.n_local + ((-self.n_local) % n_dev)
+            self.n_padded = self.local_padded
+        else:
+            from jax.experimental import multihost_utils
+
+            counts = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray(int(n_local_rows), np.int64)
+                )
+            ).reshape(-1)
+            self._init_layout(counts, mesh)
+
+    def _init_layout(self, counts: np.ndarray, mesh: Mesh) -> None:
+        """Multi-process padded layout from the per-process row counts.
+
+        The shard size `s` (rows per DEVICE) is the max over processes of
+        ceil(count_p / ldc_p), so every process's rows fit on its own
+        devices even when processes own different device counts; every
+        quantity here is computed identically on all processes from the
+        globally-visible mesh + allgathered counts."""
+        pid = jax.process_index()
+        n_dev = mesh.devices.size
+        if n_dev != len(jax.devices()):
+            raise ValueError(
+                "multi-process staging must use the full device set: "
+                f"mesh has {n_dev} devices, global count is "
+                f"{len(jax.devices())} (set num_workers=None)"
+            )
+        pidx = [d.process_index for d in mesh.devices.flat]
+        if any(a > b for a, b in zip(pidx, pidx[1:])):
+            raise ValueError(
+                "mesh device order must group processes contiguously in "
+                "ascending process_index order (the global row order "
+                "contract); got process indices " + str(pidx)
+            )
+        ldc_all = np.bincount(pidx, minlength=self.n_proc)
+        if (ldc_all == 0).any():
+            raise ValueError("every process must own >=1 device in the mesh")
+        # rows per device shard, agreed globally
+        s = max(
+            int(-(-int(c) // int(l)))
+            for c, l in zip(counts, ldc_all)
+        )
+        s = max(s, 1)
+        self.counts = counts
+        self.n_local = int(counts[pid])
+        self.n_valid = int(counts.sum())
+        self.block_sizes = (ldc_all * s).astype(np.int64)  # padded rows/process
+        self.local_padded = int(self.block_sizes[pid])
+        self.n_padded = s * n_dev
+
+    @classmethod
+    def for_replicated(cls, n_rows: int, mesh: Mesh) -> "RowStager":
+        """Stager for host arrays REPLICATED on every process (model
+        attributes, transform inputs the caller holds in full).  Each
+        process stages only its even block of the global rows, so the
+        device layout matches a per-process-loaded fit and no rows
+        duplicate.  Single-process this is identical to RowStager."""
+        _ensure_distributed()
+        if jax.process_count() == 1:
+            return cls(n_rows, mesh)
+        pid, n_proc = jax.process_index(), jax.process_count()
+        from jax.experimental import multihost_utils
+
+        # one scalar allgather VALIDATES the replication contract — a caller
+        # passing process-local rows here (fit-style input) would otherwise
+        # stage mismatched global shapes and deadlock in the next collective
+        seen = np.asarray(
+            multihost_utils.process_allgather(np.asarray(int(n_rows), np.int64))
+        ).reshape(-1)
+        if not (seen == seen[0]).all():
+            raise ValueError(
+                "RowStager.for_replicated requires the SAME row count on "
+                f"every process (saw {seen.tolist()}); pass process-local "
+                "rows through RowStager(...) instead"
+            )
+        base, rem = divmod(int(n_rows), n_proc)
+        counts = np.array(
+            [base + (1 if p < rem else 0) for p in range(n_proc)], np.int64
+        )
+        st = object.__new__(cls)
+        st.mesh = mesh
+        st.n_proc = n_proc
+        st._replicated_input = True
+        st._lo = int(counts[:pid].sum())
+        st._init_layout(counts, mesh)
+        # n_valid for a replicated stager is the full input length the
+        # caller passes to stage() (== counts.sum() here)
+        return st
+
+    def stage(
+        self, arr: np.ndarray, dtype: Optional[np.dtype] = None
+    ) -> jax.Array:
+        """Stage a (n_local, ...) host array -> (n_padded, ...) global
+        sharded jax.Array, zero-padded per the layout.  For `for_replicated`
+        stagers, pass the FULL (n_valid, ...) array; the local block is
+        sliced out here."""
+        if self._replicated_input:
+            if arr.shape[0] != self.n_valid:
+                raise ValueError(
+                    f"replicated array has {arr.shape[0]} rows, expected "
+                    f"{self.n_valid}"
+                )
+            arr = arr[self._lo : self._lo + self.n_local]
+        dtype = np.dtype(dtype) if dtype is not None else arr.dtype
+        ensure_x64(dtype)
+        if arr.shape[0] != self.n_local:
+            raise ValueError(
+                f"array has {arr.shape[0]} rows, stager expects {self.n_local}"
+            )
+        if arr.shape[0] != self.local_padded or arr.dtype != dtype:
+            if arr.ndim == 2:
+                # single host copy fusing the dtype cast and the
+                # zero-padding; OpenMP-parallel via the native staging
+                # library when large
+                from ..native import pad_cast
+
+                padded = pad_cast(arr, self.local_padded, dtype)
+            else:
+                padded = np.zeros(
+                    (self.local_padded,) + arr.shape[1:], dtype
+                )
+                padded[: arr.shape[0]] = arr
+        else:
+            padded = arr
+        sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
+        if self.n_proc == 1:
+            return jax.device_put(padded, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, padded, (self.n_padded,) + padded.shape[1:]
+        )
+
+    def mask(self, dtype=np.float32, weights: Optional[np.ndarray] = None) -> jax.Array:
+        """Validity weights (weight for real rows, 0 for padding), staged
+        with the same layout as the data."""
+        n = self.n_valid if self._replicated_input else self.n_local
+        w = np.zeros((n,), np.dtype(dtype))
+        w[:] = 1.0 if weights is None else np.asarray(weights, dtype)
+        return self.stage(w, dtype)
+
+    def fetch(self, arr: jax.Array) -> np.ndarray:
+        """Device (n_padded, ...) row-sharded array -> host (n_valid, ...)
+        valid rows in global order.  Single-process: a plain device_get +
+        tail trim.  Multi-process: device_get only the LOCAL shards (no
+        device-side replication of the full array — that would put the
+        whole dataset in every device's HBM), drop this block's tail
+        padding, then allgather the host blocks."""
+        if self.n_proc == 1:
+            return np.asarray(jax.device_get(arr))[: self.n_valid]
+        if arr.is_fully_replicated:
+            host = np.asarray(jax.device_get(arr))
+            offs = np.concatenate([[0], np.cumsum(self.block_sizes)])
+            return np.concatenate(
+                [
+                    host[int(offs[p]) : int(offs[p]) + int(c)]
+                    for p, c in enumerate(self.counts)
+                ],
+                axis=0,
+            )
+        local = _local_rows(arr)[: self.n_local]
+        return allgather_host_rows(local)
+
+    def row_ids(self, base: int = 0) -> jax.Array:
+        """Global row ids (int32; -1 on padding), staged with the layout.
+        In multi-process mode ids are offset by the preceding processes'
+        valid counts, so they match the single-process numbering."""
+        if self.n_proc > 1:
+            base += int(self.counts[: jax.process_index()].sum())
+        ids = np.arange(base, base + self.n_local, dtype=np.int32)
+        padded = np.full((self.local_padded,), -1, np.int32)
+        padded[: self.n_local] = ids
+        sharding = NamedSharding(self.mesh, data_pspec(1))
+        if self.n_proc == 1:
+            return jax.device_put(padded, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, padded, (self.n_padded,)
+        )
+
+
+def _ensure_distributed() -> None:
+    """Lazy config-tier multi-host bootstrap before the first
+    process_count()-dependent staging decision, so
+    `set_config(coordinator_address=...)` works without an explicit
+    `init_distributed()` call.  Raises loudly (from jax) if the backend was
+    already initialized single-process — silent degradation would fit a
+    different model on every host."""
+    from ..config import get_config
+
+    if get_config("coordinator_address") is not None:
+        from .context import init_distributed
+
+        init_distributed()
+
+
+def _local_rows(arr: "jax.Array") -> np.ndarray:
+    """This process's rows of an axis-0-sharded global array, in global
+    order, as one host block (device_get of only the addressable shards)."""
+    seen = {}
+    for sh in arr.addressable_shards:
+        start = sh.index[0].start or 0
+        seen.setdefault(start, sh)
+    shards = [seen[k] for k in sorted(seen)]
+    return np.concatenate([np.asarray(sh.data) for sh in shards], axis=0)
+
+
+def allgather_host_rows(arr: np.ndarray) -> np.ndarray:
+    """Concatenate per-process host row blocks into the full array on EVERY
+    process (process-major order — the same global order RowStager.fetch
+    produces).  No-op single-process.  Used by fits whose model must hold
+    replicated host state (kNN item sets, UMAP raw data — the analog of the
+    reference broadcasting model data for distributed transform,
+    umap.py:1407-1450)."""
+    _ensure_distributed()
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.asarray(arr.shape[0], np.int64))
+    ).reshape(-1)
+    m = int(counts.max())
+    padded = np.zeros((m,) + arr.shape[1:], arr.dtype)
+    padded[: arr.shape[0]] = arr
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate(
+        [gathered[p, : int(c)] for p, c in enumerate(counts)], axis=0
+    )
+
+
+def fetch_replicated(arr: "jax.Array", mesh: Mesh) -> np.ndarray:
+    """device_get that also works for non-fully-addressable (multi-process)
+    axis-0-sharded arrays.  Returns the full padded global array.  The
+    gather happens on the HOST (local shards -> process allgather), never
+    by replicating the array into every device's memory."""
+    if jax.process_count() == 1 or arr.is_fully_replicated:
+        return np.asarray(jax.device_get(arr))
+    return allgather_host_rows(_local_rows(arr))
+
+
 def shard_rows(
     arr: np.ndarray,
     mesh: Mesh,
@@ -71,31 +336,26 @@ def shard_rows(
 
     This is the host->device staging hot loop of the reference
     (core.py:886-957 pandas->cupy conversion + `_concat_and_free`); here a
-    single `jax.device_put` with a NamedSharding splits rows across chips.
-    Returns (global sharded jax.Array, true row count before padding).
+    single `jax.device_put` with a NamedSharding splits rows across chips
+    (multi-process: `jax.make_array_from_process_local_data` of each
+    process's local rows).  Returns (global sharded jax.Array, true GLOBAL
+    row count before padding).  Callers that also need masks/labels/ids in
+    multi-process mode should use `RowStager` directly so layouts line up.
     """
-    dtype = np.dtype(dtype) if dtype is not None else arr.dtype
-    ensure_x64(dtype)
-    n_valid = arr.shape[0]
-    rem = (-n_valid) % mesh.devices.size
-    if rem or arr.dtype != dtype:
-        if arr.ndim == 2:
-            # single host copy fusing the dtype cast and the zero-padding;
-            # OpenMP-parallel via the native staging library when large
-            from ..native import pad_cast
-
-            padded = pad_cast(arr, n_valid + rem, dtype)
-        else:
-            padded = np.zeros((n_valid + rem,) + arr.shape[1:], dtype)
-            padded[:n_valid] = arr
-    else:
-        padded = arr
-    sharding = NamedSharding(mesh, data_pspec(padded.ndim))
-    return jax.device_put(padded, sharding), n_valid
+    st = RowStager(arr.shape[0], mesh)
+    return st.stage(arr, dtype), st.n_valid
 
 
 def row_mask(n_valid: int, n_padded: int, mesh: Mesh, dtype=np.float32) -> jax.Array:
-    """Validity weights for padded rows (1 real, 0 pad), sharded like data."""
+    """Validity weights for padded rows (1 real, 0 pad), sharded like data.
+
+    Single-process only (padding is a global tail there); multi-process
+    callers must use `RowStager.mask` because padding interleaves."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            "row_mask assumes tail padding; use RowStager.mask in "
+            "multi-process mode"
+        )
     w = np.zeros((n_padded,), dtype=dtype)
     w[:n_valid] = 1.0
     sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
