@@ -419,6 +419,82 @@ class _TpuEstimator(Estimator, _TpuCaller):
             supervised=self._is_supervised(),
         )
 
+    # -- streaming ingest (reference reserved-memory loader utils.py:403-522) --
+
+    def _supports_streaming_stats(self) -> bool:
+        """Whether `_fit_streaming` can fit from multi-pass streamed
+        sufficient statistics (beyond-HBM datasets).  PCA/LinReg override."""
+        return False
+
+    def _fit_streaming(self, path: str) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _streaming_io_params(self):
+        features_col, features_cols = _resolve_feature_params(self)
+        label_col = (
+            self.getOrDefault("labelCol")
+            if self._is_supervised() and self.hasParam("labelCol")
+            else None
+        )
+        weight_col = (
+            self.getOrDefault("weightCol")
+            if self.hasParam("weightCol") and self.isSet("weightCol")
+            else None
+        )
+        dtype = np.float32 if self._float32_inputs else np.float64
+        return features_col, features_cols, label_col, weight_col, dtype
+
+    def _stage_or_stream(self, path: str) -> Optional[Dict[str, Any]]:
+        """Fit a parquet dataset without the controller ever holding the
+        full array: multi-pass streaming stats when the data exceeds the
+        device-memory budget (capable estimators only), else chunked
+        stream-staging into HBM + the normal device-resident fit.  Returns
+        model attrs, or None to fall back to in-memory extraction."""
+        from .config import get_config
+        from .streaming import (
+            chunk_rows_for,
+            parquet_row_count,
+            probe_num_features,
+            stage_parquet,
+        )
+
+        if (
+            self.hasParam("enable_sparse_data_optim")
+            and self.getOrDefault("enable_sparse_data_optim") is True
+        ):
+            return None  # CSR staging needs the host matrix
+        fcol, fcols, label_col, weight_col, dtype = self._streaming_io_params()
+        if self._supports_streaming_stats():
+            import jax
+
+            n = parquet_row_count(path)
+            d = probe_num_features(path, fcol, fcols)
+            need = n * d * np.dtype(dtype).itemsize
+            budget = (
+                float(get_config("hbm_bytes"))
+                * float(get_config("mem_ratio_for_data"))
+                * len(jax.devices())
+            )
+            if need > budget or get_config("force_streaming_stats"):
+                self.logger.info(
+                    f"Dataset ~{need/2**30:.1f} GiB exceeds the device "
+                    f"budget ({budget/2**30:.1f} GiB); fitting from "
+                    f"multi-pass streamed statistics."
+                )
+                return self._fit_streaming(path)
+        ds_dev = stage_parquet(
+            path,
+            features_col=fcol,
+            features_cols=fcols,
+            label_col=label_col,
+            weight_col=weight_col,
+            num_workers=self.num_workers,
+            dtype=dtype,
+            label_dtype=self._fit_label_dtype() if label_col else None,
+            chunk_rows=None,
+        )
+        return self._fit_array(self._stage_from_device(ds_dev))
+
     def _fit(self, dataset: DatasetLike) -> "_TpuModel":
         if self._use_cpu_fallback():
             self.logger.warning(
@@ -434,13 +510,21 @@ class _TpuEstimator(Estimator, _TpuCaller):
             self._copyValues(model)
             return model
         t0 = time.time()
+        attrs = None
         if isinstance(dataset, DeviceDataset):
             fit_input = self._stage_from_device(dataset)
+            attrs = self._fit_array(fit_input)
         else:
-            batch = self._extract(dataset)
-            self._validate_input(batch)
-            fit_input = self._stage_fit_input(batch)
-        attrs = self._fit_array(fit_input)
+            from .config import get_config
+            from .streaming import is_parquet_path
+
+            if is_parquet_path(dataset) and get_config("streaming_ingest"):
+                attrs = self._stage_or_stream(dataset)
+            if attrs is None:
+                batch = self._extract(dataset)
+                self._validate_input(batch)
+                fit_input = self._stage_fit_input(batch)
+                attrs = self._fit_array(fit_input)
         model = self._create_model(attrs)
         self._copyValues(model)
         model._num_workers = self._num_workers

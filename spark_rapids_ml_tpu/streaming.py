@@ -1,0 +1,422 @@
+#
+# Out-of-core / streaming ingest — the analog of the reference's
+# reserved-memory loader (`_concat_with_reserved_gpu_mem` utils.py:403-522:
+# reserve a fraction of free GPU memory, stream Arrow batches straight into
+# it) and of Spark-partitioned ingest scaling.  Two mechanisms:
+#
+#   A. `stage_parquet` — stream parquet record batches host->HBM into a
+#      PREALLOCATED sharded device buffer via one compiled
+#      dynamic-update-slice step with buffer donation (in-place).  The full
+#      dataset is never materialized in one host allocation; host memory is
+#      one chunk (`host_batch_bytes`).  Result: a DeviceDataset, so every
+#      estimator's normal device-resident fit path runs unchanged.
+#      Multi-process: each process reads only its row slice of the dataset
+#      (per-partition loading; host memory = dataset / n_processes).
+#
+#   B. `linreg_streaming_stats` / `pca_streaming_stats` — TRUE multi-pass
+#      streaming for sufficient-statistics algorithms: chunks are staged,
+#      reduced into (d,d)-sized accumulators on device, and discarded.
+#      Dataset size is bounded by neither host RAM nor HBM.
+#
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .config import get_config
+from .utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.streaming")
+
+
+def is_parquet_path(dataset) -> bool:
+    return isinstance(dataset, str) and (
+        os.path.isdir(dataset) or dataset.endswith(".parquet")
+    )
+
+
+def parquet_row_count(path: str) -> int:
+    import pyarrow.dataset as ds
+
+    return ds.dataset(path, format="parquet").count_rows()
+
+
+def probe_num_features(
+    path: str, features_col: Optional[str], features_cols: Sequence[str]
+) -> int:
+    """Feature dimension from the first record batch (the analog of the
+    reference's `df.first()` dimension probe, core.py:467-568)."""
+    if features_cols:
+        return len(features_cols)
+    import pyarrow.dataset as ds
+
+    dataset = ds.dataset(path, format="parquet")
+    cols = [features_col]
+    for batch in dataset.to_batches(columns=cols, batch_size=1):
+        if batch.num_rows == 0:
+            continue
+        first = batch.column(0)[0].as_py()
+        if np.isscalar(first):
+            return 1
+        return len(first)
+    raise ValueError("Dataset is empty: nothing to fit/transform")
+
+
+def chunk_rows_for(d: int, itemsize: int = 4) -> int:
+    """Rows per streamed chunk from the `host_batch_bytes` budget."""
+    budget = int(get_config("host_batch_bytes"))
+    return max(1024, budget // max(d * itemsize, 1))
+
+
+def _batch_to_arrays(
+    pdf,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+    dtype: np.dtype,
+):
+    from .data import _features_from_pandas
+
+    X = _features_from_pandas(pdf, features_col, list(features_cols), dtype)
+    y = pdf[label_col].to_numpy() if label_col else None
+    w = pdf[weight_col].to_numpy() if weight_col else None
+    return X, y, w
+
+
+def iter_chunks(
+    path: str,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+    chunk_rows: int,
+    dtype: np.dtype,
+    row_range: Optional[Tuple[int, int]] = None,
+) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray], int]]:
+    """Stream `(X, y, w, n_valid)` chunks of EXACTLY `chunk_rows` rows
+    (zero-padded tail on the last chunk) — fixed shapes keep the device
+    staging step at one compilation.  `row_range=(lo, hi)` restricts to a
+    global row slice (multi-process per-partition reads)."""
+    import pyarrow.dataset as ds
+
+    columns = (
+        list(features_cols) if features_cols else [features_col]
+    )
+    if label_col:
+        columns.append(label_col)
+    if weight_col:
+        columns.append(weight_col)
+    dataset = ds.dataset(path, format="parquet")
+
+    d = probe_num_features(path, features_col, features_cols)
+    bufX = np.zeros((chunk_rows, d), dtype)
+    bufy = np.zeros((chunk_rows,), np.float64) if label_col else None
+    bufw = np.zeros((chunk_rows,), np.float64) if weight_col else None
+    fill = 0
+    seen = 0  # global rows consumed so far
+    lo, hi = row_range if row_range is not None else (0, None)
+
+    for batch in dataset.to_batches(columns=columns, batch_size=chunk_rows):
+        nb = batch.num_rows
+        if nb == 0:
+            continue
+        b_lo, b_hi = seen, seen + nb
+        seen = b_hi
+        # intersect with the requested row range
+        s = max(b_lo, lo)
+        e = b_hi if hi is None else min(b_hi, hi)
+        if s >= e:
+            if hi is not None and b_lo >= hi:
+                break
+            continue
+        pdf = batch.slice(s - b_lo, e - s).to_pandas()
+        X, y, w = _batch_to_arrays(
+            pdf, features_col, features_cols, label_col, weight_col, dtype
+        )
+        pos = 0
+        while pos < X.shape[0]:
+            take = min(chunk_rows - fill, X.shape[0] - pos)
+            bufX[fill : fill + take] = X[pos : pos + take]
+            if bufy is not None:
+                bufy[fill : fill + take] = y[pos : pos + take]
+            if bufw is not None:
+                bufw[fill : fill + take] = w[pos : pos + take]
+            fill += take
+            pos += take
+            if fill == chunk_rows:
+                yield bufX, bufy, bufw, fill
+                fill = 0
+    if fill:
+        bufX[fill:] = 0.0
+        if bufy is not None:
+            bufy[fill:] = 0.0
+        if bufw is not None:
+            bufw[fill:] = 0.0
+        yield bufX, bufy, bufw, fill
+
+
+# ---------------------------------------------------------------------------
+# Mechanism A: stream-stage into a sharded HBM buffer
+# ---------------------------------------------------------------------------
+
+
+def stage_parquet(
+    path: str,
+    features_col: Optional[str] = "features",
+    features_cols: Sequence[str] = (),
+    label_col: Optional[str] = None,
+    weight_col: Optional[str] = None,
+    num_workers: Optional[int] = None,
+    dtype=np.float32,
+    label_dtype=None,
+    chunk_rows: Optional[int] = None,
+):
+    """Stream a parquet dataset into a row-sharded DeviceDataset without a
+    full-dataset host allocation (single-process), or from this process's
+    row slice only (multi-process)."""
+    import jax
+
+    from .data import DeviceDataset
+    from .parallel.mesh import _ensure_distributed, get_mesh
+
+    _ensure_distributed()
+    dtype = np.dtype(dtype)
+    n_total = parquet_row_count(path)
+    if n_total == 0:
+        raise ValueError("Dataset is empty: nothing to fit/transform")
+    d = probe_num_features(path, features_col, features_cols)
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+
+    if jax.process_count() > 1:
+        # per-partition read: this process materializes ONLY its slice
+        # (host memory = dataset / n_processes), then the standard
+        # RowStager layout assembles the global sharded arrays
+        n_proc, pid = jax.process_count(), jax.process_index()
+        base, rem = divmod(n_total, n_proc)
+        lo = pid * base + min(pid, rem)
+        hi = lo + base + (1 if pid < rem else 0)
+        n_local = hi - lo
+        X = np.zeros((n_local, d), dtype)
+        y = np.zeros((n_local,), np.float64) if label_col else None
+        w = np.zeros((n_local,), np.float64) if weight_col else None
+        at = 0
+        for cX, cy, cw, n_c in iter_chunks(
+            path, features_col, features_cols, label_col, weight_col,
+            chunk_rows, dtype, row_range=(lo, hi),
+        ):
+            X[at : at + n_c] = cX[:n_c]
+            if y is not None:
+                y[at : at + n_c] = cy[:n_c]
+            if w is not None:
+                w[at : at + n_c] = cw[:n_c]
+            at += n_c
+        return DeviceDataset.from_host(
+            X, y=y, weight=w, num_workers=num_workers, dtype=dtype,
+            label_dtype=label_dtype,
+        )
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .parallel.mesh import DATA_AXIS, ensure_x64
+
+    ensure_x64(dtype)
+    mesh = get_mesh(num_workers)
+    n_dev = mesh.devices.size
+    # chunk-aligned AND device-aligned buffer size, so every
+    # dynamic-update-slice lands fully inside the buffer
+    chunk_rows = -(-chunk_rows // n_dev) * n_dev
+    n_padded = -(-n_total // chunk_rows) * chunk_rows
+    ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
+
+    row_spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    mat_spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS, None))
+
+    def _alloc():
+        return (
+            jnp.zeros((n_padded, d), dtype),
+            jnp.zeros((n_padded,), ldt) if label_col else None,
+            jnp.zeros((n_padded,), dtype),
+        )
+
+    bufX, bufy, bufw = jax.jit(
+        _alloc,
+        out_shardings=(mat_spec, row_spec if label_col else None, row_spec),
+    )()
+
+    def _fill(bX, bY, bW, cX, cY, cW, off):
+        bX = jax.lax.dynamic_update_slice(bX, cX, (off, 0))
+        if bY is not None:
+            bY = jax.lax.dynamic_update_slice(bY, cY, (off,))
+        bW = jax.lax.dynamic_update_slice(bW, cW, (off,))
+        return bX, bY, bW
+
+    fill = jax.jit(
+        _fill,
+        donate_argnums=(0, 1, 2),
+        out_shardings=(mat_spec, row_spec if label_col else None, row_spec),
+    )
+
+    off = 0
+    n_chunks = 0
+    for cX, cy, cw, n_c in iter_chunks(
+        path, features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype,
+    ):
+        w_host = np.zeros((chunk_rows,), dtype)
+        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        cY = (
+            jnp.asarray(cy.astype(ldt)) if label_col else None
+        )
+        bufX, bufy, bufw = fill(
+            bufX, bufy, bufw,
+            jnp.asarray(cX), cY, jnp.asarray(w_host),
+            jnp.asarray(off, jnp.int32),
+        )
+        off += chunk_rows
+        n_chunks += 1
+    logger.info(
+        f"Streamed {n_total} rows x {d} cols from {path} in {n_chunks} "
+        f"chunks of {chunk_rows} rows onto {mesh}"
+    )
+    return DeviceDataset(mesh, bufX, n_total, y=bufy, weight=bufw)
+
+
+# ---------------------------------------------------------------------------
+# Mechanism B: multi-pass streaming sufficient statistics (beyond HBM)
+# ---------------------------------------------------------------------------
+
+
+def _process_row_range(n_total: int) -> Tuple[int, int]:
+    import jax
+
+    n_proc, pid = jax.process_count(), jax.process_index()
+    if n_proc == 1:
+        return 0, n_total
+    base, rem = divmod(n_total, n_proc)
+    lo = pid * base + min(pid, rem)
+    return lo, lo + base + (1 if pid < rem else 0)
+
+
+def _sum_across_processes(host_stats: dict) -> dict:
+    """Sum per-process partial statistics (host side)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return host_stats
+    from jax.experimental import multihost_utils
+
+    out = {}
+    for k, v in host_stats.items():
+        gathered = np.asarray(
+            multihost_utils.process_allgather(np.asarray(v))
+        )
+        out[k] = gathered.sum(axis=0)
+    return out
+
+
+def linreg_streaming_stats(
+    path: str,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: str,
+    weight_col: Optional[str],
+    dtype=np.float32,
+    chunk_rows: Optional[int] = None,
+) -> dict:
+    """Weighted Gram/moment/cross statistics (ops/linear.py
+    `linreg_sufficient_stats`) accumulated chunk-by-chunk: the dataset is
+    bounded by neither host RAM nor HBM.  Returns host-side float64 stats
+    summed across processes."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    d = probe_num_features(path, features_col, features_cols)
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+    n_total = parquet_row_count(path)
+    lo, hi = _process_row_range(n_total)
+
+    def _step(acc, X, w, y):
+        Xw = X * w[:, None]
+        return {
+            "gram": acc["gram"] + Xw.T @ X,
+            "sxy": acc["sxy"] + Xw.T @ y,
+            "s1": acc["s1"] + Xw.sum(axis=0),
+            "sw": acc["sw"] + w.sum(),
+            "sy": acc["sy"] + (y * w).sum(),
+            "syy": acc["syy"] + (y * y * w).sum(),
+        }
+
+    step = jax.jit(_step, donate_argnums=0)
+    # accumulate in f32 on device (MXU matmuls); final sums come back f64
+    acc = {
+        "gram": jnp.zeros((d, d), dtype),
+        "sxy": jnp.zeros((d,), dtype),
+        "s1": jnp.zeros((d,), dtype),
+        "sw": jnp.zeros((), dtype),
+        "sy": jnp.zeros((), dtype),
+        "syy": jnp.zeros((), dtype),
+    }
+    for cX, cy, cw, n_c in iter_chunks(
+        path, features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype, row_range=(lo, hi),
+    ):
+        w_host = np.zeros((chunk_rows,), dtype)
+        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        acc = step(
+            acc, jnp.asarray(cX), jnp.asarray(w_host),
+            jnp.asarray(cy.astype(dtype)),
+        )
+    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
+    return _sum_across_processes(host)
+
+
+def pca_streaming_stats(
+    path: str,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    weight_col: Optional[str],
+    dtype=np.float32,
+    chunk_rows: Optional[int] = None,
+) -> dict:
+    """Second-moment statistics for PCA (S = sum w x x^T, s1 = sum w x,
+    sw = sum w), accumulated chunk-by-chunk."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    d = probe_num_features(path, features_col, features_cols)
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+    n_total = parquet_row_count(path)
+    lo, hi = _process_row_range(n_total)
+
+    def _step(acc, X, w):
+        Xw = X * w[:, None]
+        return {
+            "S": acc["S"] + Xw.T @ X,
+            "s1": acc["s1"] + Xw.sum(axis=0),
+            "sw": acc["sw"] + w.sum(),
+        }
+
+    step = jax.jit(_step, donate_argnums=0)
+    acc = {
+        "S": jnp.zeros((d, d), dtype),
+        "s1": jnp.zeros((d,), dtype),
+        "sw": jnp.zeros((), dtype),
+    }
+    for cX, _, cw, n_c in iter_chunks(
+        path, features_col, features_cols, None, weight_col,
+        chunk_rows, dtype, row_range=(lo, hi),
+    ):
+        w_host = np.zeros((chunk_rows,), dtype)
+        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        acc = step(acc, jnp.asarray(cX), jnp.asarray(w_host))
+    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
+    return _sum_across_processes(host)
