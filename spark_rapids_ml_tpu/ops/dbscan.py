@@ -4,11 +4,11 @@
 # clustering.py:1058-1074).  The reference broadcasts the whole dataset to
 # every GPU in <=8GB chunks (clustering.py:1104-1155) and runs a CSR/BFS
 # cluster expansion; here the dataset is replicated per device (the same
-# memory contract), row *responsibility* is sharded, and cluster expansion
-# is min-label connected components:
+# N x d memory contract), row *responsibility* is sharded, and cluster
+# expansion is min-label connected components:
 #
-#   - Core detection: one (m, N) block distance pass per shard -> degree
-#     counts (an MXU matmul via the ||a-b||^2 identity).
+#   - Core detection: block distance passes per shard -> degree counts
+#     (an MXU matmul via the ||a-b||^2 identity).
 #   - Expansion: labels start as the global row index on core points.  Each
 #     sweep takes, for every local row, the min label over its in-eps core
 #     neighbors; a pointer-jumping step (label <- label[label]) collapses
@@ -19,10 +19,13 @@
 #     convergence; everything else is noise (-1), matching
 #     sklearn/cuML semantics (neighbor counts include the point itself).
 #
-# The in-eps adjacency of the local block is computed once and carried
-# through the while_loop (memory N^2/p bits-as-bools per device — the same
-# order as the reference's broadcast dataset; recompute-per-sweep is the
-# memory-lean alternative if this ever dominates).
+# Memory contract: the peak per-device footprint is the replicated dataset
+# (N x d, same as the reference's broadcast) plus ONE (m, block) distance
+# tile.  For small problems (m*N under `_ADJ_BUDGET` elements) the in-eps
+# adjacency is materialized once and carried through the while_loop — fewer
+# FLOPs; past the budget every sweep recomputes distances tile-by-tile, so
+# the N^2/p adjacency never exists in memory (the recompute-per-sweep
+# alternative the reference's broadcast design implies at scale).
 #
 from __future__ import annotations
 
@@ -34,6 +37,12 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
 
+# largest (m, N) bool adjacency worth materializing per device (elements);
+# 2^26 = 64M ~ 64 MB of bools — past this, recompute per sweep in tiles
+_ADJ_BUDGET = 1 << 26
+# column-tile width of the recompute path: one (m, _BLOCK) f32 tile
+_BLOCK = 8192
+
 
 def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
     a2 = (A * A).sum(axis=1, keepdims=True)
@@ -41,7 +50,7 @@ def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
     return jnp.maximum(a2 - 2.0 * (A @ B.T) + b2, 0.0)
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_sweeps"))
+@partial(jax.jit, static_argnames=("mesh", "max_sweeps", "adj_budget", "block"))
 def dbscan_fit_predict(
     X_sharded: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
     valid_sharded: jax.Array,  # (N_pad,) validity, sharded
@@ -49,6 +58,8 @@ def dbscan_fit_predict(
     min_samples: jax.Array,  # scalar int
     mesh=None,
     max_sweeps: int = 64,
+    adj_budget: int = _ADJ_BUDGET,
+    block: int = _BLOCK,
 ):
     """Returns (labels (N_pad,) int32 row-sharded, core_mask (N_pad,) bool).
 
@@ -70,11 +81,58 @@ def dbscan_fit_predict(
         # host-side, clustering.py:1148-1155; one all_gather over ICI here)
         Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)  # (N, d)
         vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)  # (N,)
-
-        d2 = _sqdist(Xl, Xf)  # (m, N)
-        adj = (d2 <= eps2) & (vf > 0)[None, :]
-        deg = adj.sum(axis=1)
         valid_l = valid_l_f > 0
+
+        if m * N <= adj_budget:
+            # dense path: one (m, N) adjacency, computed once and reused
+            d2 = _sqdist(Xl, Xf)
+            adj = (d2 <= eps2) & (vf > 0)[None, :]
+            deg_once = adj.sum(axis=1)
+
+            def neighbor_reduce(labf):
+                cand = jnp.min(jnp.where(adj, labf[None, :], SENT), axis=1)
+                return deg_once, cand
+
+        else:
+            # tiled recompute path: never materialize (m, N); each call
+            # re-runs the distance matmuls one (m, blk) tile at a time
+            blk = min(block, N)
+            nb = -(-N // blk)
+            Npad = nb * blk
+            Xp = jnp.pad(Xf, ((0, Npad - N), (0, 0)))
+            vp = jnp.pad(vf, (0, Npad - N))
+
+            def neighbor_reduce(labf):
+                lp = jnp.pad(labf, (0, Npad - N), constant_values=SENT)
+
+                def body(i, carry):
+                    deg, cand = carry
+                    o = jnp.asarray(i * blk, jnp.int32)
+                    Xb = jax.lax.dynamic_slice(
+                        Xp, (o, jnp.zeros((), jnp.int32)), (blk, Xp.shape[1])
+                    )
+                    vb = jax.lax.dynamic_slice(vp, (o,), (blk,))
+                    lb = jax.lax.dynamic_slice(lp, (o,), (blk,))
+                    d2 = _sqdist(Xl, Xb)
+                    adj = (d2 <= eps2) & (vb > 0)[None, :]
+                    # int32 accumulator: bool-sum defaults to int64 under x64
+                    deg = deg + adj.sum(axis=1).astype(jnp.int32)
+                    cand = jnp.minimum(
+                        cand, jnp.min(jnp.where(adj, lb[None, :], SENT), axis=1)
+                    )
+                    return deg, cand
+
+                carry0 = jax.lax.pcast(
+                    (
+                        jnp.zeros((m,), jnp.int32),
+                        jnp.full((m,), SENT, jnp.int32),
+                    ),
+                    (DATA_AXIS,),
+                    to="varying",
+                )
+                return jax.lax.fori_loop(0, nb, body, carry0)
+
+        deg, _ = neighbor_reduce(jnp.full((N,), SENT, jnp.int32))
         core_l = (deg >= min_samples) & valid_l
         core_f = jax.lax.all_gather(core_l, DATA_AXIS, tiled=True)  # (N,)
 
@@ -84,9 +142,7 @@ def dbscan_fit_predict(
         def sweep(state):
             labels, _, it = state
             core_lab = jnp.where(core_f, labels, SENT)  # only core labels spread
-            cand = jnp.min(
-                jnp.where(adj, core_lab[None, :], SENT), axis=1
-            )  # (m,) min core label among in-eps neighbors
+            _, cand = neighbor_reduce(core_lab)
             lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
             new_l = jnp.where(core_l, jnp.minimum(lab_l, cand), lab_l)
             new = jax.lax.all_gather(new_l, DATA_AXIS, tiled=True)
@@ -112,7 +168,7 @@ def dbscan_fit_predict(
 
         # border points: attach to the min-label in-eps core neighbor
         core_lab = jnp.where(core_f, labels, SENT)
-        cand = jnp.min(jnp.where(adj, core_lab[None, :], SENT), axis=1)
+        _, cand = neighbor_reduce(core_lab)
         lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
         final_l = jnp.where(
             core_l, lab_l, jnp.where(cand < SENT, cand, jnp.int32(-1))

@@ -96,3 +96,30 @@ def test_prediction_col_rename(rng):
     )
     df = pd.DataFrame({"features": list(X.astype(np.float32))})
     assert "cluster" in model.transform(df).columns
+
+
+def test_tiled_recompute_path_matches_dense(rng):
+    # force the memory-lean tiled path (adj_budget=1) with an uneven tile
+    # size, and check it agrees with the default dense-adjacency path
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.dbscan import dbscan_fit_predict
+    from spark_rapids_ml_tpu.parallel.mesh import RowStager, get_mesh
+
+    X, _ = make_blobs(n_samples=203, n_features=4, centers=5,
+                      cluster_std=0.5, random_state=3)
+    X = X.astype(np.float32)
+    mesh = get_mesh(4)
+    st = RowStager.for_replicated(X.shape[0], mesh)
+    Xs = st.stage(X, np.float32)
+    valid = st.mask(np.float32)
+    eps = jnp.asarray(1.2, jnp.float32)
+    ms = jnp.asarray(5, jnp.int32)
+    dense, _ = dbscan_fit_predict(Xs, valid, eps, ms, mesh=mesh)
+    tiled, _ = dbscan_fit_predict(
+        Xs, valid, eps, ms, mesh=mesh, adj_budget=1, block=37
+    )
+    assert np.array_equal(st.fetch(dense), st.fetch(tiled))
+    want = SkDBSCAN(eps=1.2, min_samples=5).fit_predict(X)
+    got = st.fetch(tiled)
+    assert adjusted_rand_score(got, want) == 1.0
