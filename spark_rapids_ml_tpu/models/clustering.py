@@ -38,7 +38,7 @@ class KMeansClass:
             "distanceMeasure": None,  # only euclidean on TPU (as in cuML)
             "initMode": "init",
             "k": "n_clusters",
-            "initSteps": "",
+            "initSteps": "init_steps",
             "maxIter": "max_iter",
             "seed": "random_state",
             "tol": "tol",
@@ -62,8 +62,8 @@ class KMeansClass:
 
         def init_mapper(x: str):
             return {
-                "k-means||": "k-means++",
-                "scalable-k-means++": "k-means++",
+                "k-means||": "scalable-k-means++",
+                "scalable-k-means++": "scalable-k-means++",
                 "k-means++": "k-means++",
                 "random": "random",
             }.get(x)
@@ -78,8 +78,9 @@ class KMeansClass:
             "tol": 0.0001,
             "verbose": False,
             "random_state": None,
-            "init": "k-means++",
+            "init": "scalable-k-means++",
             "n_init": "auto",
+            "init_steps": 2,
             "oversampling_factor": 2.0,
             "max_samples_per_batch": 32768,
         }
@@ -184,6 +185,8 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
             max_iter=int(p["max_iter"]),
             tol=float(p["tol"]),
             init=str(p["init"]),
+            init_steps=int(p.get("init_steps") or 2),
+            oversample=float(p.get("oversampling_factor") or 2.0),
         )
         return {
             "cluster_centers_": np.asarray(centers),
@@ -235,15 +238,16 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
     def hasSummary(self) -> bool:
         return False
 
-    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+    def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ..ops.kmeans import kmeans_predict
 
-        preds = np.asarray(
-            kmeans_predict(jnp.asarray(X), jnp.asarray(self.cluster_centers_.astype(X.dtype)))
-        )
-        return {self.getOrDefault("predictionCol"): preds}
+        return {
+            self.getOrDefault("predictionCol"): kmeans_predict(
+                Xs, jnp.asarray(self.cluster_centers_.astype(Xs.dtype))
+            )
+        }
 
     def cpu(self):
         from sklearn.cluster import KMeans as SkKMeans

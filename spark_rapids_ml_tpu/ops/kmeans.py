@@ -69,7 +69,59 @@ def kmeans_init(X: jax.Array, w: jax.Array, k: int, seed, init: str = "k-means++
     return centers
 
 
-@partial(jax.jit, static_argnames=("k", "max_iter", "init"))
+@partial(jax.jit, static_argnames=("k", "rounds", "m"))
+def kmeans_parallel_init(X: jax.Array, w: jax.Array, k: int, seed,
+                         rounds: int = 2, m: int = 4):
+    """k-means|| scalable init (Bahmani et al.) — the TPU analog of cuML's
+    `scalable-k-means++` (the init KMeansMG runs, reference
+    clustering.py:377-411) and Spark's `initMode="k-means||"` with
+    `initSteps` rounds.
+
+    O(rounds) full D² passes instead of k sequential ones: each round draws
+    `m` candidates AT ONCE from the D² distribution (Gumbel top-m is
+    sampling without replacement), candidates are weighted by the mass they
+    attract, and the small (1+rounds*m, d) weighted candidate set is reduced
+    to k centers with the sequential Gumbel k-means++.  At k=100+, init cost
+    drops from 100 passes to `rounds`+2 passes over the sharded data.
+    """
+    n, d = X.shape
+    key = jax.random.PRNGKey(seed)
+    log_w = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)), -jnp.inf)
+
+    g0 = jax.random.gumbel(key, (n,), X.dtype)
+    idx0 = jnp.argmax(g0 + log_w)
+    c0 = jnp.take(X, idx0, axis=0)
+    C = 1 + rounds * m
+    cands0 = jnp.zeros((C, d), X.dtype).at[0].set(c0)
+    d2_0 = ((X - c0) ** 2).sum(axis=1)
+
+    def round_body(r, carry):
+        cands, d2 = carry
+        g = jax.random.gumbel(jax.random.fold_in(key, r + 1), (n,), X.dtype)
+        logits = (
+            jnp.where(d2 > 0, jnp.log(jnp.maximum(d2, 1e-30)), -jnp.inf)
+            + log_w + g
+        )
+        _, idx = jax.lax.top_k(logits, m)
+        new = jnp.take(X, idx, axis=0)  # (m, d)
+        cands = jax.lax.dynamic_update_slice(
+            cands,
+            new,
+            (jnp.asarray(1 + r * m, jnp.int32), jnp.zeros((), jnp.int32)),
+        )
+        # already-chosen rows have d2=0 -> -inf logits -> never re-chosen
+        d2 = jnp.minimum(d2, _pairwise_sqdist(X, new).min(axis=1))
+        return cands, d2
+
+    cands, _ = jax.lax.fori_loop(0, rounds, round_body, (cands0, d2_0))
+    # weight candidates by the sample mass they attract (zero-weight
+    # duplicates drop out of the k-means++ reduction below)
+    labels = jnp.argmin(_pairwise_sqdist(X, cands), axis=1)
+    counts = (jax.nn.one_hot(labels, C, dtype=X.dtype) * w[:, None]).sum(axis=0)
+    return kmeans_init(cands, counts, k, seed + 1, "k-means++")
+
+
+@partial(jax.jit, static_argnames=("k", "max_iter", "init", "init_steps", "oversample"))
 def kmeans_fit(
     X: jax.Array,
     w: jax.Array,
@@ -77,7 +129,9 @@ def kmeans_fit(
     seed,
     max_iter: int = 300,
     tol: float = 1e-4,
-    init: str = "k-means++",
+    init: str = "scalable-k-means++",
+    init_steps: int = 2,
+    oversample: float = 2.0,
 ):
     """Distributed Lloyd with center-shift convergence.
 
@@ -85,7 +139,17 @@ def kmeans_fit(
     Convergence matches Spark MLlib semantics: stop when every center moves
     less than `tol` (euclidean).
     """
-    centers = kmeans_init(X, w, k, seed, init)
+    n = X.shape[0]
+    if init in ("scalable-k-means++", "k-means||"):
+        # per-round draw: l = oversample*k (Spark/cuML's oversampling
+        # factor), bumped so the candidate pool can cover k centers
+        m = max(int(round(oversample * k)), -(-(k - 1) // max(init_steps, 1)), 1)
+        m = min(m, n)
+        centers = kmeans_parallel_init(
+            X, w, k, seed, rounds=max(init_steps, 1), m=m
+        )
+    else:
+        centers = kmeans_init(X, w, k, seed, init)
 
     def assign(C):
         d2 = _pairwise_sqdist(X, C)

@@ -92,3 +92,31 @@ def test_kmeans_cpu_model():
     from sklearn.metrics import adjusted_rand_score
 
     assert adjusted_rand_score(sk_preds, tpu_preds) == pytest.approx(1.0)
+
+
+def test_kmeans_parallel_init_quality(rng):
+    """k-means|| init must reach the same solution quality as sequential
+    k-means++ at moderate k (the cost after Lloyd convergence is the
+    quality contract, cuML scalable-k-means++ analog)."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=3000, n_features=8, centers=20,
+                      cluster_std=0.5, random_state=0)
+    X = X.astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    m_par = KMeans(k=20, seed=7, initMode="k-means||", maxIter=50).fit(df)
+    m_seq = KMeans(k=20, seed=7, initMode="k-means++", maxIter=50).fit(df)
+    # both should be within 10% of each other's converged cost
+    assert m_par.inertia_ <= 1.1 * m_seq.inertia_ + 1e-6
+
+
+def test_kmeans_init_steps_param(rng):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=500, n_features=4, centers=5, random_state=2)
+    df = pd.DataFrame({"features": list(X.astype(np.float32))})
+    m = KMeans(k=5, seed=3, initSteps=4).fit(df)
+    assert m.cluster_centers_.shape == (5, 4)
+    # initSteps must reach the backend params
+    est = KMeans(k=5, initSteps=4)
+    assert est._tpu_params["init_steps"] == 4
