@@ -32,6 +32,17 @@ _DEFAULTS: Dict[str, Any] = {
     "mem_ratio_for_data": 0.8,
     # Host staging buffer size in bytes for streaming parquet reads.
     "host_batch_bytes": 512 * 1024 * 1024,
+    # Stream parquet datasets host->HBM chunk-by-chunk instead of
+    # materializing them in controller RAM (reference
+    # `_concat_with_reserved_gpu_mem` utils.py:403-522).
+    "streaming_ingest": True,
+    # Per-device HBM budget in bytes used to decide when a dataset must fit
+    # from multi-pass streamed statistics instead of device residency
+    # (v5e chips carry 16 GiB).
+    "hbm_bytes": 16 * 1024 * 1024 * 1024,
+    # Force the multi-pass streaming-statistics fit path regardless of the
+    # device-memory estimate (testing / beyond-HBM workloads).
+    "force_streaming_stats": False,
     # Multi-host bootstrap: coordinator address for jax.distributed
     # (analog of the NCCL-uid allGather bootstrap, cuml_context.py:96-102).
     "coordinator_address": None,

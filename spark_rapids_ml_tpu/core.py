@@ -618,11 +618,70 @@ class _TpuModel(Model, _TpuCaller):
 
     # -- transform contract --------------------------------------------------
 
+    def _transform_device(self, Xs: Any) -> Optional[Dict[str, Any]]:
+        """Device-side transform: map a row-sharded (n_pad, d) device
+        feature block to `{col: device array}` outputs (row-leading shapes).
+        Row-wise models implement this; the base `_transform_array` then
+        runs it data-parallel over the mesh in host-bounded chunks — the
+        analog of the reference's partition-parallel `pandas_udf` transform
+        (core.py:1846-1881).  Models that manage their own staging (DBSCAN,
+        UMAP, kNN) leave it unimplemented."""
+        return None
+
     def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        """Map a feature block to output columns ({col_name: values}).
+        """Map a host feature block to output columns ({col_name: values}).
+        Default: the distributed batched driver over `_transform_device`.
         The analog of the per-batch predict closure from
         `_get_cuml_transform_func` (reference core.py:1846-1881)."""
-        raise NotImplementedError
+        outs = self._transform_mesh(X)
+        if outs is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} implements neither _transform_array "
+                "nor _transform_device"
+            )
+        return outs
+
+    def _transform_mesh(self, X: np.ndarray) -> Optional[Dict[str, np.ndarray]]:
+        """Distributed, batched inference (reference strategy 6, SURVEY
+        §2.12: non-barrier data-parallel transform).  Rows are chunked by
+        the `host_batch_bytes` budget, each chunk staged row-sharded over
+        the mesh, and the model's `_transform_device` runs SPMD — transform
+        throughput scales with mesh size and one chip never holds more
+        than a chunk.  Multi-process: every process stages its block of the
+        (replicated) input and fetch reassembles global rows."""
+        if type(self)._transform_device is _TpuModel._transform_device:
+            return None
+        import jax
+
+        from .parallel.mesh import RowStager, get_mesh
+        from .streaming import chunk_rows_for
+
+        X = _ensure_dense(X)
+        n = int(X.shape[0])
+        d = int(X.shape[1]) if X.ndim == 2 else 1
+        mesh = get_mesh(
+            self._num_workers if jax.process_count() == 1 else None
+        )
+        chunk = max(int(chunk_rows_for(d, X.dtype.itemsize)), mesh.devices.size)
+        if n == 0:
+            # transform one dummy row, trim everything (static-shape kernels
+            # can't run on 0 rows)
+            dummy = self._transform_mesh(np.zeros((1, d), X.dtype))
+            return {c: v[:0] for c, v in dummy.items()}
+        outs: Dict[str, List[np.ndarray]] = {}
+        for lo in range(0, n, chunk):
+            Xc = np.ascontiguousarray(X[lo : lo + chunk])
+            st = RowStager.for_replicated(Xc.shape[0], mesh)
+            dev = self._transform_device(st.stage(Xc, X.dtype))
+            for col, v in dev.items():
+                outs.setdefault(col, []).append(
+                    st.fetch(v)
+                    if isinstance(v, jax.Array)
+                    else np.asarray(v)[: st.n_valid]
+                )
+        if n <= chunk:
+            return {c: v[0] for c, v in outs.items()}
+        return {c: np.concatenate(v, axis=0) for c, v in outs.items()}
 
     def _output_columns(self) -> List[str]:
         if self.hasParam("predictionCol"):
@@ -632,8 +691,18 @@ class _TpuModel(Model, _TpuCaller):
     def _transform(self, dataset: DatasetLike):
         """Append output columns to a pandas DataFrame input, or return the
         primary output array for array input (reference
-        `_CumlModelWithColumns._transform` core.py:1797-1941)."""
+        `_CumlModelWithColumns._transform` core.py:1797-1941).  Spark
+        DataFrames round-trip through Arrow and come back as Spark
+        DataFrames (spark_interop.py)."""
         import pandas as pd
+
+        from .spark_interop import is_spark_dataframe
+
+        if is_spark_dataframe(dataset):
+            from .spark_interop import pandas_to_spark, spark_dataframe_to_pandas
+
+            out_pdf = self._transform(spark_dataframe_to_pandas(dataset))
+            return pandas_to_spark(out_pdf, dataset)
 
         if isinstance(dataset, pd.DataFrame) and len(dataset) == 0:
             # empty input transforms to empty output (Spark semantics)
@@ -715,11 +784,21 @@ class _CombinedModel:
         results = []
         for m in self.models:
             outputs = m._transform_array(np.asarray(X, dtype=m._out_dtype(X)))
-            out_df = dataset.copy()
+            cols: Dict[str, Any] = {}
             for col, values in outputs.items():
                 vals: Any = values
                 if isinstance(values, np.ndarray) and values.ndim == 2:
                     vals = list(values)
-                out_df[col] = vals
+                cols[col] = vals
+            # no per-model deep copy of the input frame (round-1 review):
+            # reference the original columns and append the outputs
+            base = dataset
+            overlap = [c for c in cols if c in dataset.columns]
+            if overlap:
+                base = dataset.drop(columns=overlap)
+            # pandas>=3 copy-on-write: concat is lazy, no deep copy happens
+            out_df = pd.concat(
+                [base, pd.DataFrame(cols, index=dataset.index)], axis=1
+            )
             results.append(evaluator.evaluate(out_df))
         return results

@@ -528,41 +528,46 @@ class LogisticRegressionModel(
         ]
 
     def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        import jax.numpy as jnp
-
-        from ..ops.logistic import binary_predict, logreg_predict
-
         # +/-inf intercepts (single-label degenerate model) can't go
         # through XLA math cleanly; handle on host
         if self._is_binomial() and not np.isfinite(self.intercept_[0]):
             n = X.shape[0]
             p1 = 1.0 if self.intercept_[0] > 0 else 0.0
+            dt = X.dtype if hasattr(X, "dtype") else np.float32
             preds = np.full(n, p1, np.int32)
-            probs = np.tile([1.0 - p1, p1], (n, 1)).astype(X.dtype)
+            probs = np.tile([1.0 - p1, p1], (n, 1)).astype(dt)
             raw = np.tile(
                 [-self.intercept_[0], self.intercept_[0]], (n, 1)
-            ).astype(X.dtype)
-        elif self._is_binomial():
+            ).astype(dt)
+            return {
+                self.getOrDefault("predictionCol"): preds,
+                self.getOrDefault("probabilityCol"): probs,
+                self.getOrDefault("rawPredictionCol"): raw,
+            }
+        return super()._transform_array(X)
+
+    def _transform_device(self, Xs) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..ops.logistic import binary_predict, logreg_predict
+
+        if self._is_binomial():
             preds, probs, raw = binary_predict(
-                jnp.asarray(X),
-                jnp.asarray(self.coef_[0].astype(X.dtype)),
-                X.dtype.type(self.intercept_[0]),
+                Xs,
+                jnp.asarray(self.coef_[0].astype(Xs.dtype)),
+                Xs.dtype.type(self.intercept_[0]),
             )
-            preds, probs, raw = map(np.asarray, (preds, probs, raw))
             threshold = float(self.getOrDefault("threshold"))
             if threshold != 0.5:
-                preds = (probs[:, 1] > threshold).astype(np.int32)
+                preds = (probs[:, 1] > threshold).astype(jnp.int32)
         else:
-            preds, probs, raw = map(
-                np.asarray,
-                logreg_predict(
-                    jnp.asarray(X),
-                    jnp.asarray(self.coef_.astype(X.dtype)),
-                    jnp.asarray(self.intercept_.astype(X.dtype)),
-                ),
+            preds, probs, raw = logreg_predict(
+                Xs,
+                jnp.asarray(self.coef_.astype(Xs.dtype)),
+                jnp.asarray(self.intercept_.astype(Xs.dtype)),
             )
         return {
-            self.getOrDefault("predictionCol"): preds.astype(np.int32),
+            self.getOrDefault("predictionCol"): preds.astype(jnp.int32),
             self.getOrDefault("probabilityCol"): probs,
             self.getOrDefault("rawPredictionCol"): raw,
         }
@@ -698,21 +703,29 @@ class RandomForestClassificationModel(
             self.getOrDefault("rawPredictionCol"),
         ]
 
-    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        leaves = self._apply_trees(X)  # (T, n)
+    def _transform_device(self, Xs) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..ops.forest import forest_apply
+
+        leaves = forest_apply(
+            Xs,
+            jnp.asarray(self.feature),
+            jnp.asarray(self.threshold.astype(Xs.dtype)),
+            max_depth=self.max_depth,
+        )  # (T, n)
         # per-tree leaf class-count distributions, normalized per tree then
         # summed (Spark rawPrediction semantics)
-        counts = np.take_along_axis(
-            self.leaf_stats, leaves[:, :, None], axis=1
-        )  # (T, n, C)
-        sums = np.maximum(counts.sum(axis=2, keepdims=True), 1e-12)
+        stats = jnp.asarray(self.leaf_stats.astype(Xs.dtype))  # (T, L, C)
+        counts = jnp.take_along_axis(stats, leaves[:, :, None], axis=1)
+        sums = jnp.maximum(counts.sum(axis=2, keepdims=True), 1e-12)
         raw = (counts / sums).sum(axis=0)  # (n, C)
         probs = raw / self.numTrees
-        preds = np.argmax(raw, axis=1).astype(np.int32)
+        preds = jnp.argmax(raw, axis=1).astype(jnp.int32)
         return {
             self.getOrDefault("predictionCol"): preds,
-            self.getOrDefault("probabilityCol"): probs.astype(X.dtype),
-            self.getOrDefault("rawPredictionCol"): raw.astype(X.dtype),
+            self.getOrDefault("probabilityCol"): probs,
+            self.getOrDefault("rawPredictionCol"): raw,
         }
 
     def cpu(self):

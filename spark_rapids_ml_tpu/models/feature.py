@@ -115,6 +115,47 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
             "dtype": str(np.dtype(fit_input.dtype).name),
         }
 
+    def _supports_streaming_stats(self) -> bool:
+        return True
+
+    def _fit_streaming(self, path: str) -> Dict[str, Any]:
+        """Beyond-HBM fit from multi-pass streamed second moments
+        (streaming.py `pca_streaming_stats`): the dataset never resides in
+        host RAM or HBM, only the (d,d) accumulator does.  The host
+        finalization replicates `ops/pca.py pca_fit` in float64."""
+        from ..streaming import pca_streaming_stats
+
+        fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
+        st = pca_streaming_stats(path, fcol, fcols, weight_col, dtype=dtype)
+        S, s1, sw = np.asarray(st["S"]), np.asarray(st["s1"]), float(st["sw"])
+        d = S.shape[0]
+        k = int(self._tpu_params.get("n_components") or d)
+        if k > d:
+            raise ValueError(f"k={k} exceeds the number of features {d}")
+        mean = s1 / sw
+        cov = (S - sw * np.outer(mean, mean)) / (sw - 1.0)
+        evals, evecs = np.linalg.eigh(cov)
+        evals = evals[::-1]
+        evecs = evecs[:, ::-1]
+        components = evecs[:, :k].T
+        flip_idx = np.argmax(np.abs(components), axis=1)
+        signs = np.sign(components[np.arange(k), flip_idx])
+        signs[signs == 0] = 1.0
+        components = components * signs[:, None]
+        ev = np.clip(evals[:k], 0.0, None)
+        evr = ev / np.clip(evals, 0.0, None).sum()
+        sv = np.sqrt(ev * (sw - 1.0))
+        dtype = np.dtype(dtype)
+        return {
+            "mean_": mean.astype(dtype),
+            "components_": components.astype(dtype),
+            "explained_variance_": ev.astype(dtype),
+            "explained_variance_ratio_": evr.astype(dtype),
+            "singular_values_": sv.astype(dtype),
+            "n_cols": d,
+            "dtype": str(dtype.name),
+        }
+
     def _create_model(self, attrs: Dict[str, Any]) -> "PCAModel":
         return PCAModel(**attrs)
 
@@ -170,15 +211,16 @@ class PCAModel(PCAClass, _TpuModel, _PCATpuParams):
     def _output_columns(self) -> List[str]:
         return [self.getOrDefault("outputCol")]
 
-    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+    def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ..ops.pca import pca_transform
 
-        out = np.asarray(
-            pca_transform(jnp.asarray(X), jnp.asarray(self.components_.astype(X.dtype)))
-        )
-        return {self.getOrDefault("outputCol"): out}
+        return {
+            self.getOrDefault("outputCol"): pca_transform(
+                Xs, jnp.asarray(self.components_.astype(Xs.dtype))
+            )
+        }
 
     def cpu(self):
         from sklearn.decomposition import PCA as SkPCA

@@ -221,6 +221,46 @@ class LinearRegression(
             "dtype": str(dtype.name),
         }
 
+    def _supports_streaming_stats(self) -> bool:
+        return True
+
+    def _fit_streaming(self, path: str) -> Dict[str, Any]:
+        """Beyond-HBM fit from multi-pass streamed sufficient statistics
+        (streaming.py `linreg_streaming_stats`); the host solve is the same
+        `solve_linear_host` the in-memory path uses."""
+        from ..ops.linear import solve_linear_host
+        from ..streaming import linreg_streaming_stats
+
+        fcol, fcols, label_col, weight_col, dtype = self._streaming_io_params()
+        if label_col is None:
+            raise ValueError("labelCol must be set for LinearRegression")
+        st = linreg_streaming_stats(
+            path, fcol, fcols, label_col, weight_col, dtype=dtype
+        )
+        p = self._tpu_params
+        coef, intercept, diag = solve_linear_host(
+            np.asarray(st["gram"]),
+            np.asarray(st["sxy"]),
+            np.asarray(st["s1"]),
+            float(st["sw"]),
+            float(st["sy"]),
+            float(st["syy"]),
+            reg_param=float(p["alpha"]),
+            elasticnet_param=float(p["l1_ratio"]),
+            fit_intercept=bool(p["fit_intercept"]),
+            standardization=bool(p.get("standardization", True)),
+            tol=float(p["tol"]),
+            max_iter=int(p["max_iter"]),
+        )
+        dtype = np.dtype(dtype)
+        return {
+            "coef_": coef.astype(dtype),
+            "intercept_": float(intercept),
+            "n_iter_": int(diag["n_iter"]),
+            "n_cols": int(np.asarray(st["gram"]).shape[0]),
+            "dtype": str(dtype.name),
+        }
+
     def _create_model(self, attrs: Dict[str, Any]) -> "LinearRegressionModel":
         return LinearRegressionModel(**attrs)
 
@@ -276,19 +316,18 @@ class LinearRegressionModel(
     def hasSummary(self) -> bool:
         return False
 
-    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
+    def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp
 
         from ..ops.linear import linreg_predict
 
-        preds = np.asarray(
-            linreg_predict(
-                jnp.asarray(X),
-                jnp.asarray(self.coef_.astype(X.dtype)),
-                X.dtype.type(self.intercept_),
+        return {
+            self.getOrDefault("predictionCol"): linreg_predict(
+                Xs,
+                jnp.asarray(self.coef_.astype(Xs.dtype)),
+                Xs.dtype.type(self.intercept_),
             )
-        )
-        return {self.getOrDefault("predictionCol"): preds}
+        }
 
     def cpu(self):
         from sklearn.linear_model import LinearRegression as SkLR
@@ -347,14 +386,24 @@ class RandomForestRegressionModel(_RandomForestModel):
     """Random forest regression model (reference
     RandomForestRegressionModel in regression.py)."""
 
-    def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
-        leaves = self._apply_trees(X)  # (T, n)
-        stats = np.take_along_axis(
-            self.leaf_stats, leaves[:, :, None], axis=1
+    def _transform_device(self, Xs) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ..ops.forest import forest_apply
+
+        leaves = forest_apply(
+            Xs,
+            jnp.asarray(self.feature),
+            jnp.asarray(self.threshold.astype(Xs.dtype)),
+            max_depth=self.max_depth,
+        )  # (T, n)
+        stats = jnp.take_along_axis(
+            jnp.asarray(self.leaf_stats.astype(Xs.dtype)),
+            leaves[:, :, None], axis=1,
         )  # (T, n, 3): (weight, sum y, sum y^2)
-        w = np.maximum(stats[:, :, 0], 1e-12)
+        w = jnp.maximum(stats[:, :, 0], 1e-12)
         preds = (stats[:, :, 1] / w).mean(axis=0)
-        return {self.getOrDefault("predictionCol"): preds.astype(X.dtype)}
+        return {self.getOrDefault("predictionCol"): preds.astype(Xs.dtype)}
 
     def cpu(self):
         from .classification import _NumpyForestPredictor

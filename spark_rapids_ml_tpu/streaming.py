@@ -249,7 +249,9 @@ def stage_parquet(
     )()
 
     def _fill(bX, bY, bW, cX, cY, cW, off):
-        bX = jax.lax.dynamic_update_slice(bX, cX, (off, 0))
+        # explicit int32 zero: a Python literal would trace as int64 when a
+        # prior fit enabled x64, and dus requires uniform index types
+        bX = jax.lax.dynamic_update_slice(bX, cX, (off, jnp.zeros((), jnp.int32)))
         if bY is not None:
             bY = jax.lax.dynamic_update_slice(bY, cY, (off,))
         bW = jax.lax.dynamic_update_slice(bW, cW, (off,))

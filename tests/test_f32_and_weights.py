@@ -1,0 +1,187 @@
+#
+# float32-tolerance grid + weighted-sample coverage — round-1 review item
+# (most numeric equivalence tests force float32_inputs=False; the reference
+# tests both dtypes per algo, tests/utils.py:36-40 feature-grid +
+# float32/64).  Every test here runs the DEFAULT f32 device path against an
+# f64 sklearn reference with f32-appropriate tolerances, or checks weighted
+# semantics (weight w == row repeated w times).
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.regression import LinearRegression
+
+
+@pytest.fixture
+def reg_data(rng):
+    X = rng.normal(size=(800, 6))
+    coef = np.array([1.0, -2.0, 0.5, 3.0, 0.0, -0.5])
+    y = X @ coef + 0.7 + 0.01 * rng.normal(size=800)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# float32 default-path grids
+# ---------------------------------------------------------------------------
+
+
+def test_f32_linreg_matches_sklearn(reg_data):
+    from sklearn.linear_model import LinearRegression as SkLR
+
+    X, y = reg_data
+    m = LinearRegression(regParam=0.0).fit((X, y))  # f32 device path
+    sk = SkLR().fit(X, y)
+    np.testing.assert_allclose(m.coef_, sk.coef_, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(m.intercept_, sk.intercept_, rtol=2e-3, atol=2e-3)
+
+
+def test_f32_logreg_matches_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    X = rng.normal(size=(1500, 5))
+    y = (X @ np.array([2.0, -1.0, 0.5, 0.0, 1.0]) > 0).astype(np.float64)
+    n = len(y)
+    m = LogisticRegression(regParam=0.01, maxIter=200, tol=1e-10).fit((X, y))
+    sk = SkLR(C=1.0 / (0.01 * n), max_iter=2000, tol=1e-10).fit(X, y)
+    np.testing.assert_allclose(m.coef_[0], sk.coef_[0], rtol=0.03, atol=0.02)
+
+
+def test_f32_pca_matches_sklearn(rng):
+    from sklearn.decomposition import PCA as SkPCA
+
+    X = rng.normal(size=(600, 10))
+    X[:, 0] *= 4.0
+    m = PCA(k=3).setInputCol("features").setOutputCol("o").fit(
+        pd.DataFrame({"features": list(X)})
+    )
+    sk = SkPCA(n_components=3, svd_solver="full").fit(X)
+    np.testing.assert_allclose(
+        np.abs(m.components_), np.abs(sk.components_), rtol=5e-3, atol=5e-3
+    )
+    np.testing.assert_allclose(
+        m.explained_variance_ratio_, sk.explained_variance_ratio_,
+        rtol=5e-3, atol=1e-5,
+    )
+
+
+def test_f32_kmeans_cost_matches_sklearn(rng):
+    from sklearn.cluster import KMeans as SkKMeans
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=1200, n_features=6, centers=6,
+                      cluster_std=0.7, random_state=1)
+    m = KMeans(k=6, seed=0, maxIter=100).fit(X.astype(np.float64))
+    sk = SkKMeans(n_clusters=6, n_init=10, random_state=0).fit(X)
+    # converged cost parity within 2% (inits differ)
+    assert m.inertia_ <= 1.02 * sk.inertia_ + 1e-6
+
+
+def test_f32_rf_accuracy(rng):
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+
+    X = rng.normal(size=(2000, 8)).astype(np.float64)
+    y = ((X[:, 0] + X[:, 1] * X[:, 2]) > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = RandomForestClassifier(numTrees=20, maxDepth=6, seed=0).fit(df)
+    preds = model._transform_array(X.astype(np.float32))["prediction"]
+    assert (np.asarray(preds) == y).mean() > 0.85
+
+
+# ---------------------------------------------------------------------------
+# weighted samples: weight w == row repeated w times
+# ---------------------------------------------------------------------------
+
+
+def _weighted_frame(rng, n=300, d=4):
+    X = rng.normal(size=(n, d))
+    coef = np.arange(1, d + 1, dtype=np.float64)
+    y = X @ coef + 0.05 * rng.normal(size=n)
+    w = rng.integers(1, 4, size=n).astype(np.float64)
+    df_w = pd.DataFrame({"features": list(X), "label": y, "w": w})
+    Xr = np.repeat(X, w.astype(int), axis=0)
+    yr = np.repeat(y, w.astype(int))
+    df_r = pd.DataFrame({"features": list(Xr), "label": yr})
+    return df_w, df_r
+
+
+def test_weighted_linreg_equals_repeated_rows(rng):
+    df_w, df_r = _weighted_frame(rng)
+    m_w = (
+        LinearRegression(float32_inputs=False).setWeightCol("w").fit(df_w)
+    )
+    m_r = LinearRegression(float32_inputs=False).fit(df_r)
+    np.testing.assert_allclose(m_w.coef_, m_r.coef_, rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(m_w.intercept_, m_r.intercept_, rtol=1e-6,
+                               atol=1e-8)
+
+
+def test_weighted_kmeans_equals_repeated_rows(rng):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=200, n_features=3, centers=3, random_state=2)
+    w = rng.integers(1, 4, size=200).astype(np.float64)
+    df_w = pd.DataFrame({"features": list(X), "w": w})
+    Xr = np.repeat(X, w.astype(int), axis=0)
+    m_w = (
+        KMeans(k=3, seed=1, maxIter=100, float32_inputs=False)
+        .setWeightCol("w").fit(df_w)
+    )
+    m_r = KMeans(k=3, seed=1, maxIter=100, float32_inputs=False).fit(Xr)
+    # same converged centers (init differs in row multiplicity; compare as
+    # sets via sorted rows)
+    a = np.sort(m_w.cluster_centers_, axis=0)
+    b = np.sort(m_r.cluster_centers_, axis=0)
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_weighted_pca_stats_equal_repeated_rows(rng):
+    # PCA has no weightCol param in the reference; weighted moments are
+    # exercised through the streaming-stats path instead
+    X = rng.normal(size=(150, 5))
+    w = rng.integers(1, 4, size=150).astype(np.float64)
+    df_w = pd.DataFrame({"features": list(X), "w": w})
+    Xr = np.repeat(X, w.astype(int), axis=0)
+    from spark_rapids_ml_tpu.streaming import pca_streaming_stats
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        df_w.to_parquet(td + "/d.parquet")
+        st = pca_streaming_stats(
+            td + "/d.parquet", "features", (), "w", dtype=np.float64
+        )
+    S_r = Xr.T @ Xr
+    np.testing.assert_allclose(st["S"], S_r, rtol=1e-8, atol=1e-8)
+    assert st["sw"] == w.sum()
+
+
+# ---------------------------------------------------------------------------
+# tests_large analog: objective-at-scale behind --runslow
+# (reference tests_large/test_large_logistic_regression.py:39-60)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_large_logreg_objective_vs_sklearn(rng):
+    """10M-row LogReg: the distributed objective must match sklearn's on a
+    subsample-extrapolated reference within tolerance."""
+    n, d = 10_000_000, 32
+    X = rng.standard_normal((n, d), dtype=np.float32)
+    coef = rng.normal(size=d).astype(np.float32)
+    y = (X @ coef + 0.3 * rng.standard_normal(n).astype(np.float32) > 0).astype(
+        np.float64
+    )
+    m = LogisticRegression(regParam=1e-4, maxIter=100, tol=1e-9).fit((X, y))
+    # sklearn on a 200k subsample: coefficient directions must agree
+    from sklearn.linear_model import LogisticRegression as SkLR
+
+    ns = 200_000
+    sk = SkLR(C=1.0 / (1e-4 * ns), max_iter=500, tol=1e-9).fit(X[:ns], y[:ns])
+    cos = (m.coef_[0] @ sk.coef_[0]) / (
+        np.linalg.norm(m.coef_[0]) * np.linalg.norm(sk.coef_[0])
+    )
+    assert cos > 0.999

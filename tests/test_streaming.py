@@ -1,0 +1,178 @@
+#
+# Streaming / out-of-core ingest tests — the analog of the reference's
+# reserved-memory loader behavior (utils.py:403-522): chunked host->HBM
+# staging (`stage_parquet`) and TRUE multi-pass streaming sufficient
+# statistics for PCA/LinearRegression, plus the chunked distributed
+# transform driver.
+#
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.config import reset_config, set_config
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    reset_config()
+    yield
+    reset_config()
+
+
+def _write_parquet(tmp_path, X, y=None, w=None):
+    df = pd.DataFrame({"features": list(np.asarray(X))})
+    if y is not None:
+        df["label"] = y
+    if w is not None:
+        df["w"] = w
+    path = str(tmp_path / "data.parquet")
+    df.to_parquet(path)
+    return path
+
+
+def test_stage_parquet_matches_in_memory(tmp_path, rng):
+    from spark_rapids_ml_tpu.streaming import stage_parquet
+
+    X = rng.normal(size=(503, 6)).astype(np.float32)
+    y = rng.integers(0, 2, size=503).astype(np.float64)
+    path = _write_parquet(tmp_path, X, y)
+    # tiny chunk budget -> many chunks; buffer never holds the dataset
+    set_config(host_batch_bytes=4096)
+    ds = stage_parquet(path, label_col="label", dtype=np.float32)
+    assert ds.n_valid == 503
+    from spark_rapids_ml_tpu.parallel.mesh import fetch_replicated
+
+    Xs = fetch_replicated(ds.X, ds.mesh)[:503]
+    np.testing.assert_allclose(Xs, X, rtol=1e-6)
+    ys = fetch_replicated(ds.y, ds.mesh)[:503]
+    np.testing.assert_allclose(ys, y)
+    ws = fetch_replicated(ds.weight, ds.mesh)
+    assert ws.sum() == 503  # validity weights: 1 on real rows, 0 on padding
+
+
+def test_kmeans_fit_from_parquet_path(tmp_path, rng):
+    from sklearn.datasets import make_blobs
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X, _ = make_blobs(n_samples=400, n_features=5, centers=3, random_state=0)
+    X = X.astype(np.float32)
+    path = _write_parquet(tmp_path, X)
+    set_config(host_batch_bytes=8192)
+    m_stream = KMeans(k=3, seed=11).fit(path)
+    m_mem = KMeans(k=3, seed=11).fit(pd.DataFrame({"features": list(X)}))
+    a = np.sort(m_stream.cluster_centers_, axis=0)
+    b = np.sort(m_mem.cluster_centers_, axis=0)
+    np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_logreg_fit_from_parquet_path(tmp_path, rng):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(600, 4)).astype(np.float32)
+    coef = np.array([1.5, -2.0, 0.5, 0.0])
+    y = (X @ coef + 0.3 * rng.normal(size=600) > 0).astype(np.float64)
+    path = _write_parquet(tmp_path, X, y)
+    set_config(host_batch_bytes=4096)
+    m_stream = LogisticRegression(regParam=0.01).fit(path)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m_mem = LogisticRegression(regParam=0.01).fit(df)
+    np.testing.assert_allclose(m_stream.coef_, m_mem.coef_, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        m_stream.intercept_, m_mem.intercept_, rtol=1e-4, atol=1e-5
+    )
+
+
+def test_linreg_streaming_stats_fit(tmp_path, rng):
+    """force_streaming_stats: the multi-pass beyond-HBM path must match the
+    in-memory fit."""
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X = rng.normal(size=(500, 5)).astype(np.float32)
+    coef = np.array([2.0, -1.0, 0.5, 3.0, 0.0])
+    y = (X @ coef + 1.7 + 0.01 * rng.normal(size=500)).astype(np.float64)
+    path = _write_parquet(tmp_path, X, y)
+    set_config(force_streaming_stats=True, host_batch_bytes=4096)
+    m_stream = LinearRegression().fit(path)
+    reset_config()
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m_mem = LinearRegression().fit(df)
+    np.testing.assert_allclose(m_stream.coef_, m_mem.coef_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        m_stream.intercept_, m_mem.intercept_, rtol=1e-3, atol=1e-4
+    )
+
+
+def test_linreg_streaming_weighted_ridge(tmp_path, rng):
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (X @ np.array([1.0, 2.0, -1.0, 0.5]) + 0.5).astype(np.float64)
+    w = rng.uniform(0.5, 2.0, size=300)
+    path = _write_parquet(tmp_path, X, y, w)
+    set_config(force_streaming_stats=True, host_batch_bytes=4096)
+    est = LinearRegression(regParam=0.1).setWeightCol("w")
+    m_stream = est.fit(path)
+    reset_config()
+    df = pd.DataFrame({"features": list(X), "label": y, "w": w})
+    m_mem = LinearRegression(regParam=0.1).setWeightCol("w").fit(df)
+    np.testing.assert_allclose(m_stream.coef_, m_mem.coef_, rtol=1e-3, atol=1e-4)
+
+
+def test_pca_streaming_stats_fit(tmp_path, rng):
+    from spark_rapids_ml_tpu.feature import PCA
+
+    X = rng.normal(size=(400, 8)).astype(np.float32)
+    X[:, 0] *= 5.0  # dominant direction
+    path = _write_parquet(tmp_path, X)
+    set_config(force_streaming_stats=True, host_batch_bytes=4096)
+    m_stream = PCA(k=3).setInputCol("features").setOutputCol("o").fit(path)
+    reset_config()
+    df = pd.DataFrame({"features": list(X)})
+    m_mem = PCA(k=3).setInputCol("features").setOutputCol("o").fit(df)
+    np.testing.assert_allclose(
+        np.abs(m_stream.components_), np.abs(m_mem.components_),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        m_stream.explained_variance_, m_mem.explained_variance_,
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_streaming_ingest_disabled_falls_back(tmp_path, rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    path = _write_parquet(tmp_path, X)
+    set_config(streaming_ingest=False)
+    m = KMeans(k=2, seed=5).fit(path)  # in-memory extraction path
+    assert m.cluster_centers_.shape == (2, 3)
+
+
+def test_transform_chunked_matches_single(rng):
+    """The distributed batched transform driver: many chunks == one chunk."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(700, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression().fit(df)
+    full = model._transform_array(X)
+    set_config(host_batch_bytes=1024)  # ~64 rows per chunk
+    chunked = model._transform_array(X)
+    for col in full:
+        np.testing.assert_allclose(
+            np.asarray(full[col], np.float64),
+            np.asarray(chunked[col], np.float64),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_transform_empty_input(rng):
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    X = rng.normal(size=(50, 3)).astype(np.float32)
+    model = KMeans(k=2, seed=1).fit(pd.DataFrame({"features": list(X)}))
+    out = model._transform_array(np.zeros((0, 3), np.float32))
+    assert out[model.getOrDefault("predictionCol")].shape[0] == 0
