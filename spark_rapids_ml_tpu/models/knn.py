@@ -294,7 +294,7 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Distributed ring brute force; (metric distances, positional
         indices) trimmed of padding."""
-        from ..ops.knn import knn_ring_topk, knn_topk_local
+        from ..ops.knn import knn_ring_topk, knn_topk_blocked
         from ..parallel import TpuContext
         from ..parallel.mesh import RowStager
 
@@ -308,7 +308,7 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
         queries = qst.stage(np.asarray(Q), dtype)
         if mesh.devices.size == 1:
-            d2, idx = knn_topk_local(items, valid, ids, queries, k=k)
+            d2, idx = knn_topk_blocked(items, valid, ids, queries, k=k)
         else:
             d2, idx = knn_ring_topk(items, valid, ids, queries, k=k, mesh=mesh)
         return self._apply_metric(qst.fetch(d2)), qst.fetch(idx)
@@ -375,27 +375,32 @@ class _ANNParams(_KNNParams):
         return self._set_params(metric=value)
 
 
-_SUPPORTED_ANN_ALGOS = ("ivfflat", "ivfpq")
+_SUPPORTED_ANN_ALGOS = ("ivfflat", "ivfpq", "cagra")
 
 
 class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
-    """Approximate k nearest neighbors over IVF indexes (API parity:
-    reference ApproximateNearestNeighbors knn.py:941-1222, backed by cuVS
-    ivf_flat/ivf_pq; `cagra` is not offered — graph search is a poor fit
-    for the MXU and ivfflat/ivfpq cover the recall/speed envelope).
+    """Approximate k nearest neighbors (API parity: reference
+    ApproximateNearestNeighbors knn.py:941-1222, backed by cuVS
+    ivf_flat/ivf_pq/cagra).
 
     `fit` trains the index: an ops/kmeans.py coarse quantizer plus (for
     `ivfpq`) per-subspace residual codebooks — the analog of the cuVS index
-    build (reference knn.py:1516-1530).  `kneighbors` shards queries over
-    the mesh and probes the replicated inverted file (the single-controller
-    inverse of the reference's shard-index/broadcast-queries layout,
-    knn.py:1448-1470).
+    build (reference knn.py:1516-1530) — or, for `cagra`, an NN-descent
+    kNN graph searched by fixed-iteration beam traversal (ops/cagra.py; the
+    analog of cuVS CAGRA, reference knn.py:1581-1657).  `kneighbors`
+    shards queries over the mesh and probes the replicated index (the
+    single-controller inverse of the reference's shard-index/
+    broadcast-queries layout, knn.py:1448-1470).
 
     algoParams (reference knn.py:860-865 passthrough dict):
       - nlist: number of inverted lists (default ~sqrt(n))
       - nprobe: lists probed per query (default 20, clamped to nlist)
       - M / n_bits: ivfpq subspaces / code bits (defaults 8 / 8)
       - refine_ratio: ivfpq exact re-rank multiplier (default 2)
+      - graph_degree / nn_descent_niter: cagra graph degree (default 32)
+        and NN-descent build rounds (default 8)
+      - itopk_size / max_iterations: cagra search beam width (default 64)
+        and traversal iterations (default 12) — cuVS search param names
 
     Examples
     --------
@@ -438,7 +443,19 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
             "algorithm": algo,
             "nlist": nlist,
         }
-        if algo == "ivfflat":
+        if algo == "cagra":
+            import jax.numpy as jnp
+
+            from ..ops.cagra import build_cagra_graph
+
+            deg = int(ap.get("graph_degree", 32))
+            deg = max(1, min(deg, n - 1))
+            rounds = int(ap.get("nn_descent_niter", 8))
+            graph = build_cagra_graph(
+                jnp.asarray(X), seed=0, deg=deg, rounds=max(rounds, 1)
+            )
+            attrs.update(cagra_graph=np.asarray(graph))
+        elif algo == "ivfflat":
             index = ivf_ops.build_ivfflat(X, nlist=nlist)
             attrs.update(
                 ivf_centers=index.centers,
@@ -514,7 +531,17 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         ap = dict(self._tpu_params.get("algo_params") or {})
         nprobe = int(ap.get("nprobe", 20))
         nprobe = max(1, min(nprobe, self.nlist_))
-        if self.algorithm_ == "ivfflat":
+        if self.algorithm_ == "cagra":
+            from ..ops.cagra import search_cagra
+
+            items, graph = self._staged_index(("item_features", "cagra_graph"))
+            beam = int(ap.get("itopk_size", 64))
+            beam = max(beam, k)
+            iters = int(ap.get("max_iterations", 12))
+            d2, pos = search_cagra(
+                Qs, items, graph, k=k, beam=beam, iters=max(iters, 1)
+            )
+        elif self.algorithm_ == "ivfflat":
             centers, buckets, bids, bvalid = self._staged_index(
                 ("ivf_centers", "ivf_buckets", "ivf_bucket_ids",
                  "ivf_bucket_valid")

@@ -39,7 +39,8 @@ class UMAPClass:
                 "learning_rate", "init", "min_dist", "spread",
                 "set_op_mix_ratio", "local_connectivity",
                 "repulsion_strength", "negative_sample_rate", "a", "b",
-                "random_state", "sample_fraction",
+                "random_state", "sample_fraction", "target_metric",
+                "target_weight",
             )
         }
 
@@ -71,6 +72,8 @@ class UMAPClass:
             "precomputed_knn": None,
             "random_state": None,
             "sample_fraction": 1.0,
+            "target_metric": "categorical",
+            "target_weight": 0.5,
             "verbose": False,
         }
 
@@ -175,6 +178,11 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         super().__init__()
         self._set_params(**kwargs)
 
+    def _is_supervised(self) -> bool:
+        # supervised UMAP: labels flow into the fuzzy-set intersection when
+        # the user sets labelCol (reference umap.py:812-813)
+        return self.hasParam("labelCol") and self.isSet("labelCol")
+
     def _fit(self, dataset: DatasetLike) -> "UMAPModel":
         import time
 
@@ -182,7 +190,7 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         import jax.numpy as jnp
 
         from ..ops import umap as umap_ops
-        from ..ops.knn import knn_topk_local
+        from ..ops.knn import knn_topk_blocked
 
         t0 = time.time()
         batch = self._extract(dataset)
@@ -199,12 +207,18 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         # worker, umap.py:926-948): in multi-process mode every process
         # gathers the full sample and computes the identical model
         X = allgather_host_rows(X)
+        y_all: Optional[np.ndarray] = None
+        if batch.y is not None:
+            y_all = allgather_host_rows(np.asarray(batch.y, np.float64))
         frac = float(p.get("sample_fraction", 1.0))
         if frac < 1.0:
             rng = np.random.default_rng(seed)
-            X_fit = X[rng.random(X.shape[0]) < frac]
+            keep = rng.random(X.shape[0]) < frac
+            X_fit = X[keep]
+            y_fit = y_all[keep] if y_all is not None else None
         else:
             X_fit = X
+            y_fit = y_all
         n, d = X_fit.shape
         k = int(float(p["n_neighbors"]))
         if k >= n:
@@ -221,7 +235,7 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         Xd = jnp.asarray(X_graph)
         ones = jnp.ones((n,), Xd.dtype)
         ids = jnp.arange(n, dtype=jnp.int32)
-        d2, inds = knn_topk_local(Xd, ones, ids, Xd, k=k + 1)
+        d2, inds = knn_topk_blocked(Xd, ones, ids, Xd, k=k + 1)
         knn_d = jnp.sqrt(jnp.maximum(d2[:, 1:], 0.0))
         knn_i = inds[:, 1:]
 
@@ -232,6 +246,28 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             knn_i, knn_d, rho, sigma,
             set_op_mix_ratio=float(p["set_op_mix_ratio"]),
         )
+
+        # 2b. supervised intersection (reference umap.py:812-813, 901:
+        # labelCol -> cuML supervised fit; categorical target metric)
+        if y_fit is not None:
+            tmetric = str(p.get("target_metric") or "categorical")
+            if tmetric != "categorical":
+                raise ValueError(
+                    f"target_metric='{tmetric}' is not supported; only "
+                    "'categorical' supervised UMAP is implemented"
+                )
+            tw = float(p.get("target_weight", 0.5))
+            # umap-learn: far_dist from target_weight; 1.0 -> effectively inf
+            far_dist = 2.5 * (1.0 / (1.0 - tw)) if tw < 1.0 else 1.0e12
+            known = np.isfinite(y_fit)
+            codes = np.full(y_fit.shape[0], -1, np.int32)
+            if known.any():
+                _, inv = np.unique(y_fit[known], return_inverse=True)
+                codes[known] = inv.astype(np.int32)
+            weights = umap_ops.categorical_intersection(
+                knn_i, heads, tails, weights,
+                jnp.asarray(codes), far_dist=far_dist,
+            )
 
         # 3. a/b curve parameters (host scipy, once)
         a, b = p.get("a"), p.get("b")
@@ -336,7 +372,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
     def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
-        from ..ops.knn import knn_ring_topk, knn_topk_local
+        from ..ops.knn import knn_ring_topk, knn_topk_blocked
         from ..ops.umap import transform_init
         from ..parallel import TpuContext
 
@@ -369,7 +405,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         qst = RowStager.for_replicated(Xq.shape[0], mesh)
         Qs = qst.stage(Xq, dtype)
         if mesh.devices.size == 1:
-            d2, inds = knn_topk_local(Xi, validd, idsd, Qs, k=k)
+            d2, inds = knn_topk_blocked(Xi, validd, idsd, Qs, k=k)
         else:
             d2, inds = knn_ring_topk(Xi, validd, idsd, Qs, k=k, mesh=mesh)
         knn_d = jnp.sqrt(jnp.maximum(d2, 0.0))

@@ -100,8 +100,37 @@ def knn_ring_topk(
 @partial(jax.jit, static_argnames=("k",))
 def knn_topk_local(items, item_valid, item_ids, queries, k: int):
     """Single-device brute force (used for num_workers=1 and by UMAP's
-    local kNN-graph build)."""
+    local kNN-graph build).  Materializes the full (q, n) distance block —
+    callers with large q*n should use `knn_topk_blocked`."""
     d2 = _block_sqdist(queries, items)
     d2 = jnp.where(item_valid[None, :] > 0, d2, jnp.inf)
     neg_d, pos = jax.lax.top_k(-d2, k)
     return -neg_d, jnp.take(item_ids, pos)
+
+
+@partial(jax.jit, static_argnames=("k", "block"))
+def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
+                     block: int = 1024):
+    """Brute force with the query axis tiled: peak memory is one
+    (block, n) distance tile instead of (q, n) — the single-device analog
+    of the reference's batched GPU brute force (cuML handles this blocking
+    inside NearestNeighborsMG; at q = n = 100k an unblocked (q, n) tile
+    would be 40 GB and exceed HBM)."""
+    q, d = queries.shape
+    block = min(block, q)  # small batches pay for their own rows only
+    nb = -(-q // block)
+    qpad = nb * block
+    Qp = jnp.pad(queries, ((0, qpad - q), (0, 0)))
+
+    def one(b):
+        # uniform int32 indices (a literal 0 traces int64 once x64 is on)
+        Qb = jax.lax.dynamic_slice(
+            Qp, (b * block, jnp.zeros((), jnp.int32)), (block, d)
+        )
+        d2 = _block_sqdist(Qb, items)
+        d2 = jnp.where(item_valid[None, :] > 0, d2, jnp.inf)
+        neg_d, pos = jax.lax.top_k(-d2, k)
+        return -neg_d, jnp.take(item_ids, pos)
+
+    ds, ids = jax.lax.map(one, jnp.arange(nb, dtype=jnp.int32))
+    return ds.reshape(qpad, k)[:q], ids.reshape(qpad, k)[:q]

@@ -185,6 +185,52 @@ def optimize_embedding(
 
 
 @jax.jit
+def categorical_intersection(
+    knn_inds: jax.Array,  # (n, k) neighbor row indices (edge-list order)
+    heads: jax.Array,  # (n*k,)
+    tails: jax.Array,  # (n*k,)
+    weights: jax.Array,  # (n*k,) symmetrized membership weights
+    labels: jax.Array,  # (n,) int codes; -1 = unknown
+    unknown_dist=1.0,
+    far_dist=5.0,
+):
+    """Supervised (categorical) simplicial set intersection — the analog of
+    cuML's supervised UMAP fit consuming labelCol (reference
+    umap.py:812-813, 901; umap-learn's
+    `categorical_simplicial_set_intersection` + `reset_local_connectivity`):
+
+      - edges between differently-labeled points are scaled by
+        exp(-far_dist), edges touching unknown (-1) labels by
+        exp(-unknown_dist);
+      - local connectivity is then reset: per-head max-normalization
+        followed by the fuzzy union with the reverse edge (reverse weights
+        looked up by scanning the tail's neighbor list, as in
+        `fuzzy_simplicial_set`; a reverse edge absent from the kNN lists
+        contributes 0 — the same approximation the forward pass makes).
+    """
+    n, k = knn_inds.shape
+    li = jnp.take(labels, heads)
+    lj = jnp.take(labels, tails)
+    unknown = (li < 0) | (lj < 0)
+    differ = li != lj
+    scale = jnp.where(
+        unknown,
+        jnp.exp(-unknown_dist),
+        jnp.where(differ, jnp.exp(-far_dist), 1.0),
+    )
+    w = weights * scale
+    wmat = w.reshape(n, k)
+    wmax = jnp.maximum(wmat.max(axis=1), 1e-12)
+    wn = wmat / wmax[:, None]
+    j_neighbors = knn_inds[tails]  # (n*k, k)
+    j_weights = wn[tails]  # (n*k, k)
+    match = j_neighbors == heads[:, None]
+    w_rev = jnp.where(match, j_weights, 0.0).max(axis=1)
+    w_fwd = wn.reshape(-1)
+    return w_fwd + w_rev - w_fwd * w_rev
+
+
+@jax.jit
 def transform_init(
     knn_inds: jax.Array,  # (q, k) neighbor indices into training rows
     knn_dists: jax.Array,  # (q, k)

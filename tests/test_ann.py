@@ -98,7 +98,7 @@ def test_bad_n_bits_raises(blobs):
 
 def test_unsupported_algorithm_raises(blobs):
     with pytest.raises(ValueError, match="not supported"):
-        ApproximateNearestNeighbors(algorithm="cagra").fit(blobs)
+        ApproximateNearestNeighbors(algorithm="hnsw").fit(blobs)
 
 
 def test_approx_similarity_join(blobs):
@@ -123,5 +123,80 @@ def test_ann_save_load(tmp_path, blobs):
     _, _, a = model.kneighbors(blobs[:10])
     _, _, b = loaded.kneighbors(blobs[:10])
     assert np.array_equal(
+        np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
+    )
+
+
+def test_cagra_recall(blobs, num_workers):
+    """CAGRA-class graph ANN (ops/cagra.py): NN-descent build + beam search
+    must reach high recall vs exact brute force (reference knn.py:903-904,
+    1581-1657 offers cuVS cagra)."""
+    k = 8
+    model = ApproximateNearestNeighbors(
+        k=k, algorithm="cagra",
+        algoParams={"graph_degree": 16, "itopk_size": 64},
+        num_workers=num_workers,
+    ).fit(blobs)
+    _, _, knn_df = model.kneighbors(blobs[:100])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(blobs)
+    _, want_idx = sk.kneighbors(blobs[:100])
+    assert _recall(got_idx, want_idx) >= 0.95
+
+
+def test_cagra_skewed_clusters_recall(rng):
+    """Recall under heavily skewed cluster sizes (round-1 review: ANN
+    recall evidence on skewed data)."""
+    from sklearn.datasets import make_blobs
+
+    sizes = [2000, 400, 80, 40, 20]
+    X, _ = make_blobs(
+        n_samples=sizes, n_features=12,
+        cluster_std=[0.5, 1.0, 2.0, 0.3, 3.0], random_state=4,
+    )
+    X = X.astype(np.float32)
+    k = 10
+    model = ApproximateNearestNeighbors(
+        k=k, algorithm="cagra", algoParams={"graph_degree": 24}
+    ).fit(X)
+    q = X[::17]
+    _, _, knn_df = model.kneighbors(q)
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(X)
+    _, want_idx = sk.kneighbors(q)
+    assert _recall(got_idx, want_idx) >= 0.9
+
+
+def test_ivf_skewed_clusters_recall(rng):
+    from sklearn.datasets import make_blobs
+
+    sizes = [2000, 400, 80, 40, 20]
+    X, _ = make_blobs(
+        n_samples=sizes, n_features=12,
+        cluster_std=[0.5, 1.0, 2.0, 0.3, 3.0], random_state=4,
+    )
+    X = X.astype(np.float32)
+    k = 10
+    model = ApproximateNearestNeighbors(
+        k=k, algoParams={"nlist": 32, "nprobe": 8}
+    ).fit(X)
+    q = X[::17]
+    _, _, knn_df = model.kneighbors(q)
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute").fit(X)
+    _, want_idx = sk.kneighbors(q)
+    assert _recall(got_idx, want_idx) >= 0.85
+
+
+def test_cagra_save_load(tmp_path, blobs):
+    model = ApproximateNearestNeighbors(
+        k=4, algorithm="cagra", algoParams={"graph_degree": 8}
+    ).fit(blobs)
+    path = str(tmp_path / "cagra_model")
+    model.save(path)
+    loaded = ApproximateNearestNeighborsModel.load(path)
+    _, _, a = model.kneighbors(blobs[:20])
+    _, _, b = loaded.kneighbors(blobs[:20])
+    np.testing.assert_array_equal(
         np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
     )

@@ -111,3 +111,63 @@ def test_save_load(tmp_path, blobs):
     a = model._transform_array(X[:20])["embedding"]
     b = loaded._transform_array(X[:20])["embedding"]
     np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_supervised_umap_improves_separation(rng):
+    """labelCol threads into the fuzzy-set intersection (reference
+    umap.py:812-813): with labels, same-class points pull together and
+    cross-class edges are suppressed, so class separation in the embedding
+    must improve over the unsupervised fit."""
+    import pandas as pd
+
+    n = 150
+    # two heavily-overlapping gaussians: unsupervised UMAP cannot separate
+    X = np.concatenate([
+        rng.normal(0.0, 1.0, size=(n, 6)),
+        rng.normal(0.4, 1.0, size=(n, 6)),
+    ]).astype(np.float32)
+    y = np.concatenate([np.zeros(n), np.ones(n)])
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    def sep(emb):
+        a, b = emb[:n], emb[n:]
+        inter = np.linalg.norm(a.mean(0) - b.mean(0))
+        intra = 0.5 * (a.std(0).mean() + b.std(0).mean())
+        return inter / max(intra, 1e-9)
+
+    common = dict(n_neighbors=10, random_state=5, n_epochs=100)
+    m_uns = UMAP(**common).setFeaturesCol("features").fit(df)
+    m_sup = (
+        UMAP(**common).setFeaturesCol("features").setLabelCol("label").fit(df)
+    )
+    assert sep(m_sup.embedding_) > 2.0 * sep(m_uns.embedding_)
+
+
+def test_supervised_umap_unknown_labels(rng):
+    """NaN labels are 'unknown' (-1): the fit must run and produce finite
+    embeddings (umap-learn unknown-label semantics)."""
+    import pandas as pd
+
+    X = rng.normal(size=(120, 5)).astype(np.float32)
+    y = rng.integers(0, 3, size=120).astype(np.float64)
+    y[::7] = np.nan
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = (
+        UMAP(n_neighbors=8, random_state=2, n_epochs=50)
+        .setFeaturesCol("features").setLabelCol("label").fit(df)
+    )
+    assert np.isfinite(m.embedding_).all()
+
+
+def test_supervised_umap_regression_target_rejected(rng):
+    import pandas as pd
+
+    X = rng.normal(size=(60, 4)).astype(np.float32)
+    y = rng.normal(size=60)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    est = (
+        UMAP(n_neighbors=5, target_metric="euclidean")
+        .setFeaturesCol("features").setLabelCol("label")
+    )
+    with pytest.raises(ValueError, match="target_metric"):
+        est.fit(df)
