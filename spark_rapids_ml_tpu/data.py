@@ -51,6 +51,10 @@ def _to_pandas(dataset: DatasetLike):
         return dataset
     if isinstance(dataset, pa.Table):
         return dataset.to_pandas()
+    from .spark_interop import is_spark_dataframe, spark_dataframe_to_pandas
+
+    if is_spark_dataframe(dataset):
+        return spark_dataframe_to_pandas(dataset)
     if isinstance(dataset, str):
         import pyarrow.parquet as pq
 

@@ -1,0 +1,155 @@
+#
+# PySpark interop — the analog of the reference's actual user story: a
+# zero-import-change pyspark.ml drop-in (reference install.py:51-77 proxy
+# modules; core.py Arrow-based dataset exchange).  Without a JVM-side
+# plugin, interop is host-Arrow based:
+#
+#   - `fit`/`transform` accept a live `pyspark.sql.DataFrame`: VectorUDT
+#     feature columns are unwrapped with `vector_to_array` (the reference's
+#     `_pre_process_data` does the same, core.py:493-537) and the dataset is
+#     collected to the controller via Arrow (`toPandas`).  The single-
+#     controller JAX runtime then shards rows onto the mesh as usual — the
+#     Spark cluster is the storage/ETL tier, the TPU mesh is the compute
+#     tier.
+#   - `Model.transform(spark_df)` returns a `pyspark.sql.DataFrame` again
+#     (createDataFrame of the appended-columns pandas result).
+#   - `install()` replaces pyspark.ml estimator attributes with the
+#     accelerated classes, mirroring reference install.py.
+#
+# Everything is gated on pyspark being importable; nothing here executes in
+# environments without Spark (pyspark is NOT a dependency of this package).
+#
+from __future__ import annotations
+
+import sys
+from typing import Any, List, Optional
+
+from .utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.spark_interop")
+
+
+def pyspark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+def is_spark_dataframe(obj: Any) -> bool:
+    """Duck-typed check that never imports pyspark on its own: if pyspark
+    is not already imported, `obj` cannot be a Spark DataFrame."""
+    if "pyspark" not in sys.modules:
+        return False
+    try:
+        from pyspark.sql import DataFrame
+
+        if isinstance(obj, DataFrame):
+            return True
+    except Exception:  # pragma: no cover
+        pass
+    try:  # Spark Connect DataFrames are a distinct class
+        from pyspark.sql.connect.dataframe import DataFrame as CDataFrame
+
+        return isinstance(obj, CDataFrame)
+    except Exception:
+        return False
+
+
+def spark_dataframe_to_pandas(df: Any, columns: Optional[List[str]] = None):
+    """Collect a Spark DataFrame to pandas via Arrow, unwrapping VectorUDT
+    columns to array columns first (the `vector_to_array` step of the
+    reference's `_pre_process_data`, core.py:493-537)."""
+    vec_cols = [
+        f.name
+        for f in df.schema.fields
+        if type(f.dataType).__name__ == "VectorUDT"
+    ]
+    if vec_cols:
+        from pyspark.ml.functions import vector_to_array
+
+        for c in vec_cols:
+            df = df.withColumn(c, vector_to_array(c))
+    if columns:
+        df = df.select(*columns)
+    try:
+        spark = df.sparkSession
+        spark.conf.set("spark.sql.execution.arrow.pyspark.enabled", "true")
+    except Exception:  # pragma: no cover — conf may be read-only (Connect)
+        pass
+    n_parts = None
+    try:
+        n_parts = df.rdd.getNumPartitions()
+    except Exception:
+        pass
+    logger.info(
+        "Collecting Spark DataFrame to the controller via Arrow"
+        + (f" ({n_parts} partitions)" if n_parts else "")
+    )
+    return df.toPandas()
+
+
+def pandas_to_spark(pdf, like_df: Any):
+    """pandas -> Spark DataFrame in the same session as `like_df`."""
+    spark = like_df.sparkSession
+    return spark.createDataFrame(pdf)
+
+
+# ---------------------------------------------------------------------------
+# Zero-import-change pyspark.ml accelerator (reference install.py:51-77)
+# ---------------------------------------------------------------------------
+
+# pyspark.ml module -> attribute -> accelerated replacement
+_ACCELERATED = {
+    "pyspark.ml.feature": {"PCA": ("spark_rapids_ml_tpu.feature", "PCA")},
+    "pyspark.ml.clustering": {
+        "KMeans": ("spark_rapids_ml_tpu.clustering", "KMeans"),
+    },
+    "pyspark.ml.classification": {
+        "LogisticRegression": (
+            "spark_rapids_ml_tpu.classification", "LogisticRegression",
+        ),
+        "RandomForestClassifier": (
+            "spark_rapids_ml_tpu.classification", "RandomForestClassifier",
+        ),
+    },
+    "pyspark.ml.regression": {
+        "LinearRegression": (
+            "spark_rapids_ml_tpu.regression", "LinearRegression",
+        ),
+        "RandomForestRegressor": (
+            "spark_rapids_ml_tpu.regression", "RandomForestRegressor",
+        ),
+    },
+    "pyspark.ml.tuning": {
+        "CrossValidator": ("spark_rapids_ml_tpu.tuning", "CrossValidator"),
+    },
+}
+
+_originals: dict = {}
+
+
+def install() -> None:
+    """Patch pyspark.ml modules so `from pyspark.ml.classification import
+    LogisticRegression` hands back the TPU-accelerated class (reference
+    install.py:51-77 import-hook proxies).  Requires pyspark."""
+    import importlib
+
+    for mod_name, attrs in _ACCELERATED.items():
+        mod = importlib.import_module(mod_name)
+        for attr, (repl_mod, repl_attr) in attrs.items():
+            repl = getattr(importlib.import_module(repl_mod), repl_attr)
+            _originals.setdefault((mod_name, attr), getattr(mod, attr, None))
+            setattr(mod, attr, repl)
+            logger.info(f"Accelerated {mod_name}.{attr} -> {repl_mod}.{repl_attr}")
+
+
+def uninstall() -> None:
+    import importlib
+
+    for (mod_name, attr), orig in _originals.items():
+        if orig is not None:
+            setattr(importlib.import_module(mod_name), attr, orig)
+    _originals.clear()

@@ -1,0 +1,73 @@
+#
+# PySpark interop tests — the analog of the reference's core user story
+# (pyspark.ml drop-in; reference install.py + tests_no_import_change).
+# pyspark is not part of this image's baked dependency set, so the whole
+# module skips cleanly when it is absent; in Spark-equipped environments it
+# exercises the Arrow round-trip end to end.
+#
+import numpy as np
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+
+
+@pytest.fixture(scope="module")
+def spark():
+    from pyspark.sql import SparkSession
+
+    spark = (
+        SparkSession.builder.master("local[2]")
+        .appName("spark_rapids_ml_tpu-interop")
+        .config("spark.sql.execution.arrow.pyspark.enabled", "true")
+        .getOrCreate()
+    )
+    yield spark
+    spark.stop()
+
+
+def _make_df(spark, n=200, d=4, seed=0):
+    from pyspark.ml.linalg import Vectors
+
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d))
+    coef = rng.normal(size=d)
+    y = (X @ coef > 0).astype(float)
+    rows = [(Vectors.dense(x), float(label)) for x, label in zip(X, y)]
+    return spark.createDataFrame(rows, ["features", "label"]), X, y
+
+
+def test_fit_from_spark_dataframe(spark):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    df, X, y = _make_df(spark)
+    model = LogisticRegression(regParam=0.01).fit(df)
+    assert model.coef_.shape[1] == 4
+    preds = model._transform_array(X.astype(np.float32))["prediction"]
+    assert (np.asarray(preds) == y).mean() > 0.9
+
+
+def test_transform_returns_spark_dataframe(spark):
+    from pyspark.sql import DataFrame
+
+    from spark_rapids_ml_tpu.clustering import KMeans
+
+    df, X, _ = _make_df(spark)
+    model = KMeans(k=2, seed=1).fit(df)
+    out = model.transform(df)
+    assert isinstance(out, DataFrame)
+    assert "prediction" in out.columns
+    assert out.count() == 200
+
+
+def test_install_hook(spark):
+    from spark_rapids_ml_tpu import spark_interop
+
+    spark_interop.install()
+    try:
+        from pyspark.ml.classification import LogisticRegression
+
+        import spark_rapids_ml_tpu.classification as tpu_cls
+
+        assert LogisticRegression is tpu_cls.LogisticRegression
+    finally:
+        spark_interop.uninstall()
