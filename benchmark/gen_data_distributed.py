@@ -1,0 +1,243 @@
+#
+# Distributed / partitioned dataset generation — the analog of reference
+# python/benchmark/gen_data_distributed.py (84-952: Spark-parallel
+# generators writing partitioned parquet).  Without Spark, partitions are
+# generated independently from per-partition seeds and written as separate
+# parquet files, so:
+#
+#   - the full dataset never exists in one allocation (each partition is
+#     bounded host memory),
+#   - generation parallelizes across processes (`--part_offset` /
+#     `--part_stride`: process p of P writes parts p, p+P, ... — the same
+#     contract Spark tasks get from partition ids),
+#   - the output is directly consumable by the streaming ingest path
+#     (spark_rapids_ml_tpu/streaming.py reads parquet directories).
+#
+# Global structure (cluster centers, regression coefficients, low-rank
+# factors) is derived ONLY from the base seed, so any partitioning of the
+# same (kind, seed, shape) yields one consistent dataset.
+#
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _part_rng(seed: int, part: int) -> np.random.Generator:
+    return np.random.default_rng(np.random.SeedSequence([seed, part]))
+
+
+class _Gen:
+    """One partition-decomposable generator: `shared(seed)` builds the
+    global structure, `partition(shared, rng, n_rows)` draws rows."""
+
+    label = True
+
+    def __init__(self, n_cols: int, **kw: float) -> None:
+        self.n_cols = n_cols
+        self.kw = kw
+
+    def shared(self, seed: int):
+        raise NotImplementedError
+
+    def partition(self, shared, rng, n_rows: int):
+        raise NotImplementedError
+
+
+class BlobsGen(_Gen):
+    """make_blobs, partition-decomposable (reference BlobsDataGen,
+    gen_data_distributed.py)."""
+
+    def shared(self, seed: int):
+        rng = np.random.default_rng(seed)
+        centers = int(self.kw.get("centers", 20))
+        box = float(self.kw.get("center_box", 10.0))
+        return rng.uniform(-box, box, size=(centers, self.n_cols))
+
+    def partition(self, centers, rng, n_rows: int):
+        std = float(self.kw.get("cluster_std", 1.0))
+        which = rng.integers(0, centers.shape[0], size=n_rows)
+        X = centers[which] + rng.normal(0.0, std, size=(n_rows, self.n_cols))
+        return X.astype(np.float32), which.astype(np.float64)
+
+
+class RegressionGen(_Gen):
+    """Linear regression rows y = X @ w + noise (reference
+    RegressionDataGen)."""
+
+    def shared(self, seed: int):
+        rng = np.random.default_rng(seed)
+        n_inf = int(self.kw.get("n_informative", max(1, self.n_cols // 2)))
+        w = np.zeros(self.n_cols)
+        idx = rng.permutation(self.n_cols)[:n_inf]
+        w[idx] = rng.normal(0.0, 100.0, size=n_inf)
+        return w
+
+    def partition(self, w, rng, n_rows: int):
+        noise = float(self.kw.get("noise", 1.0))
+        X = rng.normal(size=(n_rows, self.n_cols))
+        y = X @ w + rng.normal(0.0, noise, size=n_rows)
+        return X.astype(np.float32), y.astype(np.float64)
+
+
+class ClassificationGen(_Gen):
+    """Binary/multiclass rows from a shared random linear model
+    (reference ClassificationDataGen)."""
+
+    def shared(self, seed: int):
+        rng = np.random.default_rng(seed)
+        n_classes = int(self.kw.get("n_classes", 2))
+        return rng.normal(size=(n_classes, self.n_cols))
+
+    def partition(self, W, rng, n_rows: int):
+        X = rng.normal(size=(n_rows, self.n_cols))
+        flip = float(self.kw.get("flip_y", 0.01))
+        logits = X @ W.T
+        y = np.argmax(logits, axis=1).astype(np.float64)
+        noise = rng.random(n_rows) < flip
+        y[noise] = rng.integers(0, W.shape[0], size=int(noise.sum()))
+        return X.astype(np.float32), y
+
+
+class LowRankGen(_Gen):
+    """X = A_part @ B with shared (r, d) factor B (reference
+    LowRankMatrixDataGen)."""
+
+    label = False
+
+    def shared(self, seed: int):
+        rng = np.random.default_rng(seed)
+        r = int(self.kw.get("effective_rank", max(1, self.n_cols // 10)))
+        return rng.normal(size=(r, self.n_cols)) / np.sqrt(r)
+
+    def partition(self, B, rng, n_rows: int):
+        A = rng.normal(size=(n_rows, B.shape[0]))
+        return (A @ B).astype(np.float32), None
+
+
+class SparseRegressionGen(_Gen):
+    """Sparse rows with `density` nonzeros, y from a shared dense w
+    (reference SparseRegressionDataGen, gen_data_distributed.py:84-300).
+    Features are written as dense arrays with explicit zeros (the parquet
+    layout every ingest path takes); the sparsity is in the data."""
+
+    def shared(self, seed: int):
+        rng = np.random.default_rng(seed)
+        return rng.normal(0.0, 10.0, size=self.n_cols)
+
+    def partition(self, w, rng, n_rows: int):
+        density = float(self.kw.get("density", 0.1))
+        noise = float(self.kw.get("noise", 1.0))
+        X = rng.normal(size=(n_rows, self.n_cols)).astype(np.float32)
+        mask = rng.random((n_rows, self.n_cols)) < density
+        X = np.where(mask, X, 0.0).astype(np.float32)
+        y = X @ w + rng.normal(0.0, noise, size=n_rows)
+        return X, y.astype(np.float64)
+
+
+GENERATORS = {
+    "blobs": BlobsGen,
+    "regression": RegressionGen,
+    "classification": ClassificationGen,
+    "low_rank_matrix": LowRankGen,
+    "sparse_regression": SparseRegressionGen,
+}
+
+
+def _part_ranges(n_rows: int, parts: int):
+    base, rem = divmod(n_rows, parts)
+    lo = 0
+    for p in range(parts):
+        n = base + (1 if p < rem else 0)
+        yield p, lo, n
+        lo += n
+
+
+def generate_partitioned(
+    kind: str,
+    n_rows: int,
+    n_cols: int,
+    output_dir: str,
+    parts: int = 8,
+    seed: int = 0,
+    feature_layout: str = "array",
+    part_offset: int = 0,
+    part_stride: int = 1,
+    rows_per_batch: Optional[int] = None,
+    **kw: float,
+) -> str:
+    """Write `parts` parquet files under `output_dir`.  This process writes
+    parts `part_offset, part_offset+part_stride, ...` (single-process:
+    all).  Returns the output directory path."""
+    import pandas as pd
+
+    gen = GENERATORS[kind](n_cols, **kw)
+    shared = gen.shared(seed)
+    os.makedirs(output_dir, exist_ok=True)
+    n_written = 0
+    for p, lo, n in _part_ranges(n_rows, parts):
+        if (p - part_offset) % part_stride != 0:
+            continue
+        rng = _part_rng(seed, p)
+        X, y = gen.partition(shared, rng, n)
+        if feature_layout == "array":
+            df = pd.DataFrame({"features": list(X)})
+        else:
+            df = pd.DataFrame(X, columns=[f"c{i}" for i in range(n_cols)])
+        if y is not None and gen.label:
+            df["label"] = y
+        df.to_parquet(os.path.join(output_dir, f"part-{p:05d}.parquet"))
+        n_written += 1
+    if part_offset == 0:
+        with open(os.path.join(output_dir, "_meta.json"), "w") as f:
+            json.dump(
+                {"kind": kind, "num_rows": n_rows, "num_cols": n_cols,
+                 "parts": parts, "seed": seed, **kw}, f,
+            )
+    return output_dir
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        description="Generate partitioned synthetic benchmark data "
+        "(distributed-datagen analog)"
+    )
+    p.add_argument("kind", choices=sorted(GENERATORS))
+    p.add_argument("--num_rows", type=int, default=100_000)
+    p.add_argument("--num_cols", type=int, default=64)
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--parts", type=int, default=8)
+    p.add_argument("--feature_layout", choices=["array", "scalar"],
+                   default="array")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--part_offset", type=int, default=0,
+                   help="this worker's first partition id")
+    p.add_argument("--part_stride", type=int, default=1,
+                   help="number of parallel datagen workers")
+    p.add_argument("--n_classes", type=int, default=2)
+    p.add_argument("--density", type=float, default=0.1)
+    args = p.parse_args()
+
+    kw = {}
+    if args.kind == "classification":
+        kw["n_classes"] = args.n_classes
+    if args.kind == "sparse_regression":
+        kw["density"] = args.density
+    out = generate_partitioned(
+        args.kind, args.num_rows, args.num_cols, args.output_dir,
+        parts=args.parts, seed=args.seed,
+        feature_layout=args.feature_layout,
+        part_offset=args.part_offset, part_stride=args.part_stride, **kw,
+    )
+    print(
+        f"wrote {args.num_rows}x{args.num_cols} {args.kind} in "
+        f"{args.parts} parts -> {out}"
+    )
+
+
+if __name__ == "__main__":
+    main()

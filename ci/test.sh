@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+#
+# CI gate — the analog of the reference's ci/test.sh (lint + unit tests +
+# benchmark smoke; pre-merge vs nightly split via --runslow).
+#
+#   ./ci/test.sh            # pre-merge: lint + fast suite + bench smoke
+#   ./ci/test.sh --runslow  # nightly: adds slow-marked scale tests
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint: byte-compile all sources =="
+python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
+
+echo "== lint: import surface =="
+python - << 'EOF'
+import importlib
+mods = [
+    "spark_rapids_ml_tpu",
+    "spark_rapids_ml_tpu.feature", "spark_rapids_ml_tpu.clustering",
+    "spark_rapids_ml_tpu.classification", "spark_rapids_ml_tpu.regression",
+    "spark_rapids_ml_tpu.knn", "spark_rapids_ml_tpu.umap",
+    "spark_rapids_ml_tpu.tuning", "spark_rapids_ml_tpu.pipeline",
+    "spark_rapids_ml_tpu.sklearn_api", "spark_rapids_ml_tpu.spark_interop",
+    "spark_rapids_ml_tpu.streaming", "spark_rapids_ml_tpu.metrics",
+    "benchmark.benchmark_runner", "benchmark.gen_data",
+    "benchmark.gen_data_distributed",
+]
+for m in mods:
+    importlib.import_module(m)
+print(f"{len(mods)} modules import cleanly")
+EOF
+
+echo "== unit tests =="
+python -m pytest tests/ -q "$@"
+
+echo "== benchmark smoke =="
+BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \
+    JAX_PLATFORMS=cpu python bench.py
+
+echo "== multichip dryrun =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py 8
+
+echo "CI PASSED"

@@ -51,3 +51,54 @@ def test_gen_data_parquet(tmp_path):
     gen_data.write_parquet(X, None, path2, feature_layout="scalar")
     df2 = pd.read_parquet(path2)
     assert list(df2.columns) == [f"c{i}" for i in range(6)]
+
+
+def test_gen_data_distributed_consistency(tmp_path):
+    """Partition-decomposable generation: any partitioning of the same
+    (kind, seed, shape) yields the same dataset, and the streaming fit
+    recovers the shared structure."""
+    import pyarrow.parquet as pq
+
+    from benchmark.gen_data_distributed import generate_partitioned
+
+    a = generate_partitioned(
+        "regression", 2000, 8, str(tmp_path / "a"), parts=4, seed=7
+    )
+    t = pq.read_table(a)
+    assert t.num_rows == 2000
+    # two datagen workers writing interleaved parts == one worker
+    b_dir = str(tmp_path / "b")
+    generate_partitioned("regression", 2000, 8, b_dir, parts=4, seed=7,
+                         part_offset=0, part_stride=2)
+    generate_partitioned("regression", 2000, 8, b_dir, parts=4, seed=7,
+                         part_offset=1, part_stride=2)
+    tb = pq.read_table(b_dir)
+    assert t.equals(tb)
+
+
+def test_gen_data_distributed_streaming_fit(tmp_path):
+    import numpy as np
+
+    from benchmark.gen_data_distributed import RegressionGen, generate_partitioned
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    out = generate_partitioned(
+        "regression", 3000, 6, str(tmp_path / "reg"), parts=6, seed=3,
+        noise=0.01,
+    )
+    model = LinearRegression().fit(out)  # parquet-path streaming ingest
+    w = RegressionGen(6, noise=0.01).shared(3)
+    np.testing.assert_allclose(model.coef_, w, rtol=0.05, atol=0.5)
+
+
+def test_gen_data_distributed_kinds(tmp_path):
+    import pyarrow.parquet as pq
+
+    from benchmark.gen_data_distributed import GENERATORS, generate_partitioned
+
+    for kind in GENERATORS:
+        out = generate_partitioned(
+            kind, 300, 5, str(tmp_path / kind), parts=3, seed=1
+        )
+        t = pq.read_table(out)
+        assert t.num_rows == 300, kind
