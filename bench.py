@@ -223,8 +223,41 @@ def bench_umap(extra: dict):
     extra["umap_100kx32_rows_per_sec"] = round(n / el, 1)
 
 
+_state = {"rows_per_sec": 0.0, "vs_baseline": 0.0, "extra": {}, "printed": False}
+
+
+def _emit() -> None:
+    if _state["printed"]:
+        return
+    print(
+        json.dumps(
+            {
+                "metric": f"logreg_fit_rows_per_sec ({N_ROWS}x{N_COLS}, "
+                f"maxIter={MAX_ITER})",
+                "value": round(_state["rows_per_sec"], 1),
+                "unit": "rows/sec/chip",
+                "vs_baseline": round(_state["vs_baseline"], 3),
+                "extra": _state["extra"],
+            }
+        ),
+        flush=True,
+    )
+    # set only after a complete write: a SIGTERM mid-print must not mark
+    # the truncated line as already-emitted
+    _state["printed"] = True
+
+
 def main() -> None:
-    extra: dict = {}
+    import signal
+
+    def _on_term(signum, frame):  # a driver timeout still records progress
+        _state["extra"]["terminated"] = f"signal {signum}"
+        _emit()
+        raise SystemExit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    extra = _state["extra"]
     benches = {
         "pca": bench_pca,
         "kmeans": bench_kmeans,
@@ -234,31 +267,21 @@ def main() -> None:
     }
     # logreg is the headline and ALWAYS runs (the driver needs the metric
     # line); a failure is still recorded as a JSON line rather than a crash
+    print("bench: logreg ...", file=sys.stderr, flush=True)
     try:
-        rows_per_sec, vs_baseline = bench_logreg(extra)
+        _state["rows_per_sec"], _state["vs_baseline"] = bench_logreg(extra)
     except Exception as e:
         extra["logreg_error"] = f"{type(e).__name__}: {e}"[:200]
-        rows_per_sec, vs_baseline = 0.0, 0.0
     for name, fn in benches.items():
         if name not in WORKLOADS:
             continue
+        print(f"bench: {name} ...", file=sys.stderr, flush=True)
         try:
             fn(extra)
         except Exception as e:  # non-headline failures are recorded, not fatal
             extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    print(
-        json.dumps(
-            {
-                "metric": f"logreg_fit_rows_per_sec ({N_ROWS}x{N_COLS}, "
-                f"maxIter={MAX_ITER})",
-                "value": round(rows_per_sec, 1),
-                "unit": "rows/sec/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "extra": extra,
-            }
-        )
-    )
+    _emit()
 
 
 if __name__ == "__main__":

@@ -43,6 +43,9 @@ _DEFAULTS: Dict[str, Any] = {
     # Force the multi-pass streaming-statistics fit path regardless of the
     # device-memory estimate (testing / beyond-HBM workloads).
     "force_streaming_stats": False,
+    # When set, fits run under jax.profiler.trace writing an XProf/
+    # TensorBoard device profile here (tracing.py device_profile).
+    "profile_dir": None,
     # Multi-host bootstrap: coordinator address for jax.distributed
     # (analog of the NCCL-uid allGather bootstrap, cuml_context.py:96-102).
     "coordinator_address": None,
@@ -61,6 +64,7 @@ _TYPES: Dict[str, type] = {
     "process_id": int,
     "num_processes": int,
     "coordinator_address": str,
+    "profile_dir": str,
 }
 
 

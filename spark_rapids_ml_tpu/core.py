@@ -45,6 +45,12 @@ class FitInput:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+def _is_oom(e: BaseException) -> bool:
+    """Whether an exception is an XLA device-memory exhaustion."""
+    s = str(e)
+    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
+
+
 def _resolve_feature_params(inst: Params) -> Tuple[Optional[str], Sequence[str]]:
     """Which column(s) hold features: featuresCol/featuresCols for
     predictors, inputCol/inputCols for feature transformers like PCA
@@ -482,18 +488,42 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     f"multi-pass streamed statistics."
                 )
                 return self._fit_streaming(path)
-        ds_dev = stage_parquet(
-            path,
-            features_col=fcol,
-            features_cols=fcols,
-            label_col=label_col,
-            weight_col=weight_col,
-            num_workers=self.num_workers,
-            dtype=dtype,
-            label_dtype=self._fit_label_dtype() if label_col else None,
-            chunk_rows=None,
-        )
-        return self._fit_array(self._stage_from_device(ds_dev))
+        ds_dev = fit_input = None
+        try:
+            ds_dev = stage_parquet(
+                path,
+                features_col=fcol,
+                features_cols=fcols,
+                label_col=label_col,
+                weight_col=weight_col,
+                num_workers=self.num_workers,
+                dtype=dtype,
+                label_dtype=self._fit_label_dtype() if label_col else None,
+                chunk_rows=None,
+            )
+            fit_input = self._stage_from_device(ds_dev)
+            return self._fit_array(fit_input)
+        except Exception as e:
+            # drop the staged buffers BEFORE any retry — keeping them alive
+            # would hold the very HBM whose exhaustion we are recovering from
+            ds_dev = fit_input = None  # noqa: F841
+            # OOM backoff (the analog of the reference's reserved-memory
+            # retry loop, utils.py:403-522): fall back to the multi-pass
+            # streamed-statistics fit when the estimator supports it
+            if not _is_oom(e):
+                raise
+            if self._supports_streaming_stats():
+                self.logger.warning(
+                    "Device staging exhausted HBM; retrying as a "
+                    "multi-pass streaming-statistics fit."
+                )
+                return self._fit_streaming(path)
+            raise RuntimeError(
+                "Dataset exceeds device memory while stream-staging and "
+                f"{type(self).__name__} cannot fit from streamed "
+                "statistics; raise num_workers (more chips) or reduce "
+                "the dataset"
+            ) from e
 
     def _fit(self, dataset: DatasetLike) -> "_TpuModel":
         if self._use_cpu_fallback():
@@ -510,21 +540,30 @@ class _TpuEstimator(Estimator, _TpuCaller):
             self._copyValues(model)
             return model
         t0 = time.time()
-        attrs = None
-        if isinstance(dataset, DeviceDataset):
-            fit_input = self._stage_from_device(dataset)
-            attrs = self._fit_array(fit_input)
-        else:
-            from .config import get_config
-            from .streaming import is_parquet_path
+        from .tracing import device_profile, trace
 
-            if is_parquet_path(dataset) and get_config("streaming_ingest"):
-                attrs = self._stage_or_stream(dataset)
-            if attrs is None:
-                batch = self._extract(dataset)
-                self._validate_input(batch)
-                fit_input = self._stage_fit_input(batch)
-                attrs = self._fit_array(fit_input)
+        attrs = None
+        with device_profile():
+            if isinstance(dataset, DeviceDataset):
+                with trace("stage_from_device", self.logger):
+                    fit_input = self._stage_from_device(dataset)
+                with trace("fit_kernel", self.logger):
+                    attrs = self._fit_array(fit_input)
+            else:
+                from .config import get_config
+                from .streaming import is_parquet_path
+
+                if is_parquet_path(dataset) and get_config("streaming_ingest"):
+                    with trace("stream_ingest_fit", self.logger):
+                        attrs = self._stage_or_stream(dataset)
+                if attrs is None:
+                    with trace("extract", self.logger):
+                        batch = self._extract(dataset)
+                        self._validate_input(batch)
+                    with trace("stage", self.logger):
+                        fit_input = self._stage_fit_input(batch)
+                    with trace("fit_kernel", self.logger):
+                        attrs = self._fit_array(fit_input)
         model = self._create_model(attrs)
         self._copyValues(model)
         model._num_workers = self._num_workers
@@ -668,18 +707,38 @@ class _TpuModel(Model, _TpuCaller):
             # can't run on 0 rows)
             dummy = self._transform_mesh(np.zeros((1, d), X.dtype))
             return {c: v[:0] for c, v in dummy.items()}
+        from .tracing import trace
+
+        n_dev = mesh.devices.size
         outs: Dict[str, List[np.ndarray]] = {}
-        for lo in range(0, n, chunk):
-            Xc = np.ascontiguousarray(X[lo : lo + chunk])
-            st = RowStager.for_replicated(Xc.shape[0], mesh)
-            dev = self._transform_device(st.stage(Xc, X.dtype))
-            for col, v in dev.items():
-                outs.setdefault(col, []).append(
-                    st.fetch(v)
-                    if isinstance(v, jax.Array)
-                    else np.asarray(v)[: st.n_valid]
+        lo = 0
+        while lo < n:
+            try:
+                with trace(
+                    f"transform_chunk[{lo}:{min(lo + chunk, n)}]", self.logger
+                ):
+                    Xc = np.ascontiguousarray(X[lo : lo + chunk])
+                    st = RowStager.for_replicated(Xc.shape[0], mesh)
+                    dev = self._transform_device(st.stage(Xc, X.dtype))
+                    for col, v in dev.items():
+                        outs.setdefault(col, []).append(
+                            st.fetch(v)
+                            if isinstance(v, jax.Array)
+                            else np.asarray(v)[: st.n_valid]
+                        )
+                lo += chunk
+            except Exception as e:
+                # OOM backoff: halve the chunk and RESUME at the failing row
+                # (completed chunks are kept — the analog of the reference's
+                # reserved-memory OOM loop, utils.py:403-522)
+                if not _is_oom(e) or chunk <= n_dev:
+                    raise
+                chunk = max(chunk // 2, n_dev)
+                self.logger.warning(
+                    f"Transform chunk exhausted device memory; resuming at "
+                    f"row {lo} with chunk={chunk} rows"
                 )
-        if n <= chunk:
+        if all(len(v) == 1 for v in outs.values()):
             return {c: v[0] for c, v in outs.items()}
         return {c: np.concatenate(v, axis=0) for c, v in outs.items()}
 

@@ -1,0 +1,121 @@
+#
+# Tracing / profiling — the analog of the reference's observability tier
+# (cuML verbose levels 0-6 routed to executors, reference core.py:413-436;
+# per-stage wall-clock logs in ANN, knn.py:1571-1687; benchmark
+# `with_benchmark` wrappers).  Two mechanisms:
+#
+#   - `trace(stage)`: a nestable per-process stage timer.  Events are
+#     recorded in-process (inspect with `get_trace_events` / `summarize`);
+#     at `verbose >= 1` each stage logs its wall-clock on exit, giving the
+#     per-stage timing breakdown the reference's verbose levels provide.
+#   - `profile_dir` config: when set, fits run under `jax.profiler.trace`,
+#     producing a TensorBoard/XProf trace of the actual device execution —
+#     the TPU-native deep-profiling path (there is no cuML logger to
+#     forward to; XLA's profiler is strictly more detailed).
+#
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+from .config import get_config
+from .utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.tracing")
+
+_tls = threading.local()
+
+# bounded event history per thread: long-lived serving processes transform
+# repeatedly and must not grow memory without bound
+MAX_EVENTS = 4096
+
+
+@dataclass
+class TraceEvent:
+    name: str
+    seconds: float
+    depth: int
+
+
+def _records() -> List[TraceEvent]:
+    rec = getattr(_tls, "records", None)
+    if rec is None:
+        rec = _tls.records = []
+    return rec
+
+
+def _append(event: TraceEvent) -> None:
+    rec = _records()
+    if len(rec) >= MAX_EVENTS:
+        del rec[: MAX_EVENTS // 2]  # drop the oldest half
+    rec.append(event)
+
+
+def get_trace_events() -> List[TraceEvent]:
+    """Events recorded on this thread since the last `reset_trace`."""
+    return list(_records())
+
+
+def reset_trace() -> None:
+    _records().clear()
+
+
+def summarize() -> str:
+    """Indented per-stage timing table for the recorded events."""
+    lines = [
+        f"{'  ' * e.depth}{e.name}: {e.seconds:.4f}s" for e in _records()
+    ]
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def trace(name: str, log: Optional[object] = None) -> Iterator[None]:
+    """Time a stage.  Nested stages indent; `verbose >= 1` logs on exit."""
+    depth = getattr(_tls, "depth", 0)
+    _tls.depth = depth + 1
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        _tls.depth = depth
+        _append(TraceEvent(name, dt, depth))
+        if int(get_config("verbose") or 0) >= 1:
+            (log or logger).info(f"[trace] {'  ' * depth}{name}: {dt:.4f}s")
+
+
+_profile_lock = threading.Lock()
+_profile_active = False
+
+
+@contextlib.contextmanager
+def device_profile() -> Iterator[None]:
+    """Wrap a region in `jax.profiler.trace` when `profile_dir` is set —
+    the XLA/TPU execution profile (TensorBoard `xprof` format).  The jax
+    profiler is process-global, so concurrent fits (fitMultiple consumers)
+    share one trace: only the first caller starts/stops it."""
+    global _profile_active
+    profile_dir = get_config("profile_dir")
+    if not profile_dir:
+        yield
+        return
+    with _profile_lock:
+        owner = not _profile_active
+        if owner:
+            import jax
+
+            jax.profiler.start_trace(str(profile_dir))
+            _profile_active = True
+    try:
+        yield
+    finally:
+        if owner:
+            with _profile_lock:
+                import jax
+
+                jax.profiler.stop_trace()
+                _profile_active = False
+            logger.info(f"Wrote device profile to {profile_dir}")
