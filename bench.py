@@ -250,6 +250,12 @@ def _emit() -> None:
 def main() -> None:
     import signal
 
+    from spark_rapids_ml_tpu.config import set_config
+
+    # fixed benchmark shapes gain nothing from compile-sharing buckets;
+    # exact padding keeps rows/sec honest
+    set_config(shape_bucketing=False)
+
     def _on_term(signum, frame):  # a driver timeout still records progress
         _state["extra"]["terminated"] = f"signal {signum}"
         _emit()

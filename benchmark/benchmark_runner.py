@@ -285,11 +285,17 @@ def bench_approximate_nearest_neighbors(args, report: Report) -> None:
         return
     from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
 
-    nlist = max(16, int(np.sqrt(args.num_rows)))
+    if args.algorithm == "cagra":
+        algo_params = {"graph_degree": 32}
+        extra_cfg = {"algorithm": "cagra", **algo_params}
+    else:
+        nlist = max(16, int(np.sqrt(args.num_rows)))
+        algo_params = {"nlist": nlist, "nprobe": max(1, nlist // 16)}
+        extra_cfg = {"algorithm": args.algorithm, **algo_params}
     model, build_s = with_benchmark(
         "tpu index build",
         lambda: ApproximateNearestNeighbors(
-            k=k, algoParams={"nlist": nlist, "nprobe": max(1, nlist // 16)},
+            k=k, algorithm=args.algorithm, algoParams=algo_params,
             num_workers=args.num_workers,
         ).fit(X),
     )
@@ -309,7 +315,7 @@ def bench_approximate_nearest_neighbors(args, report: Report) -> None:
     report.add(benchmark="approximate_nearest_neighbors", mode="tpu",
                num_rows=args.num_rows, num_cols=args.num_cols,
                fit_sec=build_s, transform_sec=search_s, score_name="recall",
-               score=recall, extra={"nlist": nlist, "k": k})
+               score=recall, extra={**extra_cfg, "k": k})
 
 
 def bench_umap(args, report: Report) -> None:
@@ -368,6 +374,9 @@ def main(argv: Optional[list] = None) -> None:
     p.add_argument("--num_trees", type=int, default=32)
     p.add_argument("--max_depth", type=int, default=10)
     p.add_argument("--n_classes", type=int, default=2)
+    p.add_argument("--algorithm", choices=["ivfflat", "ivfpq", "cagra"],
+                   default="ivfflat",
+                   help="approximate_nearest_neighbors index type")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--report", default=None, help="CSV report path (append)")
     args = p.parse_args(argv)

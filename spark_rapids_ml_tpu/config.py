@@ -46,6 +46,11 @@ _DEFAULTS: Dict[str, Any] = {
     # When set, fits run under jax.profiler.trace writing an XProf/
     # TensorBoard device profile here (tracing.py device_profile).
     "profile_dir": None,
+    # Pad staged row counts up to {1, 1.5} x 2^k buckets so nearby dataset
+    # sizes share one XLA compilation (k-fold CV / fitMultiple folds differ
+    # by a few rows and would otherwise each pay the full compile).  Costs
+    # at most 50% masked padding rows; disable for exact-shape staging.
+    "shape_bucketing": True,
     # Multi-host bootstrap: coordinator address for jax.distributed
     # (analog of the NCCL-uid allGather bootstrap, cuml_context.py:96-102).
     "coordinator_address": None,

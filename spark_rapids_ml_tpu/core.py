@@ -724,7 +724,7 @@ class _TpuModel(Model, _TpuCaller):
                         outs.setdefault(col, []).append(
                             st.fetch(v)
                             if isinstance(v, jax.Array)
-                            else np.asarray(v)[: st.n_valid]
+                            else st.trim_host(np.asarray(v))
                         )
                 lo += chunk
             except Exception as e:

@@ -35,6 +35,24 @@ MODEL_AXIS = "model"
 _mesh_cache = {}
 
 
+def bucket_rows(n: int) -> int:
+    """Smallest {1, 1.5} x 2^k >= n (min 256): the shape-bucketing grid.
+
+    Kernels jit-compile per padded shape; padding row counts to a coarse
+    grid lets k-fold CV folds, fitMultiple re-fits, and transform tail
+    chunks of nearby sizes reuse one compilation (the round-1 finding: an
+    87.8s cold compile re-paid per (shape, static-arg) combo).  Padding
+    rows carry zero weight, so they are masked out of every kernel."""
+    if n <= 256:
+        return 256
+    p = 1 << (int(n - 1).bit_length() - 1)  # largest power of two < n... or ==
+    # candidates around n: p, 1.5p, 2p
+    for c in (p, p + p // 2, 2 * p):
+        if c >= n:
+            return c
+    return 2 * p
+
+
 def get_mesh(num_workers: Optional[int] = None) -> Mesh:
     """A 1-D mesh over the first `num_workers` visible devices.  `num_workers`
     is the analog of the reference's `num_workers` (= #GPUs = #barrier tasks,
@@ -66,8 +84,11 @@ class RowStager:
     """Stages host arrays onto the mesh with one consistent padded row
     layout, so X / y / weights / masks / row-ids always line up.
 
-    Single-process (the common case): the caller holds the full dataset;
-    rows 0..n_valid-1 are real, zero-padding sits at the global tail.
+    Single-process (the common case): the caller holds the full dataset.
+    With small (exact-shape) padding rows stay contiguous with the zero
+    padding at the global tail; once bucket padding could unbalance the
+    per-device split, rows interleave round-robin over devices (see
+    `_to_layout`) so every device holds an even share of valid rows.
 
     Multi-process (pods): every process holds only its LOCAL rows — the
     analog of the reference's per-partition data loading (each Spark barrier
@@ -85,11 +106,25 @@ class RowStager:
         self.n_proc = jax.process_count()
         self._replicated_input = False
         if self.n_proc == 1:
+            from ..config import get_config
+
             n_dev = mesh.devices.size
             self.n_local = int(n_local_rows)
             self.n_valid = self.n_local
-            self.local_padded = self.n_local + ((-self.n_local) % n_dev)
+            target = (
+                bucket_rows(self.n_local)
+                if get_config("shape_bucketing")
+                else self.n_local
+            )
+            self.local_padded = target + ((-target) % n_dev)
             self.n_padded = self.local_padded
+            self._n_dev = n_dev
+            # interleave only when padding is big enough to unbalance the
+            # contiguous per-device split (bucketed padding); exact-shape
+            # staging keeps the copy-free contiguous layout
+            self._interleave = (
+                n_dev > 1 and (self.local_padded - self.n_local) >= n_dev
+            )
         else:
             from jax.experimental import multihost_utils
 
@@ -132,6 +167,11 @@ class RowStager:
             for c, l in zip(counts, ldc_all)
         )
         s = max(s, 1)
+        # NOTE: no shape bucketing here — multi-process blocks shard
+        # contiguously per device, so bucket padding could leave whole
+        # devices holding only padding (per-device work like the RF
+        # ensemble would silently starve); per-process loading already
+        # bounds padding to < one device share
         self.counts = counts
         self.n_local = int(counts[pid])
         self.n_valid = int(counts.sum())
@@ -215,10 +255,52 @@ class RowStager:
             padded = arr
         sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
         if self.n_proc == 1:
-            return jax.device_put(padded, sharding)
+            return jax.device_put(self._to_layout(padded), sharding)
         return jax.make_array_from_process_local_data(
             sharding, padded, (self.n_padded,) + padded.shape[1:]
         )
+
+    # -- single-process round-robin device layout ---------------------------
+    #
+    # Sharding splits axis 0 into contiguous per-device blocks.  With
+    # tail padding (especially bucketed padding, which can exceed n/n_dev
+    # rows) contiguous blocks would leave the LAST devices mostly or
+    # entirely padding — fatal for per-device work like the RF ensemble
+    # (a device with no valid rows grows an empty tree).  Host rows are
+    # therefore interleaved round-robin: row j lands on device j % n_dev,
+    # so every device holds an even share of valid rows no matter how much
+    # padding the bucket adds.  The transform is one reshape+transpose copy.
+
+    def _to_layout(self, padded: np.ndarray) -> np.ndarray:
+        if not getattr(self, "_interleave", False):
+            return padded
+        n_dev = self._n_dev
+        s = self.local_padded // n_dev
+        return np.ascontiguousarray(
+            padded.reshape((s, n_dev) + padded.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(padded.shape)
+        )
+
+    def _from_layout(self, laid_out: np.ndarray) -> np.ndarray:
+        if not getattr(self, "_interleave", False):
+            return laid_out
+        n_dev = self._n_dev
+        s = self.local_padded // n_dev
+        return (
+            laid_out.reshape((n_dev, s) + laid_out.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(laid_out.shape)
+        )
+
+    def trim_host(self, host: np.ndarray) -> np.ndarray:
+        """Valid rows, in input order, of a HOST array shaped like the
+        staged layout (the host-side sibling of `fetch`).  Multi-process
+        stagers fall back to a plain head-trim — only constant-per-row
+        host outputs (degenerate-model paths) take that branch."""
+        if self.n_proc == 1:
+            return self._from_layout(np.asarray(host))[: self.n_valid]
+        return np.asarray(host)[: self.n_valid]
 
     def mask(self, dtype=np.float32, weights: Optional[np.ndarray] = None) -> jax.Array:
         """Validity weights (weight for real rows, 0 for padding), staged
@@ -236,7 +318,8 @@ class RowStager:
         whole dataset in every device's HBM), drop this block's tail
         padding, then allgather the host blocks."""
         if self.n_proc == 1:
-            return np.asarray(jax.device_get(arr))[: self.n_valid]
+            host = np.asarray(jax.device_get(arr))
+            return self._from_layout(host)[: self.n_valid]
         if arr.is_fully_replicated:
             host = np.asarray(jax.device_get(arr))
             offs = np.concatenate([[0], np.cumsum(self.block_sizes)])
@@ -261,7 +344,7 @@ class RowStager:
         padded[: self.n_local] = ids
         sharding = NamedSharding(self.mesh, data_pspec(1))
         if self.n_proc == 1:
-            return jax.device_put(padded, sharding)
+            return jax.device_put(self._to_layout(padded), sharding)
         return jax.make_array_from_process_local_data(
             sharding, padded, (self.n_padded,)
         )
@@ -344,22 +427,6 @@ def shard_rows(
     """
     st = RowStager(arr.shape[0], mesh)
     return st.stage(arr, dtype), st.n_valid
-
-
-def row_mask(n_valid: int, n_padded: int, mesh: Mesh, dtype=np.float32) -> jax.Array:
-    """Validity weights for padded rows (1 real, 0 pad), sharded like data.
-
-    Single-process only (padding is a global tail there); multi-process
-    callers must use `RowStager.mask` because padding interleaves."""
-    if jax.process_count() > 1:
-        raise RuntimeError(
-            "row_mask assumes tail padding; use RowStager.mask in "
-            "multi-process mode"
-        )
-    w = np.zeros((n_padded,), dtype=dtype)
-    w[:n_valid] = 1.0
-    sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
-    return jax.device_put(w, sharding)
 
 
 def replicate(arr: Union[np.ndarray, jax.Array], mesh: Mesh) -> jax.Array:

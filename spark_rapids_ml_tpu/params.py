@@ -613,7 +613,9 @@ class _TpuParams(_TpuClass, Params):
         value_map = self._param_value_mapping()
         for k, v in kwargs.items():
             if k == "num_workers":
-                self._num_workers = int(v)
+                # None keeps the default (all visible devices), matching the
+                # reference's inferred num_workers (params.py:556-588)
+                self._num_workers = int(v) if v is not None else None
                 continue
             if k == "float32_inputs":
                 self._float32_inputs = bool(v)

@@ -110,7 +110,10 @@ def test_approx_similarity_join(blobs):
     assert len(join_df) == 15
     # self-neighbors at distance ~0
     self_rows = join_df[join_df["item_id"] == join_df["query_id"]]
-    assert np.allclose(self_rows["dist"], 0.0, atol=1e-3)
+    # f32 matmul-identity distances carry ~eps*||x||^2 cancellation noise
+    # (see test_ivfflat_full_probe_is_exact); at blob norms that is ~2e-2
+    # in euclidean units
+    assert np.allclose(self_rows["dist"], 0.0, atol=5e-2)
 
 
 def test_ann_save_load(tmp_path, blobs):

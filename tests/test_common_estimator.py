@@ -220,3 +220,48 @@ def test_transform_empty_dataframe():
     out = model.transform(empty)
     assert len(out) == 0
     assert "prediction" in out.columns
+
+
+def test_shape_bucketing_shares_padded_shapes(rng):
+    """Nearby dataset sizes stage to ONE padded shape (compile reuse);
+    disabling bucketing restores exact padding."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.parallel.mesh import RowStager, bucket_rows, get_mesh
+
+    mesh = get_mesh(4)
+    try:
+        a = RowStager(900, mesh)
+        b = RowStager(1000, mesh)
+        assert a.n_padded == b.n_padded == 1024
+        assert a.n_valid == 900 and b.n_valid == 1000
+        Xs = a.stage(np.ones((900, 3), np.float32))
+        assert Xs.shape[0] == 1024
+        # bucket grid: {1, 1.5} x 2^k
+        assert bucket_rows(1536) == 1536
+        assert bucket_rows(1537) == 2048
+        assert bucket_rows(10) == 256
+        set_config(shape_bucketing=False)
+        c = RowStager(1000, mesh)
+        assert c.n_padded == 1000
+    finally:
+        reset_config()
+
+
+def test_bucketed_fit_matches_exact(rng):
+    import numpy as np
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X = rng.normal(size=(900, 4))
+    y = X @ np.array([1.0, -2.0, 0.5, 3.0]) + 0.5
+    m_bucket = LinearRegression(float32_inputs=False).fit((X, y))
+    try:
+        set_config(shape_bucketing=False)
+        m_exact = LinearRegression(float32_inputs=False).fit((X, y))
+    finally:
+        reset_config()
+    np.testing.assert_allclose(m_bucket.coef_, m_exact.coef_, rtol=1e-10)
+    np.testing.assert_allclose(m_bucket.intercept_, m_exact.intercept_, rtol=1e-10)

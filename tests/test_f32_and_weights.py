@@ -120,9 +120,12 @@ def test_weighted_linreg_equals_repeated_rows(rng):
 
 
 def test_weighted_kmeans_equals_repeated_rows(rng):
+    # well-separated blobs: the optimum is unique, so both datasets must
+    # converge to the SAME centers even though their inits differ
     from sklearn.datasets import make_blobs
 
-    X, _ = make_blobs(n_samples=200, n_features=3, centers=3, random_state=2)
+    X, _ = make_blobs(n_samples=200, n_features=3, centers=3,
+                      cluster_std=0.4, random_state=2)
     w = rng.integers(1, 4, size=200).astype(np.float64)
     df_w = pd.DataFrame({"features": list(X), "w": w})
     Xr = np.repeat(X, w.astype(int), axis=0)
