@@ -287,11 +287,10 @@ def bench_approximate_nearest_neighbors(args, report: Report) -> None:
 
     if args.algorithm == "cagra":
         algo_params = {"graph_degree": 32}
-        extra_cfg = {"algorithm": "cagra", **algo_params}
     else:
         nlist = max(16, int(np.sqrt(args.num_rows)))
         algo_params = {"nlist": nlist, "nprobe": max(1, nlist // 16)}
-        extra_cfg = {"algorithm": args.algorithm, **algo_params}
+    extra_cfg = {"algorithm": args.algorithm, **algo_params}
     model, build_s = with_benchmark(
         "tpu index build",
         lambda: ApproximateNearestNeighbors(

@@ -53,6 +53,19 @@ def bucket_rows(n: int) -> int:
     return 2 * p
 
 
+def bucket_rows_floor(n: int) -> int:
+    """Largest bucket grid point <= n (min 256).  Chunked drivers size
+    their FULL chunks with this so no chunk carries bucket padding; only
+    the tail chunk buckets up."""
+    if n <= 256:
+        return 256
+    b = bucket_rows(n)
+    if b == n:
+        return n
+    # previous grid point: 1.5*2^k points are divisible by 3, 2^k never is
+    return (2 * b) // 3 if b % 3 == 0 else (3 * b) // 4
+
+
 def get_mesh(num_workers: Optional[int] = None) -> Mesh:
     """A 1-D mesh over the first `num_workers` visible devices.  `num_workers`
     is the analog of the reference's `num_workers` (= #GPUs = #barrier tasks,
@@ -100,22 +113,24 @@ class RowStager:
     which is why masks/labels must be staged through the same object.
     """
 
-    def __init__(self, n_local_rows: int, mesh: Mesh) -> None:
+    def __init__(
+        self, n_local_rows: int, mesh: Mesh,
+        bucketing: Optional[bool] = None,
+    ) -> None:
         _ensure_distributed()
         self.mesh = mesh
         self.n_proc = jax.process_count()
         self._replicated_input = False
+        self._interleave = False
         if self.n_proc == 1:
             from ..config import get_config
 
+            if bucketing is None:
+                bucketing = bool(get_config("shape_bucketing"))
             n_dev = mesh.devices.size
             self.n_local = int(n_local_rows)
             self.n_valid = self.n_local
-            target = (
-                bucket_rows(self.n_local)
-                if get_config("shape_bucketing")
-                else self.n_local
-            )
+            target = bucket_rows(self.n_local) if bucketing else self.n_local
             self.local_padded = target + ((-target) % n_dev)
             self.n_padded = self.local_padded
             self._n_dev = n_dev
@@ -212,6 +227,7 @@ class RowStager:
         st.mesh = mesh
         st.n_proc = n_proc
         st._replicated_input = True
+        st._interleave = False  # multi-process blocks stay contiguous
         st._lo = int(counts[:pid].sum())
         st._init_layout(counts, mesh)
         # n_valid for a replicated stager is the full input length the
@@ -272,7 +288,7 @@ class RowStager:
     # padding the bucket adds.  The transform is one reshape+transpose copy.
 
     def _to_layout(self, padded: np.ndarray) -> np.ndarray:
-        if not getattr(self, "_interleave", False):
+        if not self._interleave:
             return padded
         n_dev = self._n_dev
         s = self.local_padded // n_dev
@@ -283,7 +299,7 @@ class RowStager:
         )
 
     def _from_layout(self, laid_out: np.ndarray) -> np.ndarray:
-        if not getattr(self, "_interleave", False):
+        if not self._interleave:
             return laid_out
         n_dev = self._n_dev
         s = self.local_padded // n_dev
@@ -295,12 +311,16 @@ class RowStager:
 
     def trim_host(self, host: np.ndarray) -> np.ndarray:
         """Valid rows, in input order, of a HOST array shaped like the
-        staged layout (the host-side sibling of `fetch`).  Multi-process
-        stagers fall back to a plain head-trim — only constant-per-row
-        host outputs (degenerate-model paths) take that branch."""
-        if self.n_proc == 1:
-            return self._from_layout(np.asarray(host))[: self.n_valid]
-        return np.asarray(host)[: self.n_valid]
+        staged layout (the host-side sibling of `fetch`).  Arrays NOT in
+        the staged layout (length != local_padded — e.g. already-trimmed
+        host outputs in original order) are head-trimmed untouched.
+        Multi-process stagers fall back to a plain head-trim — only
+        constant-per-row host outputs (degenerate-model paths) take that
+        branch."""
+        host = np.asarray(host)
+        if self.n_proc == 1 and host.shape[0] == self.local_padded:
+            return self._from_layout(host)[: self.n_valid]
+        return host[: self.n_valid]
 
     def mask(self, dtype=np.float32, weights: Optional[np.ndarray] = None) -> jax.Array:
         """Validity weights (weight for real rows, 0 for padding), staged
@@ -424,8 +444,13 @@ def shard_rows(
     process's local rows).  Returns (global sharded jax.Array, true GLOBAL
     row count before padding).  Callers that also need masks/labels/ids in
     multi-process mode should use `RowStager` directly so layouts line up.
+
+    This thin wrapper keeps the ORIGINAL contiguous-tail-padding contract
+    (no bucketing, no interleave): its return value exposes no stager, so
+    `device_get(...)[:n]` must stay a valid way to recover the rows.
+    Bucketed/interleaved staging is RowStager-only.
     """
-    st = RowStager(arr.shape[0], mesh)
+    st = RowStager(arr.shape[0], mesh, bucketing=False)
     return st.stage(arr, dtype), st.n_valid
 
 
