@@ -93,6 +93,20 @@ def bench_logreg(extra: dict):
     extra["logreg_compile_overhead_sec"] = round(cold - elapsed, 2)
     rows_per_sec = N_ROWS / elapsed
 
+    # bf16 feature-storage variant: the HBM-bandwidth lever (solver f32)
+    from spark_rapids_ml_tpu.config import set_config
+
+    try:
+        set_config(bf16_features=True)
+        fit()  # compile at the bf16 shapes
+        bf16 = min(fit()[0] for _ in range(3))
+        extra["logreg_bf16_warm_fit_sec"] = round(bf16, 3)
+        extra["logreg_bf16_rows_per_sec"] = round(N_ROWS / bf16, 1)
+    except Exception as e:
+        extra["logreg_bf16_error"] = f"{type(e).__name__}: {e}"[:200]
+    finally:
+        set_config(bf16_features=False)
+
     # distributed batched transform throughput (mesh-sharded driver)
     n_t = min(N_ROWS, 1_000_000)
     model._transform_array(X[:n_t])  # warm

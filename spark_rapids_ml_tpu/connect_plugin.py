@@ -71,11 +71,20 @@ def _scalar_attributes(model) -> Dict[str, Any]:
 
     out: Dict[str, Any] = {}
     for k, v in model._get_model_attributes().items():
+        if isinstance(v, (np.integer, np.floating, np.bool_)):
+            v = v.item()
         if isinstance(v, (str, int, float, bool)) or v is None:
             out[k] = v
+        elif isinstance(v, list) and all(
+            isinstance(x, (str, int, float, bool)) for x in v
+        ):
+            out[k] = v  # e.g. classes_
         elif isinstance(v, np.ndarray):
             out[k + "_shape"] = list(v.shape)
     return out
+
+
+_model_cache: Dict[Any, Any] = {}
 
 
 def handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
@@ -111,12 +120,19 @@ def handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
         model_path = req.get("model_path")
         if not model_path:
             return {"status": "error", "error": "transform requires model_path"}
-        model = model_cls.load(model_path)
+        # long-lived workers serve many transforms per model: cache the
+        # loaded model (and with it the lazily staged device index)
+        key = (operator, str(model_path))
+        model = _model_cache.get(key)
+        if model is None:
+            model = model_cls.load(model_path)
+            _model_cache.clear()  # one resident model keeps HBM bounded
+            _model_cache[key] = model
         if params:
             model._set_params(**params)
-        import pyarrow.parquet as pq
+        from .data import _to_pandas
 
-        pdf = pq.read_table(data).to_pandas()
+        pdf = _to_pandas(data)
         out_df = model.transform(pdf)
         output_path = req.get("output_path")
         num_rows = int(len(out_df))

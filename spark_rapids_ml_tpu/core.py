@@ -701,13 +701,15 @@ class _TpuModel(Model, _TpuCaller):
         mesh = get_mesh(
             self._num_workers if jax.process_count() == 1 else None
         )
+        from .config import get_config
         from .parallel.mesh import bucket_rows_floor
 
         # floor the chunk to the bucket grid: full chunks then carry ZERO
         # bucket padding and still share one compilation; only the tail
-        # chunk buckets up
+        # chunk buckets up (moot when bucketing is off)
         chunk = max(int(chunk_rows_for(d, X.dtype.itemsize)), mesh.devices.size)
-        chunk = max(bucket_rows_floor(chunk), mesh.devices.size)
+        if get_config("shape_bucketing"):
+            chunk = max(bucket_rows_floor(chunk), mesh.devices.size)
         if n == 0:
             # transform one dummy row, trim everything (static-shape kernels
             # can't run on 0 rows)

@@ -398,6 +398,15 @@ class LogisticRegression(
                         X, w, jnp.zeros_like(mean), std
                     )
                     mean = None
+            from ..config import get_config
+
+            if get_config("bf16_features") and X.dtype == jnp.float32:
+                # bandwidth lever: the L-BFGS margin/gradient matvecs are
+                # HBM-bound; bf16 feature STORAGE halves the bytes per
+                # iteration while the solver state and accumulation stay
+                # f32 (the MXU consumes bf16 natively).  Opt-in: costs ~3
+                # decimal digits of feature precision.
+                X = X.astype(jnp.bfloat16)
             if binomial:
                 coef, b, loss, n_iter = logreg_fit_binary(
                     X, w, fit_input.y, **kwargs

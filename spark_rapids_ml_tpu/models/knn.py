@@ -158,18 +158,26 @@ class _NNModelBase(_TpuModel):
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
 
-    def _apply_metric(self, d2: np.ndarray) -> np.ndarray:
-        """Map squared-euclidean kernel output to the requested metric."""
-        metric = "euclidean"
+    def _metric(self) -> str:
         if self.hasParam("metric"):
-            metric = str(self._tpu_params.get("metric",
-                                              self.getOrDefault("metric")))
+            return str(self._tpu_params.get("metric",
+                                            self.getOrDefault("metric")))
+        return "euclidean"
+
+    def _apply_metric(self, d2: np.ndarray) -> np.ndarray:
+        """Map squared-euclidean kernel output to the requested metric.
+        Cosine search runs on unit vectors, where cosine distance
+        1 - cos = ||u-v||^2 / 2 (the cuVS cosine convention)."""
+        metric = self._metric()
         if metric == "sqeuclidean":
             return d2
         if metric == "euclidean":
             return np.sqrt(d2)
+        if metric == "cosine":
+            return d2 / 2.0
         raise ValueError(
-            f"metric '{metric}' is not supported; use euclidean or sqeuclidean"
+            f"metric '{metric}' is not supported; use euclidean, "
+            "sqeuclidean, or cosine"
         )
 
     def kneighbors(
@@ -350,12 +358,12 @@ class _ANNClass:
 
 class _ANNParams(_KNNParams):
     algorithm = Param("_", "algorithm",
-                      "ANN algorithm: 'ivfflat' or 'ivfpq'.",
+                      "ANN algorithm: ivfflat, ivfpq, or cagra.",
                       TypeConverters.toString)
     algoParams = Param("_", "algoParams",
                        "algorithm-specific parameters (nlist/nprobe/M/n_bits/"
                        "refine_ratio).", TypeConverters.identity)
-    metric = Param("_", "metric", "distance metric (euclidean/sqeuclidean).",
+    metric = Param("_", "metric", "distance metric (euclidean/sqeuclidean/cosine).",
                    TypeConverters.toString)
 
     def __init__(self) -> None:
@@ -431,6 +439,18 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
                 f"algorithm '{algo}' is not supported; choose from "
                 f"{_SUPPORTED_ANN_ALGOS}"
             )
+        metric = str(self._tpu_params.get("metric", "euclidean"))
+        if metric not in ("euclidean", "sqeuclidean", "cosine"):
+            raise ValueError(
+                f"metric '{metric}' is not supported; use euclidean, "
+                "sqeuclidean, or cosine"
+            )
+        if metric == "cosine":
+            # cuVS cosine == euclidean on unit vectors / 2: build the index
+            # over normalized items (queries normalize at search)
+            X = X / np.maximum(
+                np.linalg.norm(X, axis=1, keepdims=True), 1e-12
+            ).astype(np.float32)
         ap = dict(self._tpu_params.get("algo_params") or {})
         n = X.shape[0]
         nlist = int(ap.get("nlist", max(1, min(int(np.sqrt(n)), n))))
@@ -526,6 +546,10 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         with TpuContext(self.num_workers) as ctx:
             mesh = ctx.mesh
         Q = np.ascontiguousarray(Q, dtype=np.float32)
+        if self._metric() == "cosine":
+            Q = Q / np.maximum(
+                np.linalg.norm(Q, axis=1, keepdims=True), 1e-12
+            ).astype(np.float32)
         qst = RowStager.for_replicated(Q.shape[0], mesh)
         Qs = qst.stage(Q, np.float32)
         ap = dict(self._tpu_params.get("algo_params") or {})

@@ -145,8 +145,11 @@ def logreg_fit(
 
     Returns (W (n_classes,d), b (n_classes,), loss, n_iter).
     """
+    # solver state never drops below f32 (bf16 feature STORAGE is fine —
+    # the matmul accumulates f32 — but bf16 L-BFGS curvature pairs are not)
+    dtype = jnp.promote_types(X.dtype, jnp.float32)
     return _solve_multinomial(
-        lambda Wm: X @ Wm.T, n_classes, X.shape[1], X.dtype, w, y,
+        lambda Wm: X @ Wm.T, n_classes, X.shape[1], dtype, w, y,
         l2, l1, fit_intercept, tol, max_iter, history, ls_max,
     )
 
@@ -167,8 +170,9 @@ def logreg_fit_binary(
     ls_max: int = 20,
 ):
     """Dense binary fit; returns (coef (d,), intercept, loss, n_iter)."""
+    dtype = jnp.promote_types(X.dtype, jnp.float32)
     return _solve_binary(
-        lambda beta: X @ beta, X.shape[1], X.dtype, w, y,
+        lambda beta: X @ beta, X.shape[1], dtype, w, y,
         l2, l1, fit_intercept, tol, max_iter, history, ls_max,
     )
 

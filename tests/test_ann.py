@@ -203,3 +203,40 @@ def test_cagra_save_load(tmp_path, blobs):
     np.testing.assert_array_equal(
         np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
     )
+
+
+def test_cosine_metric_matches_sklearn(rng):
+    """cosine metric (cuVS metric surface, reference knn.py:860-865):
+    index over normalized items, distances = 1 - cos."""
+    X = rng.normal(size=(400, 12)).astype(np.float32)
+    k = 5
+    model = ApproximateNearestNeighbors(
+        k=k, metric="cosine", algoParams={"nlist": 8, "nprobe": 8}
+    ).fit(X)
+    _, _, knn_df = model.kneighbors(X[:60])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    got_d = np.stack(knn_df["distances"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute", metric="cosine").fit(X)
+    want_d, want_idx = sk.kneighbors(X[:60])
+    assert _recall(got_idx, want_idx) >= 0.99
+    np.testing.assert_allclose(np.sort(got_d), np.sort(want_d), atol=2e-3)
+
+
+def test_cosine_metric_cagra(rng):
+    X = rng.normal(size=(400, 12)).astype(np.float32)
+    k = 5
+    model = ApproximateNearestNeighbors(
+        k=k, metric="cosine", algorithm="cagra",
+        algoParams={"graph_degree": 16},
+    ).fit(X)
+    _, _, knn_df = model.kneighbors(X[:60])
+    got_idx = np.stack(knn_df["indices"].to_numpy())
+    sk = SkNN(n_neighbors=k, algorithm="brute", metric="cosine").fit(X)
+    _, want_idx = sk.kneighbors(X[:60])
+    assert _recall(got_idx, want_idx) >= 0.9
+
+
+def test_bad_metric_rejected_at_fit(rng):
+    X = rng.normal(size=(50, 4)).astype(np.float32)
+    with pytest.raises(ValueError, match="metric"):
+        ApproximateNearestNeighbors(metric="manhattan").fit(X)

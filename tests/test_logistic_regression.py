@@ -202,3 +202,27 @@ def test_unsupported_params():
         LogisticRegression(thresholds=[0.3, 0.7])
     with pytest.raises(ValueError, match="not supported"):
         LogisticRegression(regParam=-1.0)
+
+
+def test_bf16_features_close_to_f32(rng):
+    """bf16 feature storage (config bf16_features): coefficients must stay
+    close to the f32 fit — the bandwidth lever may cost ~3 digits of
+    feature precision but not solution quality."""
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    X = rng.normal(size=(3000, 16)).astype(np.float32)
+    beta = rng.normal(size=16)
+    y = (X @ beta > 0).astype(np.float64)
+    m32 = LogisticRegression(regParam=0.01, maxIter=200, tol=1e-9).fit((X, y))
+    try:
+        set_config(bf16_features=True)
+        m16 = LogisticRegression(regParam=0.01, maxIter=200, tol=1e-9).fit((X, y))
+    finally:
+        reset_config()
+    # relative coefficient agreement ~1% (bf16 has ~3 significant digits)
+    denom = np.maximum(np.abs(m32.coef_), 0.1)
+    rel = np.abs(m16.coef_ - m32.coef_) / denom
+    assert rel.max() < 0.05, rel.max()
+    p32 = m32._transform_array(X)["prediction"]
+    p16 = m16._transform_array(X)["prediction"]
+    assert (np.asarray(p32) == np.asarray(p16)).mean() > 0.995
