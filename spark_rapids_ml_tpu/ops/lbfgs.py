@@ -26,6 +26,9 @@ class LbfgsResult(NamedTuple):
     f: jax.Array
     n_iter: jax.Array
     converged: jax.Array
+    history_f: jax.Array  # (max_iter+1,) full objective per iteration
+    # (entry 0 = initial objective; entries past n_iter stay NaN) — the
+    # source of Spark's LogisticRegressionTrainingSummary.objectiveHistory
 
 
 def _pseudo_gradient(w: jax.Array, g: jax.Array, l1: jax.Array, l1_mask: jax.Array):
@@ -102,7 +105,7 @@ def lbfgs_minimize(
         return -r
 
     def body(state):
-        w, f, g, S, Y, rho, k, it, _ = state
+        w, f, g, S, Y, rho, k, it, _, hist = state
         pg = _pseudo_gradient(w, g, l1, l1_mask)
         p = direction(pg, S, Y, rho, k)
         # OWL-QN: force descent orthant agreement with -pseudo-gradient
@@ -155,13 +158,17 @@ def lbfgs_minimize(
         converged = (gnorm <= tol * jnp.maximum(1.0, jnp.linalg.norm(w_new))) | (
             jnp.abs(rel_impr) <= tol
         )
-        return w_new, f_new, g_new, S, Y, rho, k, it + 1, converged
+        hist = hist.at[it + 1].set(new_full)
+        return w_new, f_new, g_new, S, Y, rho, k, it + 1, converged, hist
 
     def cond(state):
-        _, _, _, _, _, _, _, it, converged = state
+        it, converged = state[7], state[8]
         return (it < max_iter) & (~converged)
 
     f0, g0 = value_and_grad(w0)
+    hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(
+        f0 + (l1 * l1_mask * jnp.abs(w0)).sum()
+    )
     state0 = (
         w0,
         f0,
@@ -172,6 +179,9 @@ def lbfgs_minimize(
         jnp.array(0, jnp.int32),
         jnp.array(0, jnp.int32),
         jnp.array(False),
+        hist0,
     )
-    w, f, g, S, Y, rho, k, it, converged = jax.lax.while_loop(cond, body, state0)
-    return LbfgsResult(w=w, f=f, n_iter=it, converged=converged)
+    w, f, g, S, Y, rho, k, it, converged, hist = jax.lax.while_loop(
+        cond, body, state0
+    )
+    return LbfgsResult(w=w, f=f, n_iter=it, converged=converged, history_f=hist)

@@ -70,7 +70,7 @@ def _solve_binary(
         l1=l1, l1_mask=l1_mask, ls_max=ls_max,
     )
     beta, b = unpack(res.w)
-    return beta, b, res.f, res.n_iter
+    return beta, b, res.f, res.n_iter, res.history_f
 
 
 def _solve_multinomial(
@@ -118,7 +118,7 @@ def _solve_multinomial(
         l1=l1, l1_mask=l1_mask, ls_max=ls_max,
     )
     Wm, b = unpack(res.w)
-    return Wm, b, res.f, res.n_iter
+    return Wm, b, res.f, res.n_iter, res.history_f
 
 
 @partial(

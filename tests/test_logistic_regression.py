@@ -226,3 +226,25 @@ def test_bf16_features_close_to_f32(rng):
     p32 = m32._transform_array(X)["prediction"]
     p16 = m16._transform_array(X)["prediction"]
     assert (np.asarray(p32) == np.asarray(p16)).mean() > 0.995
+
+
+def test_objective_history_summary(rng):
+    """Spark LogisticRegressionTrainingSummary parity: objectiveHistory is
+    monotone non-increasing and ends at the reported objective."""
+    X = rng.normal(size=(1000, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+    m = LogisticRegression(regParam=0.01, maxIter=50).fit((X, y))
+    assert m.hasSummary
+    h = m.summary.objectiveHistory
+    assert len(h) == m.num_iters + 1
+    assert m.summary.totalIterations == m.num_iters
+    diffs = np.diff(h)
+    assert (diffs <= 1e-7).all(), h  # monotone decrease (OWL-QN allows ~eps)
+    assert abs(h[-1] - m.objective) < 1e-5 * max(1.0, abs(m.objective))
+    # persists through save/load
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        m.save(td + "/m")
+        lm = LogisticRegressionModel.load(td + "/m")
+        assert lm.summary.objectiveHistory == h

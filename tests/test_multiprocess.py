@@ -125,6 +125,25 @@ WORKER = textwrap.dedent(
         emb[um.getOrDefault("outputCol")], np.float64
     ).tolist()
 
+    # streaming ingest in multi-process mode: each process reads ONLY its
+    # global row slice from parquet (streaming.py stage_parquet), and the
+    # beyond-HBM streamed-stats fit sums partial statistics across
+    # processes (linreg_streaming_stats + process_allgather)
+    ppath = os.path.join(os.path.dirname(outfile), f"stream_{pid}_{nproc}.parquet")
+    y_reg = (X @ beta).astype(np.float64)
+    pd.DataFrame(
+        {"features": list(X.astype(np.float32)), "label": y_reg}
+    ).to_parquet(ppath)
+    from spark_rapids_ml_tpu.streaming import stage_parquet
+    ds = stage_parquet(ppath, label_col="label", dtype=np.float32)
+    assert ds.n_valid == 1003, ds.n_valid
+    del ds  # free the staged copy before the streamed-stats fit below
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    set_config(force_streaming_stats=True)
+    lrs = LinearRegression().fit(ppath)
+    set_config(force_streaming_stats=False)
+    out["stream_coef"] = np.asarray(lrs.coef_, np.float64).tolist()
+
     if pid == 0:
         with open(outfile, "w") as f:
             json.dump(out, f)
@@ -201,4 +220,9 @@ def test_two_process_fit_matches_single_process(tmp_path):
     )
     np.testing.assert_allclose(
         multi["umap_emb"], single["umap_emb"], rtol=1e-3, atol=1e-3
+    )
+    # streamed-stats fit: per-process partial statistics summed across
+    # processes must reproduce the single-process solve
+    np.testing.assert_allclose(
+        multi["stream_coef"], single["stream_coef"], rtol=1e-4, atol=1e-5
     )
