@@ -446,10 +446,19 @@ class LogisticRegression(
             intercept = intercept - intercept.mean()
 
         # Spark's LogisticRegressionTrainingSummary.objectiveHistory:
-        # full objective per L-BFGS iteration, entry 0 = initial
-        hist = np.asarray(host["hist"], np.float64)
-        hist = hist[: int(n_iter) + 1]
-        hist = hist[np.isfinite(hist)]
+        # FULL (penalty-inclusive) objective per iteration, entry 0 =
+        # initial.  Entries 0..n_iter are all written; strip only a
+        # defensive trailing-NaN tail so objectiveHistory[j] always means
+        # iteration j (a mid-run non-finite objective is reported, not
+        # hidden).
+        hist = np.asarray(host["hist"], np.float64)[: int(n_iter) + 1]
+        while len(hist) and np.isnan(hist[-1]):
+            hist = hist[:-1]
+        if len(hist):
+            # `objective` matches the history definition (incl. the L1
+            # term under OWL-QN) so summary.objectiveHistory[-1] ==
+            # model.objective always holds
+            loss = hist[-1]
         return {
             "coef_": coef.astype(dtype),
             "intercept_": intercept.astype(dtype),

@@ -218,6 +218,15 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         )
 
 
+class KMeansSummary:
+    """pyspark KMeansSummary analog: the training-cost surface."""
+
+    def __init__(self, trainingCost: float, k: int, numIter: int) -> None:
+        self.trainingCost = float(trainingCost)
+        self.k = int(k)
+        self.numIter = int(numIter)
+
+
 class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
     """KMeans model (reference KMeansModel clustering.py:501-600)."""
 
@@ -236,7 +245,17 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
 
     @property
     def hasSummary(self) -> bool:
-        return False
+        return True
+
+    @property
+    def summary(self) -> "KMeansSummary":
+        """pyspark parity: KMeansModel.summary.trainingCost (the weighted
+        training inertia Spark's summary reports) + iteration count."""
+        return KMeansSummary(
+            trainingCost=self.inertia_,
+            k=int(self.cluster_centers_.shape[0]),
+            numIter=self.n_iter_,
+        )
 
     def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp

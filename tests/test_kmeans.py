@@ -120,3 +120,15 @@ def test_kmeans_init_steps_param(rng):
     # initSteps must reach the backend params
     est = KMeans(k=5, initSteps=4)
     assert est._tpu_params["init_steps"] == 4
+
+
+def test_kmeans_summary_training_cost(rng):
+    """pyspark parity: model.summary.trainingCost == inertia."""
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=300, n_features=4, centers=3, random_state=0)
+    m = KMeans(k=3, seed=1).fit(X.astype(np.float32))
+    assert m.hasSummary
+    s = m.summary
+    assert s.trainingCost == m.inertia_
+    assert s.k == 3 and s.numIter == m.n_iter_

@@ -248,3 +248,16 @@ def test_objective_history_summary(rng):
         m.save(td + "/m")
         lm = LogisticRegressionModel.load(td + "/m")
         assert lm.summary.objectiveHistory == h
+
+
+def test_objective_history_l1_consistency(rng):
+    """Under OWL-QN the reported objective and the history tail use the
+    SAME (penalty-inclusive) definition."""
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] > 0).astype(np.float64)
+    m = LogisticRegression(regParam=0.05, elasticNetParam=1.0, maxIter=60).fit(
+        (X, y)
+    )
+    h = m.summary.objectiveHistory
+    assert len(h) == m.summary.totalIterations + 1
+    assert abs(h[-1] - m.objective) < 1e-12
