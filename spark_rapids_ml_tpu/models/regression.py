@@ -212,11 +212,38 @@ class LinearRegression(
             tol=float(p["tol"]),
             max_iter=int(p["max_iter"]),
         )
+        # summary metrics via a cancellation-free residual pass over the
+        # still-staged data (the one-pass SSE expansion loses ~eps·Σwy²)
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.linear import _summary_from_sse, linreg_residual_sse
+
+        sse = float(
+            jax.device_get(
+                linreg_residual_sse(
+                    fit_input.X,
+                    fit_input.w,
+                    fit_input.y,
+                    jnp.asarray(coef, fit_input.X.dtype),
+                    fit_input.X.dtype.type(intercept),
+                )
+            )
+        )
+        diag.update(
+            _summary_from_sse(
+                sse, float(sw), float(sy), float(syy),
+                bool(p["fit_intercept"]),
+            )
+        )
         dtype = np.dtype(fit_input.dtype)
         return {
             "coef_": coef.astype(dtype),
             "intercept_": float(intercept),
             "n_iter_": int(diag["n_iter"]),
+            "rmse_": float(diag["rmse"]),
+            "mse_": float(diag["mse"]),
+            "r2_": float(diag["r2"]),
             "n_cols": fit_input.pdesc.n,
             "dtype": str(dtype.name),
         }
@@ -257,6 +284,9 @@ class LinearRegression(
             "coef_": coef.astype(dtype),
             "intercept_": float(intercept),
             "n_iter_": int(diag["n_iter"]),
+            "rmse_": float(diag["rmse"]),
+            "mse_": float(diag["mse"]),
+            "r2_": float(diag["r2"]),
             "n_cols": int(np.asarray(st["gram"]).shape[0]),
             "dtype": str(dtype.name),
         }
@@ -280,13 +310,42 @@ class LinearRegression(
                 fit_intercept=self.getOrDefault("fitIntercept"),
             )
         sk.fit(batch.X, batch.y, sample_weight=batch.weight)
+        # summary metrics so the fallback path matches the TPU surface
+        w = (
+            np.ones(batch.X.shape[0])
+            if batch.weight is None
+            else np.asarray(batch.weight, np.float64)
+        )
+        y = np.asarray(batch.y, np.float64)
+        resid = y - sk.predict(batch.X)
+        sse = float((w * resid * resid).sum())
+        from ..ops.linear import _summary_from_sse
+
+        stats = _summary_from_sse(
+            sse, float(w.sum()), float((w * y).sum()),
+            float((w * y * y).sum()), self.getOrDefault("fitIntercept"),
+        )
         return LinearRegressionModel(
             coef_=np.asarray(sk.coef_, batch.X.dtype),
             intercept_=float(sk.intercept_),
             n_iter_=int(np.max(getattr(sk, "n_iter_", 0)) or 0),
+            rmse_=stats["rmse"],
+            mse_=stats["mse"],
+            r2_=stats["r2"],
             n_cols=int(batch.X.shape[1]),
             dtype=str(batch.X.dtype),
         )
+
+
+class LinearRegressionTrainingSummary:
+    """Spark LinearRegressionTrainingSummary analog (exact-from-stats)."""
+
+    def __init__(self, rootMeanSquaredError: float, meanSquaredError: float,
+                 r2: float, totalIterations: int) -> None:
+        self.rootMeanSquaredError = float(rootMeanSquaredError)
+        self.meanSquaredError = float(meanSquaredError)
+        self.r2 = float(r2)
+        self.totalIterations = int(totalIterations)
 
 
 class LinearRegressionModel(
@@ -300,6 +359,9 @@ class LinearRegressionModel(
         self.coef_: np.ndarray = np.asarray(attrs["coef_"])
         self.intercept_: float = float(attrs["intercept_"])
         self.n_iter_: int = int(attrs.get("n_iter_", 0))
+        self.rmse_: float = float(attrs.get("rmse_", float("nan")))
+        self.mse_: float = float(attrs.get("mse_", float("nan")))
+        self.r2_: float = float(attrs.get("r2_", float("nan")))
         self.n_cols: int = int(attrs["n_cols"])
         self.dtype: str = str(attrs.get("dtype", "float32"))
 
@@ -314,7 +376,21 @@ class LinearRegressionModel(
 
     @property
     def hasSummary(self) -> bool:
-        return False
+        return np.isfinite(self.rmse_)
+
+    @property
+    def summary(self) -> "LinearRegressionTrainingSummary":
+        """Training summary (pyspark parity): weighted training rmse/mse/r2
+        computed EXACTLY from the fit's sufficient statistics — no second
+        data pass (Spark's summary re-reads the training data)."""
+        if not self.hasSummary:
+            raise RuntimeError("No training summary available on this model")
+        return LinearRegressionTrainingSummary(
+            rootMeanSquaredError=self.rmse_,
+            meanSquaredError=self.mse_,
+            r2=self.r2_,
+            totalIterations=self.n_iter_,
+        )
 
     def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp

@@ -122,8 +122,55 @@ def solve_linear_host(
 
     coef = coef_s / scale
     intercept = float(ymean - mean @ coef) if fit_intercept else 0.0
+    # training-summary statistics from the same sufficient stats (Spark's
+    # LinearRegressionTrainingSummary surface): weighted
+    # SSE = Σw(y - Xβ - b)² expanded in gram/cross/moment terms.
+    # NOTE: this expansion subtracts near-equal accumulated terms; with
+    # f32-accumulated inputs the absolute error is ~eps32·syy/sw, so
+    # callers holding the data should overwrite with `summary_stats`'s
+    # cancellation-free residual pass (models/regression.py does).
+    sse = (
+        syy
+        - 2.0 * (coef @ sxy + intercept * sy)
+        + coef @ gram @ coef
+        + 2.0 * intercept * (s1 @ coef)
+        + intercept * intercept * sw
+    )
+    sse = max(float(sse), 0.0)
     diag = {"n_iter": float(n_iter)}
+    diag.update(_summary_from_sse(sse, sw, sy, syy, fit_intercept))
     return coef, intercept, diag
+
+
+def _summary_from_sse(
+    sse: float, sw: float, sy: float, syy: float, fit_intercept: bool
+) -> Dict[str, float]:
+    """Weighted mse/rmse/r2 from residual and label moments.  Spark
+    semantics: SStot is through-origin (Σw·y²) when fitIntercept=False
+    (RegressionMetrics throughOrigin); r2 is NaN when SStot == 0 but the
+    model still mispredicts, 1.0 only for an exact fit."""
+    sst = float(syy - sy * sy / sw) if fit_intercept else float(syy)
+    sst = max(sst, 0.0)
+    if sst > 0.0:
+        r2 = 1.0 - sse / sst
+    else:
+        r2 = 1.0 if sse == 0.0 else float("nan")
+    return {
+        "mse": sse / sw,
+        "rmse": float(np.sqrt(sse / sw)),
+        "r2": r2,
+    }
+
+
+@jax.jit
+def linreg_residual_sse(X: jax.Array, w: jax.Array, y: jax.Array,
+                        coef: jax.Array, intercept):
+    """Cancellation-free weighted SSE: one extra matvec over the staged
+    data.  Residuals are computed directly, so precision tracks the
+    residual magnitude instead of eps·Σw·y² (the one-pass expansion's
+    floor)."""
+    r = y - (X @ coef + intercept)
+    return (w * r * r).sum()
 
 
 @jax.jit

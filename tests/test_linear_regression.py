@@ -136,3 +136,64 @@ def test_unsupported_values():
         LinearRegression(loss="huber")
     with pytest.raises(ValueError, match="not supported"):
         LinearRegression(solver="l-bfgs")
+
+
+def test_training_summary_matches_sklearn_metrics(rng):
+    """LinearRegressionTrainingSummary: rmse/r2 computed exactly from the
+    fit's sufficient statistics must match recomputed residual metrics."""
+    from sklearn.metrics import mean_squared_error, r2_score
+
+    X = rng.normal(size=(600, 5))
+    y = X @ np.array([1.0, -2.0, 0.5, 3.0, 0.0]) + 1.5 + 0.3 * rng.normal(size=600)
+    m = LinearRegression(regParam=0.0, float32_inputs=False).fit((X, y))
+    pred = np.asarray(m._transform_array(X)["prediction"], np.float64)
+    assert m.hasSummary
+    s = m.summary
+    np.testing.assert_allclose(s.meanSquaredError,
+                               mean_squared_error(y, pred), rtol=1e-6)
+    np.testing.assert_allclose(s.rootMeanSquaredError,
+                               np.sqrt(mean_squared_error(y, pred)), rtol=1e-6)
+    np.testing.assert_allclose(s.r2, r2_score(y, pred), rtol=1e-6)
+
+
+def test_training_summary_streaming_path(tmp_path, rng):
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X @ np.array([2.0, -1.0, 0.5, 1.0])).astype(np.float64)
+    path = str(tmp_path / "d.parquet")
+    pd.DataFrame({"features": list(X), "label": y}).to_parquet(path)
+    try:
+        set_config(force_streaming_stats=True)
+        m = LinearRegression().fit(path)
+    finally:
+        reset_config()
+    assert m.hasSummary and m.summary.r2 > 0.99
+
+
+def test_training_summary_precision_on_near_exact_fit(rng):
+    """The residual-pass SSE must not suffer one-pass cancellation: on a
+    noiseless f32 fit the reported rmse tracks the true tiny residual."""
+    X = rng.normal(size=(400, 4)).astype(np.float32) * 10.0
+    y = (X @ np.array([1.0, 2.0, -1.0, 0.5]) + 3.0).astype(np.float64)
+    m = LinearRegression(regParam=0.0).fit((X, y))
+    pred = np.asarray(m._transform_array(X)["prediction"], np.float64)
+    true_rmse = float(np.sqrt(((y - pred) ** 2).mean()))
+    # within 10x of the recomputed value (both ~f32-noise scale), never
+    # the ~1000x overstatement the one-pass expansion produced
+    assert m.summary.rootMeanSquaredError <= max(10 * true_rmse, 1e-4)
+
+
+def test_training_summary_no_intercept_through_origin(rng):
+    """Spark parity: fitIntercept=False uses through-origin SStot."""
+    X = rng.normal(size=(500, 3))
+    y = X @ np.array([2.0, -1.0, 0.5]) + 0.1 * rng.normal(size=500)
+    m = LinearRegression(
+        regParam=0.0, fitIntercept=False, float32_inputs=False
+    ).fit((X, y))
+    pred = np.asarray(m._transform_array(X)["prediction"], np.float64)
+    sse = float(((y - pred) ** 2).sum())
+    r2_origin = 1.0 - sse / float((y * y).sum())
+    np.testing.assert_allclose(m.summary.r2, r2_origin, rtol=1e-6)
