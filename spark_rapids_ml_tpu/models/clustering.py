@@ -437,11 +437,21 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
         st = RowStager.for_replicated(X.shape[0], mesh)
         Xs = st.stage(X, dtype)
         valid = st.mask(dtype)
+        kernel_kwargs: Dict[str, Any] = {}
+        mb = self._tpu_params.get("max_mbytes_per_batch")
+        if mb:
+            # cuML's max_mbytes_per_batch (reference clustering.py:603-632):
+            # bound the per-device adjacency working set; past it the kernel
+            # recomputes distance tiles per sweep
+            kernel_kwargs["adj_budget"] = max(
+                int(float(mb) * 1024 * 1024 / np.dtype(dtype).itemsize), 1
+            )
         labels, _core = dbscan_fit_predict(
             Xs, valid,
             jnp.asarray(eps, dtype),
             jnp.asarray(int(self._tpu_params["min_samples"]), jnp.int32),
             mesh=mesh,
+            **kernel_kwargs,
         )
         labels = st.fetch(labels)
         # renumber representatives to consecutive ids by first occurrence,
