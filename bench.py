@@ -169,6 +169,20 @@ def bench_kmeans(extra: dict):
     extra["kmeans_5Mx64_k20_fit_sec"] = round(el, 3)
     extra["kmeans_5Mx64_k20_rows_per_sec"] = round(n / el, 1)
 
+    # k=100 init comparison: k-means|| (2 rounds) vs sequential k-means++
+    # (100 D^2 passes) — the scalable-init evidence at high k
+    n2 = 1_000_000
+    X2 = _rng(7).standard_normal((n2, 32)).astype("float32")
+    ds2 = DeviceDataset.from_host(X2)
+    for mode, tag in (("k-means||", "scalable"), ("k-means++", "sequential")):
+        est = KMeans(k=100, seed=0, maxIter=5, initMode=mode)
+        est.fit(ds2)  # compile
+        t0 = time.perf_counter()
+        est.fit(ds2)
+        extra[f"kmeans_1Mx32_k100_{tag}_fit_sec"] = round(
+            time.perf_counter() - t0, 3
+        )
+
 
 def bench_rf(extra: dict):
     """RandomForestClassifier (BASELINE 100 trees/100M scaled: 16 trees,

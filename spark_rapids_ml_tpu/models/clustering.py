@@ -442,10 +442,11 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
         if mb:
             # cuML's max_mbytes_per_batch (reference clustering.py:603-632):
             # bound the per-device adjacency working set; past it the kernel
-            # recomputes distance tiles per sweep
-            kernel_kwargs["adj_budget"] = max(
-                int(float(mb) * 1024 * 1024 / np.dtype(dtype).itemsize), 1
-            )
+            # recomputes distance tiles per sweep.  The kernel budget counts
+            # 1-byte bool adjacency elements (see ops/dbscan.py
+            # _ADJ_BUDGET), so MB maps 1:1 to elements regardless of the
+            # feature dtype.
+            kernel_kwargs["adj_budget"] = max(int(float(mb) * 1024 * 1024), 1)
         labels, _core = dbscan_fit_predict(
             Xs, valid,
             jnp.asarray(eps, dtype),

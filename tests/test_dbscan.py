@@ -140,10 +140,6 @@ def test_max_mbytes_per_batch_forces_tiled_path(rng, monkeypatch):
         seen.update(kwargs)
         return orig(*args, **kwargs)
 
-    monkeypatch.setattr(
-        "spark_rapids_ml_tpu.models.clustering.dbscan_fit_predict", spy,
-        raising=False,
-    )
     # the model imports the kernel inside the method; patch at the source
     monkeypatch.setattr(dbscan_ops, "dbscan_fit_predict", spy)
     a = DBSCAN(eps=1.0, min_samples=4).fit(X)
