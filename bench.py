@@ -154,6 +154,10 @@ def bench_kmeans(extra: dict):
     from spark_rapids_ml_tpu import DeviceDataset
     from spark_rapids_ml_tpu.models.clustering import KMeans
 
+    extra["kmeans_intended_config"] = (
+        "BASELINE: k=20 on 100Mx64 over a cluster; run: 5Mx64 (rows/20, "
+        "one chip's HBM share)"
+    )
     n, d, k = 5_000_000, 64, 20
     X = _rng(2).standard_normal((n, d)).astype("float32")
     ds = DeviceDataset.from_host(X)
@@ -185,27 +189,38 @@ def bench_kmeans(extra: dict):
 
 
 def bench_rf(extra: dict):
-    """RandomForestClassifier (BASELINE 100 trees/100M scaled: 16 trees,
-    1M x 32; depth>6 currently exceeds the TPU compiler on the level-wise
-    builder — see ops/forest.py)."""
+    """RandomForestClassifier at cuML's default depth 16 (the active-node
+    frontier builder, ops/forest.py).  BASELINE intends 100 trees on
+    100M rows; rows scale to single-chip HBM."""
     import numpy as np
     import pandas as pd
 
     from spark_rapids_ml_tpu.models.classification import RandomForestClassifier
 
+    extra["rf_intended_config"] = (
+        "BASELINE: 100 trees, depth 16, 100Mx32; run: 1Mx32 (rows/100) at "
+        "depth 16 with 16 trees then 100 trees"
+    )
     n, d = 1_000_000, 32
     X, y = _gen_binary(n, d, seed=3)
     df = pd.DataFrame({"features": list(X), "label": y.astype(np.float64)})
 
-    def fit():
-        est = RandomForestClassifier(numTrees=16, maxDepth=6, seed=0)
+    def fit(trees: int):
+        est = RandomForestClassifier(numTrees=trees, maxDepth=16, seed=0)
         t0 = time.perf_counter()
         est.fit(df)
         return time.perf_counter() - t0
 
-    el = min(fit() for _ in range(2))
-    extra["rf_1Mx32_t16_fit_sec"] = round(el, 3)
-    extra["rf_1Mx32_t16_rows_per_sec"] = round(n / el, 1)
+    el = min(fit(16) for _ in range(2))
+    extra["rf_1Mx32_t16_d16_fit_sec"] = round(el, 3)
+    extra["rf_1Mx32_t16_d16_rows_per_sec"] = round(n / el, 1)
+    try:
+        # the BASELINE tree count (trees are vmapped per device; 100 on one
+        # chip is the worst case the reference spreads over its cluster)
+        el = fit(100)
+        extra["rf_1Mx32_t100_d16_fit_sec"] = round(el, 3)
+    except Exception as e:
+        extra["rf_t100_error"] = f"{type(e).__name__}: {e}"[:200]
 
 
 def bench_ann(extra: dict):
@@ -214,6 +229,10 @@ def bench_ann(extra: dict):
 
     from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
 
+    extra["ann_intended_config"] = (
+        "BASELINE: 10Mx128 items; run: 200kx64 (items/50, dims/2 — graph "
+        "build is O(n * iters * degree) and replicated per chip)"
+    )
     n, d, q, k = 200_000, 64, 10_000, 10
     X = _rng(4).standard_normal((n, d)).astype("float32")
     t0 = time.perf_counter()
@@ -242,6 +261,10 @@ def bench_umap(extra: dict):
     """UMAP (BASELINE 10M x 128 scaled to the one-worker fit: 100k x 32)."""
     from spark_rapids_ml_tpu.umap import UMAP
 
+    extra["umap_intended_config"] = (
+        "BASELINE: 10Mx128 (reference fits on ONE worker's sample too); "
+        "run: 100kx32 (rows/100, dims/4)"
+    )
     n, d = 100_000, 32
     X = _rng(5).standard_normal((n, d)).astype("float32")
     t0 = time.perf_counter()

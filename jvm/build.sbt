@@ -1,0 +1,22 @@
+// Spark 4.0 Connect server plugin that routes pyspark.ml estimators to the
+// spark_rapids_ml_tpu Python backend (the TPU analog of the reference's
+// jvm/ plugin, /root/reference/jvm/pom.xml).  Build: `sbt package`; load
+// with
+//   --conf spark.connect.ml.backend.classes=com.tpurapids.ml.Plugin
+//   --jars spark-rapids-ml-tpu-plugin_2.13-*.jar
+name := "spark-rapids-ml-tpu-plugin"
+
+version := "0.3.0"
+
+scalaVersion := "2.13.14"
+
+val sparkVersion = "4.0.0"
+
+libraryDependencies ++= Seq(
+  "org.apache.spark" %% "spark-sql" % sparkVersion % "provided",
+  "org.apache.spark" %% "spark-mllib" % sparkVersion % "provided",
+  "org.apache.spark" %% "spark-connect" % sparkVersion % "provided",
+  "org.scalatest" %% "scalatest" % "3.2.18" % Test
+)
+
+Test / fork := true

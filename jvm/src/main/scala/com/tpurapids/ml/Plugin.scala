@@ -1,0 +1,45 @@
+/*
+ * Spark Connect ML backend plugin: substitutes the built-in pyspark.ml
+ * algorithms with the spark_rapids_ml_tpu implementations (the analog of
+ * the reference plugin, /root/reference/jvm/.../Plugin.scala:26-57, with
+ * the py4j PythonPlannerRunner transport replaced by the line-JSON worker
+ * protocol of spark_rapids_ml_tpu/connect_plugin.py).
+ */
+package com.tpurapids.ml
+
+import java.util.Optional
+
+import org.apache.spark.sql.connect.plugin.MLBackendPlugin
+
+class Plugin extends MLBackendPlugin {
+
+  override def transform(mlName: String): Optional[String] = {
+    mlName match {
+      case "org.apache.spark.ml.classification.LogisticRegression" =>
+        Optional.of("com.tpurapids.ml.TpuLogisticRegression")
+      case "org.apache.spark.ml.classification.LogisticRegressionModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuLogisticRegressionModel")
+      case "org.apache.spark.ml.classification.RandomForestClassifier" =>
+        Optional.of("com.tpurapids.ml.TpuRandomForestClassifier")
+      case "org.apache.spark.ml.classification.RandomForestClassificationModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuRandomForestClassificationModel")
+      case "org.apache.spark.ml.regression.RandomForestRegressor" =>
+        Optional.of("com.tpurapids.ml.TpuRandomForestRegressor")
+      case "org.apache.spark.ml.regression.RandomForestRegressionModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuRandomForestRegressionModel")
+      case "org.apache.spark.ml.regression.LinearRegression" =>
+        Optional.of("com.tpurapids.ml.TpuLinearRegression")
+      case "org.apache.spark.ml.regression.LinearRegressionModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuLinearRegressionModel")
+      case "org.apache.spark.ml.clustering.KMeans" =>
+        Optional.of("com.tpurapids.ml.TpuKMeans")
+      case "org.apache.spark.ml.clustering.KMeansModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuKMeansModel")
+      case "org.apache.spark.ml.feature.PCA" =>
+        Optional.of("com.tpurapids.ml.TpuPCA")
+      case "org.apache.spark.ml.feature.PCAModel" =>
+        Optional.of("org.apache.spark.ml.tpu.TpuPCAModel")
+      case _ => Optional.empty()
+    }
+  }
+}

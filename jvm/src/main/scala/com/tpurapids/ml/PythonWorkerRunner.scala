@@ -1,0 +1,167 @@
+/*
+ * Line-JSON bridge to the spark_rapids_ml_tpu Python worker — the
+ * transport analog of the reference's PythonEstimatorRunner /
+ * PythonModelRunner (py4j PythonPlannerRunner,
+ * /root/reference/jvm/.../PythonEstimatorRunner.scala:40-67).  Instead of
+ * py4j object registries, datasets travel as parquet paths on a shared
+ * filesystem and requests/responses are one JSON object per line on the
+ * worker's stdin/stdout — exactly the protocol served by
+ * `python -m spark_rapids_ml_tpu.connect_plugin` (connect_plugin.py and
+ * tests/test_connect_plugin.py are the executable specification).
+ *
+ * Protocol (all requests carry `op` + `operator`):
+ *   fit:       {"op": "fit", "operator": ..., "params": {...},
+ *               "data": <parquet path>, "model_path": <dir>,
+ *               "inline_arrays": true}
+ *           -> {"status": "ok", "operator": ..., "attributes": {...},
+ *               "model_path": ...}
+ *   transform: {"op": "transform", "operator": ..., "params": {...},
+ *               "data": <parquet path>, "model_path": <dir>,
+ *               "output_path": <parquet path>}
+ *           -> {"status": "ok", "output_path": ..., "num_rows": N}
+ */
+package com.tpurapids.ml
+
+import java.io.{BufferedReader, BufferedWriter, InputStreamReader, OutputStreamWriter}
+import java.nio.charset.StandardCharsets
+import java.nio.file.{Files, Paths}
+import java.util.UUID
+
+import org.json4s._
+import org.json4s.jackson.JsonMethods
+
+object PythonWorkerRunner {
+
+  private var process: Process = _
+  private var stdin: BufferedWriter = _
+  private var stdout: BufferedReader = _
+
+  private def pythonExe: String =
+    sys.env.getOrElse("SRMT_PYTHON_EXE", "python3")
+
+  /** Shared-filesystem scratch dir for the parquet exchange. */
+  def exchangeDir: String =
+    sys.env.getOrElse(
+      "SRMT_EXCHANGE_DIR",
+      System.getProperty("java.io.tmpdir"))
+
+  def newExchangePath(suffix: String): String =
+    Paths.get(exchangeDir, s"srmt-jvm-${UUID.randomUUID().toString}$suffix")
+      .toString
+
+  private def ensureWorker(): Unit = synchronized {
+    if (process == null || !process.isAlive) {
+      val pb = new ProcessBuilder(
+        pythonExe, "-m", "spark_rapids_ml_tpu.connect_plugin")
+      pb.redirectErrorStream(false)
+      process = pb.start()
+      stdin = new BufferedWriter(new OutputStreamWriter(
+        process.getOutputStream, StandardCharsets.UTF_8))
+      stdout = new BufferedReader(new InputStreamReader(
+        process.getInputStream, StandardCharsets.UTF_8))
+      sys.addShutdownHook { if (process != null) process.destroy() }
+    }
+  }
+
+  /** One request/response round-trip (the worker is long-lived and
+   *  serves requests serially; concurrent callers serialize here). */
+  def request(req: JObject): JValue = synchronized {
+    ensureWorker()
+    stdin.write(JsonMethods.compact(JsonMethods.render(req)))
+    stdin.write("\n")
+    stdin.flush()
+    val line = stdout.readLine()
+    if (line == null) {
+      throw new RuntimeException(
+        "spark_rapids_ml_tpu worker exited; stderr: " + drainStderr())
+    }
+    val resp = JsonMethods.parse(line)
+    (resp \ "status") match {
+      case JString("ok") => resp
+      case _ =>
+        val err = (resp \ "error") match {
+          case JString(e) => e
+          case _ => line
+        }
+        throw new RuntimeException(s"spark_rapids_ml_tpu worker error: $err")
+    }
+  }
+
+  private def drainStderr(): String = {
+    val err = new BufferedReader(new InputStreamReader(
+      process.getErrorStream, StandardCharsets.UTF_8))
+    val sb = new StringBuilder
+    var line = err.readLine()
+    var n = 0
+    while (line != null && n < 50) { sb.append(line).append('\n'); n += 1; line = err.readLine() }
+    sb.toString
+  }
+
+  def fit(
+      operator: String,
+      params: Map[String, Any],
+      dataPath: String,
+      modelPath: String): JValue = {
+    request(JObject(List(
+      "op" -> JString("fit"),
+      "operator" -> JString(operator),
+      "params" -> toJson(params),
+      "data" -> JString(dataPath),
+      "model_path" -> JString(modelPath),
+      "inline_arrays" -> JBool(true))))
+  }
+
+  def transform(
+      operator: String,
+      modelPath: String,
+      dataPath: String,
+      outputPath: String,
+      params: Map[String, Any] = Map.empty): JValue = {
+    request(JObject(List(
+      "op" -> JString("transform"),
+      "operator" -> JString(operator),
+      "params" -> toJson(params),
+      "data" -> JString(dataPath),
+      "model_path" -> JString(modelPath),
+      "output_path" -> JString(outputPath))))
+  }
+
+  private def toJson(m: Map[String, Any]): JObject =
+    JObject(m.toList.map { case (k, v) => k -> anyToJson(v) })
+
+  private def anyToJson(v: Any): JValue = v match {
+    case null => JNull
+    case b: Boolean => JBool(b)
+    case i: Int => JInt(BigInt(i))
+    case l: Long => JInt(BigInt(l))
+    case d: Double => JDouble(d)
+    case f: Float => JDouble(f.toDouble)
+    case s: String => JString(s)
+    case seq: Seq[_] => JArray(seq.toList.map(anyToJson))
+    case arr: Array[_] => JArray(arr.toList.map(anyToJson))
+    case other => JString(other.toString)
+  }
+
+  def cleanup(path: String): Unit = {
+    def rm(p: java.io.File): Unit = {
+      if (p.isDirectory) p.listFiles().foreach(rm)
+      p.delete(); ()
+    }
+    val f = Paths.get(path).toFile
+    if (f.exists()) rm(f)
+    val _ = Files.notExists(Paths.get(path))
+  }
+
+  private val deferred = new scala.collection.mutable.ArrayBuffer[String]()
+  private lazy val deferredHook: Unit = {
+    sys.addShutdownHook { deferred.synchronized { deferred.foreach(cleanup) } }
+    ()
+  }
+
+  /** Paths that stay referenced by lazy DataFrames (transform outputs)
+   *  are deleted at JVM exit instead of immediately. */
+  def cleanupOnExit(path: String): Unit = {
+    deferredHook
+    deferred.synchronized { deferred += path; () }
+  }
+}

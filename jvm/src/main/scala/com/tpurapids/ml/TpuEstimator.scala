@@ -1,0 +1,59 @@
+/*
+ * Shared estimator-side bridge (the analog of the reference's
+ * RapidsEstimator trait, /root/reference/jvm/.../RapidsTraits.scala):
+ * write the input Dataset as parquet to the shared exchange dir, round-trip
+ * a `fit` request through the Python worker, and hand the returned inline
+ * attributes to the concrete wrapper's model builder.
+ */
+package com.tpurapids.ml
+
+import org.apache.spark.ml.functions.vector_to_array
+import org.apache.spark.ml.param.Params
+import org.apache.spark.sql.{Dataset, functions => F}
+import org.json4s.JValue
+
+trait TpuEstimator extends Params {
+
+  /** Operator name in the Python worker registry
+   *  (spark_rapids_ml_tpu/connect_plugin.py `_registry`). */
+  def operatorName: String
+
+  /** Explicitly-set Spark params by name — the Python estimators accept
+   *  Spark param names as constructor kwargs (params.py value maps). */
+  protected def collectParams: Map[String, Any] = {
+    params.flatMap { p =>
+      if (isSet(p)) Some(p.name -> ($(p) match {
+        case v: java.lang.Number => v
+        case v: Boolean => v
+        case v: String => v
+        case v => v.toString
+      })) else None
+    }.toMap
+  }
+
+  /** Columns the Python side reads; VectorUDT features become arrays
+   *  (the reference's `vector_to_array` preprocessing, core.py:493-537). */
+  protected def writeDataset(dataset: Dataset[_]): String = {
+    val path = PythonWorkerRunner.newExchangePath(".parquet")
+    var df = dataset.toDF()
+    for (f <- df.schema.fields
+         if f.dataType.getClass.getSimpleName == "VectorUDT") {
+      df = df.withColumn(f.name, vector_to_array(F.col(f.name)))
+    }
+    df.write.parquet(path)
+    path
+  }
+
+  /** Fit on the Python worker; returns (attributes JSON, model dir). */
+  protected def trainOnPython(dataset: Dataset[_]): (JValue, String) = {
+    val dataPath = writeDataset(dataset)
+    val modelPath = PythonWorkerRunner.newExchangePath(".model")
+    try {
+      val resp = PythonWorkerRunner.fit(
+        operatorName, collectParams, dataPath, modelPath)
+      (resp \ "attributes", modelPath)
+    } finally {
+      PythonWorkerRunner.cleanup(dataPath)
+    }
+  }
+}

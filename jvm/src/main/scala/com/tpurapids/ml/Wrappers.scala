@@ -1,0 +1,129 @@
+/*
+ * Estimator wrappers the Plugin substitutes for the Spark built-ins (the
+ * analog of the reference's Rapids* wrappers, /root/reference/jvm/.../
+ * RapidsLogisticRegression.scala etc.): each extends the REAL Spark
+ * estimator — so the Connect server applies the user's params to it
+ * unchanged — and overrides the train step with a Python-worker fit.
+ */
+package com.tpurapids.ml
+
+import org.apache.spark.ml.classification.{LogisticRegression, RandomForestClassifier}
+import org.apache.spark.ml.clustering.KMeans
+import org.apache.spark.ml.feature.PCA
+import org.apache.spark.ml.regression.{LinearRegression, RandomForestRegressor}
+import org.apache.spark.ml.tpu._
+import org.apache.spark.ml.util.Identifiable
+import org.apache.spark.sql.Dataset
+import org.apache.spark.sql.types.StructType
+
+class TpuLogisticRegression(override val uid: String)
+    extends LogisticRegression with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_logreg"))
+
+  override def operatorName: String = "LogisticRegression"
+
+  override def train(dataset: Dataset[_]): TpuLogisticRegressionModel = {
+    val (attrs, modelPath) = trainOnPython(dataset)
+    val m = ModelBuilder.logisticRegression(uid, attrs)
+    val out = new TpuLogisticRegressionModel(
+      uid, m.coefficientMatrix, m.interceptVector, m.numClasses,
+      m.coefficientMatrix.numRows > 1,
+      new TpuPythonBackedModel("LogisticRegressionModel", modelPath))
+    copyValues(out)
+  }
+
+  // feature columns may arrive as array<double> (vector_to_array)
+  override def transformSchema(schema: StructType): StructType = schema
+}
+
+class TpuLinearRegression(override val uid: String)
+    extends LinearRegression with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_linreg"))
+
+  override def operatorName: String = "LinearRegression"
+
+  override def train(dataset: Dataset[_]): TpuLinearRegressionModel = {
+    val (attrs, modelPath) = trainOnPython(dataset)
+    val m = ModelBuilder.linearRegression(uid, attrs)
+    copyValues(new TpuLinearRegressionModel(
+      uid, m.coefficients, m.intercept,
+      new TpuPythonBackedModel("LinearRegressionModel", modelPath)))
+  }
+
+  override def transformSchema(schema: StructType): StructType = schema
+}
+
+class TpuKMeans(override val uid: String) extends KMeans with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_kmeans"))
+
+  override def operatorName: String = "KMeans"
+
+  override def fit(dataset: Dataset[_]): TpuKMeansModel = {
+    val (attrs, modelPath) = trainOnPython(dataset)
+    val m = ModelBuilder.kmeans(uid, attrs)
+    copyValues(new TpuKMeansModel(
+      uid, m.parentModel,
+      new TpuPythonBackedModel("KMeansModel", modelPath)))
+  }
+
+  override def transformSchema(schema: StructType): StructType = schema
+}
+
+class TpuPCA(override val uid: String) extends PCA with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_pca"))
+
+  override def operatorName: String = "PCA"
+
+  override def fit(dataset: Dataset[_]): TpuPCAModel = {
+    val (attrs, modelPath) = trainOnPython(dataset)
+    val m = ModelBuilder.pca(uid, attrs)
+    copyValues(new TpuPCAModel(
+      uid, m.pc, m.explainedVariance,
+      new TpuPythonBackedModel("PCAModel", modelPath)))
+  }
+
+  override def transformSchema(schema: StructType): StructType = schema
+}
+
+class TpuRandomForestClassifier(override val uid: String)
+    extends RandomForestClassifier with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_rfc"))
+
+  override def operatorName: String = "RandomForestClassifier"
+
+  /** The forest stays Python-resident (node-table format); the returned
+   *  wrapper transforms by worker round-trip. */
+  def trainPythonModel(dataset: Dataset[_]): TpuRandomForestClassificationModel = {
+    val (attrs, modelPath) = trainOnPython(dataset)
+    val numClasses = (attrs \ "num_classes") match {
+      case org.json4s.JInt(i) => i.toInt
+      case _ => 2
+    }
+    new TpuRandomForestClassificationModel(
+      uid, numClasses,
+      new TpuPythonBackedModel("RandomForestClassificationModel", modelPath))
+  }
+
+  override def transformSchema(schema: StructType): StructType = schema
+}
+
+class TpuRandomForestRegressor(override val uid: String)
+    extends RandomForestRegressor with TpuEstimator {
+
+  def this() = this(Identifiable.randomUID("tpu_rfr"))
+
+  override def operatorName: String = "RandomForestRegressor"
+
+  def trainPythonModel(dataset: Dataset[_]): TpuRandomForestRegressionModel = {
+    val (_, modelPath) = trainOnPython(dataset)
+    new TpuRandomForestRegressionModel(
+      uid, new TpuPythonBackedModel("RandomForestRegressionModel", modelPath))
+  }
+
+  override def transformSchema(schema: StructType): StructType = schema
+}

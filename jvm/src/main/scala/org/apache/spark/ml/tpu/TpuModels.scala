@@ -1,0 +1,188 @@
+/*
+ * Model wrappers living in the org.apache.spark.ml namespace for access to
+ * the package-private Spark model constructors (the same technique the
+ * reference uses, /root/reference/jvm/src/main/scala/org/apache/spark/ml/
+ * rapids/RapidsModel.scala) — the MODEL MATH though comes back from the
+ * spark_rapids_ml_tpu Python fit as inline JSON attributes.
+ */
+package org.apache.spark.ml.tpu
+
+import com.tpurapids.ml.PythonWorkerRunner
+
+import org.apache.spark.ml.classification.LogisticRegressionModel
+import org.apache.spark.ml.clustering.KMeansModel
+import org.apache.spark.ml.feature.PCAModel
+import org.apache.spark.ml.linalg.{DenseMatrix, DenseVector, Matrices, Vectors}
+import org.apache.spark.ml.regression.LinearRegressionModel
+import org.apache.spark.ml.util.Identifiable
+import org.apache.spark.mllib.clustering.{KMeansModel => MLlibKMeansModel}
+import org.apache.spark.mllib.linalg.{Vectors => MLlibVectors}
+import org.apache.spark.sql.{DataFrame, Dataset}
+import org.json4s._
+
+/** Builders from the worker's inline `attributes` JSON to REAL Spark
+ *  models — the analog of the reference's ModelHelper
+ *  (/root/reference/jvm/.../ModelHelper.scala). */
+object ModelBuilder {
+
+  private def arr1(v: JValue): Array[Double] = v match {
+    case JArray(xs) => xs.map(doubleOf).toArray
+    case other => throw new IllegalArgumentException(s"expected array, got $other")
+  }
+
+  private def arr2(v: JValue): Array[Array[Double]] = v match {
+    case JArray(rows) => rows.map {
+      case JArray(xs) => xs.map(doubleOf).toArray
+      case other => throw new IllegalArgumentException(s"expected row, got $other")
+    }.toArray
+    case other => throw new IllegalArgumentException(s"expected matrix, got $other")
+  }
+
+  private def doubleOf(v: JValue): Double = v match {
+    case JDouble(d) => d
+    case JInt(i) => i.toDouble
+    case JDecimal(d) => d.toDouble
+    // the worker stringifies non-finite values (strict-JSON wire format)
+    case JString("Infinity") => Double.PositiveInfinity
+    case JString("-Infinity") => Double.NegativeInfinity
+    case JString("NaN") => Double.NaN
+    case other => throw new IllegalArgumentException(s"expected number, got $other")
+  }
+
+  def logisticRegression(uid: String, attrs: JValue): LogisticRegressionModel = {
+    val coef = arr2(attrs \ "coef_")
+    val intercept = arr1(attrs \ "intercept_")
+    val numClasses = (attrs \ "classes_") match {
+      case JArray(cs) => cs.size
+      case _ => coef.length max 2
+    }
+    val isMultinomial = coef.length > 1
+    val rows = coef.length
+    val cols = if (rows > 0) coef(0).length else 0
+    val mat = Matrices.dense(rows, cols, {
+      // column-major storage
+      val flat = new Array[Double](rows * cols)
+      for (r <- 0 until rows; c <- 0 until cols) flat(c * rows + r) = coef(r)(c)
+      flat
+    })
+    new LogisticRegressionModel(
+      uid, mat, Vectors.dense(intercept), numClasses, isMultinomial)
+  }
+
+  def linearRegression(uid: String, attrs: JValue): LinearRegressionModel = {
+    val coef = arr1(attrs \ "coef_")
+    val intercept = doubleOf(attrs \ "intercept_")
+    new LinearRegressionModel(uid, Vectors.dense(coef), intercept)
+  }
+
+  def kmeans(uid: String, attrs: JValue): KMeansModel = {
+    val centers = arr2(attrs \ "cluster_centers_")
+      .map(c => MLlibVectors.dense(c))
+    new KMeansModel(uid, new MLlibKMeansModel(centers))
+  }
+
+  def pca(uid: String, attrs: JValue): PCAModel = {
+    val comp = arr2(attrs \ "components_") // (k, d), row = component
+    val evr = arr1(attrs \ "explained_variance_ratio_")
+    val k = comp.length
+    val d = if (k > 0) comp(0).length else 0
+    // Spark stores principal components as a (d, k) column matrix
+    val flat = new Array[Double](d * k)
+    for (r <- 0 until k; c <- 0 until d) flat(r * d + c) = comp(r)(c)
+    new PCAModel(
+      uid, new DenseMatrix(d, k, flat), new DenseVector(evr))
+  }
+}
+
+/** Random-forest models stay Python-resident (the node-table forest
+ *  format, spark_rapids_ml_tpu/models/tree.py): transform round-trips
+ *  parquet through the worker instead of rebuilding JVM trees.  The
+ *  reference instead translates treelite JSON into Spark trees
+ *  (reference utils.py:585-809); the delegating design keeps one source
+ *  of truth for the forest math. */
+class TpuPythonBackedModel(
+    override val uid: String,
+    val operatorName: String,
+    val modelPath: String) extends Serializable {
+
+  def this(operatorName: String, modelPath: String) =
+    this(Identifiable.randomUID("tpu"), operatorName, modelPath)
+
+  def transformViaPython(dataset: Dataset[_]): DataFrame = {
+    import org.apache.spark.ml.functions.vector_to_array
+    import org.apache.spark.sql.{functions => F}
+
+    val spark = dataset.sparkSession
+    val dataPath = PythonWorkerRunner.newExchangePath(".parquet")
+    val outPath = PythonWorkerRunner.newExchangePath(".out.parquet")
+    // same VectorUDT unwrapping the fit path applies (TpuEstimator
+    // .writeDataset) — the worker reads plain array columns
+    var df = dataset.toDF()
+    for (f <- df.schema.fields
+         if f.dataType.getClass.getSimpleName == "VectorUDT") {
+      df = df.withColumn(f.name, vector_to_array(F.col(f.name)))
+    }
+    df.write.parquet(dataPath)
+    try {
+      PythonWorkerRunner.transform(operatorName, modelPath, dataPath, outPath)
+      // reading is lazy, so outPath cannot be removed here; it is
+      // registered for deletion when the JVM exits
+      PythonWorkerRunner.cleanupOnExit(outPath)
+      spark.read.parquet(outPath)
+    } finally {
+      PythonWorkerRunner.cleanup(dataPath)
+    }
+  }
+}
+
+/** Connect-facing model classes (the names Plugin maps the Spark model
+ *  classes to).  Each IS the corresponding Spark model — fitted
+ *  coefficients live JVM-side, so the whole pyspark.ml model surface
+ *  (save/load, summaries, transform on the Connect server) keeps working —
+ *  plus the Python model directory for TPU-accelerated batch transform. */
+class TpuLogisticRegressionModel(
+    uid: String,
+    coefficientMatrix: org.apache.spark.ml.linalg.Matrix,
+    interceptVector: org.apache.spark.ml.linalg.Vector,
+    numClasses: Int,
+    isMultinomial: Boolean,
+    val pythonModel: TpuPythonBackedModel)
+  extends LogisticRegressionModel(
+    uid, coefficientMatrix, interceptVector, numClasses, isMultinomial)
+
+class TpuLinearRegressionModel(
+    uid: String,
+    coefficients: org.apache.spark.ml.linalg.Vector,
+    intercept: Double,
+    val pythonModel: TpuPythonBackedModel)
+  extends LinearRegressionModel(uid, coefficients, intercept)
+
+class TpuKMeansModel(
+    uid: String,
+    parent: MLlibKMeansModel,
+    val pythonModel: TpuPythonBackedModel)
+  extends KMeansModel(uid, parent)
+
+class TpuPCAModel(
+    uid: String,
+    pc: DenseMatrix,
+    explainedVariance: DenseVector,
+    val pythonModel: TpuPythonBackedModel)
+  extends PCAModel(uid, pc, explainedVariance)
+
+/** The forests stay Python-resident (see TpuPythonBackedModel): transform
+ *  delegates to the worker, predictions come back as a parquet column. */
+class TpuRandomForestClassificationModel(
+    val uid: String,
+    val numClassesValue: Int,
+    val pythonModel: TpuPythonBackedModel) extends Serializable {
+  def transform(dataset: Dataset[_]): DataFrame =
+    pythonModel.transformViaPython(dataset)
+}
+
+class TpuRandomForestRegressionModel(
+    val uid: String,
+    val pythonModel: TpuPythonBackedModel) extends Serializable {
+  def transform(dataset: Dataset[_]): DataFrame =
+    pythonModel.transformViaPython(dataset)
+}

@@ -61,6 +61,17 @@ _DEFAULTS: Dict[str, Any] = {
     "coordinator_address": None,
     "process_id": None,
     "num_processes": None,
+    # Spark-DataFrame exchange: datasets estimated above this many bytes
+    # are written by the EXECUTORS to `spark_exchange_dir` as parquet and
+    # fit through the streaming-ingest path instead of `toPandas()`
+    # through the controller (the reference never materializes the dataset
+    # on the driver either — workers pull partitions, core.py:742-1013).
+    "spark_collect_max_bytes": 2 * 1024 * 1024 * 1024,
+    # Shared-filesystem directory for the parquet exchange (must be
+    # readable from the controller and writable from the executors, e.g.
+    # NFS/GCS-fuse).  Empty -> always collect, with a warning past the
+    # size limit.
+    "spark_exchange_dir": "",
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

@@ -69,10 +69,17 @@ def _scalar_attributes(model) -> Dict[str, Any]:
     carries them — a path does the same job here)."""
     import numpy as np
 
+    import math
+
     out: Dict[str, Any] = {}
     for k, v in model._get_model_attributes().items():
         if isinstance(v, (np.integer, np.floating, np.bool_)):
             v = v.item()
+        if isinstance(v, float) and not math.isfinite(v):
+            # strings keep strict-JSON parsers (the JVM side) working
+            v = "NaN" if math.isnan(v) else (
+                "Infinity" if v > 0 else "-Infinity"
+            )
         if isinstance(v, (str, int, float, bool)) or v is None:
             out[k] = v
         elif isinstance(v, list) and all(
@@ -95,6 +102,12 @@ def handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
     data = req.get("data")
 
     base = operator[:-5] if operator.endswith("Model") else operator
+    # model class names do not all strip to their estimator's name
+    # (RandomForestClassificationModel -> RandomForestClassifier)
+    base = {
+        "RandomForestClassification": "RandomForestClassifier",
+        "RandomForestRegression": "RandomForestRegressor",
+    }.get(base, base)
     if base not in registry:
         return {
             "status": "error",
@@ -109,10 +122,34 @@ def handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
         model_path = req.get("model_path")
         if model_path:
             model.save(model_path)
+        attributes = _scalar_attributes(model)
+        if req.get("inline_arrays"):
+            # a JVM caller building a real Spark model (jvm/ ModelBuilder)
+            # needs the array payload inline, not just the npz path.
+            # Non-finite values travel as strings: Jackson on the JVM side
+            # rejects bare Infinity/NaN tokens (ModelBuilder.doubleOf
+            # parses the strings back).
+            import math
+
+            import numpy as np
+
+            def _clean(x):
+                if isinstance(x, list):
+                    return [_clean(v) for v in x]
+                if isinstance(x, float) and not math.isfinite(x):
+                    return (
+                        "NaN" if math.isnan(x)
+                        else ("Infinity" if x > 0 else "-Infinity")
+                    )
+                return x
+
+            for k, v in model._get_model_attributes().items():
+                if isinstance(v, np.ndarray):
+                    attributes[k] = _clean(v.tolist())
         return {
             "status": "ok",
             "operator": base + "Model",
-            "attributes": _scalar_attributes(model),
+            "attributes": attributes,
             "model_path": model_path,
         }
 

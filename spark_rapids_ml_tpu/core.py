@@ -542,28 +542,44 @@ class _TpuEstimator(Estimator, _TpuCaller):
         t0 = time.time()
         from .tracing import device_profile, trace
 
-        attrs = None
-        with device_profile():
-            if isinstance(dataset, DeviceDataset):
-                with trace("stage_from_device", self.logger):
-                    fit_input = self._stage_from_device(dataset)
-                with trace("fit_kernel", self.logger):
-                    attrs = self._fit_array(fit_input)
-            else:
-                from .config import get_config
-                from .streaming import is_parquet_path
+        # large Spark DataFrames route around the controller: executors
+        # write parquet to the exchange dir and the streaming-ingest path
+        # below takes over (spark_interop.spark_dataframe_to_staging)
+        from .spark_interop import is_spark_dataframe
 
-                if is_parquet_path(dataset) and get_config("streaming_ingest"):
-                    with trace("stream_ingest_fit", self.logger):
-                        attrs = self._stage_or_stream(dataset)
-                if attrs is None:
-                    with trace("extract", self.logger):
-                        batch = self._extract(dataset)
-                        self._validate_input(batch)
-                    with trace("stage", self.logger):
-                        fit_input = self._stage_fit_input(batch)
+        exchange_cleanup = None
+        if is_spark_dataframe(dataset):
+            from .spark_interop import spark_dataframe_to_staging
+
+            dataset, exchange_cleanup = spark_dataframe_to_staging(dataset)
+        attrs = None
+        try:
+            with device_profile():
+                if isinstance(dataset, DeviceDataset):
+                    with trace("stage_from_device", self.logger):
+                        fit_input = self._stage_from_device(dataset)
                     with trace("fit_kernel", self.logger):
                         attrs = self._fit_array(fit_input)
+                else:
+                    from .config import get_config
+                    from .streaming import is_parquet_path
+
+                    if is_parquet_path(dataset) and get_config("streaming_ingest"):
+                        with trace("stream_ingest_fit", self.logger):
+                            attrs = self._stage_or_stream(dataset)
+                    if attrs is None:
+                        with trace("extract", self.logger):
+                            batch = self._extract(dataset)
+                            self._validate_input(batch)
+                        with trace("stage", self.logger):
+                            fit_input = self._stage_fit_input(batch)
+                        with trace("fit_kernel", self.logger):
+                            attrs = self._fit_array(fit_input)
+        finally:
+            if exchange_cleanup:
+                import shutil
+
+                shutil.rmtree(exchange_cleanup, ignore_errors=True)
         model = self._create_model(attrs)
         self._copyValues(model)
         model._num_workers = self._num_workers
@@ -728,12 +744,19 @@ class _TpuModel(Model, _TpuCaller):
                     Xc = np.ascontiguousarray(X[lo : lo + chunk])
                     st = RowStager.for_replicated(Xc.shape[0], mesh)
                     dev = self._transform_device(st.stage(Xc, X.dtype))
-                    for col, v in dev.items():
-                        outs.setdefault(col, []).append(
+                    # fetch the whole chunk before publishing: a failure on a
+                    # later column must not leave earlier columns appended
+                    # (the retry would duplicate their rows)
+                    fetched = {
+                        col: (
                             st.fetch(v)
                             if isinstance(v, jax.Array)
                             else st.trim_host(np.asarray(v))
                         )
+                        for col, v in dev.items()
+                    }
+                    for col, v in fetched.items():
+                        outs.setdefault(col, []).append(v)
                 lo += chunk
             except Exception as e:
                 # OOM backoff: halve the chunk and RESUME at the failing row

@@ -170,6 +170,43 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         super().__init__()
         self._set_params(**kwargs)
 
+    def _supports_streaming_stats(self) -> bool:
+        # beyond-HBM epoch-streaming Lloyd (streaming.py
+        # `kmeans_streaming_fit`): no sufficient statistics exist, so every
+        # iteration re-streams the parquet chunks
+        return True
+
+    def _fit_streaming(self, path: str) -> Dict[str, Any]:
+        """Beyond-HBM fit: centers seeded from a strided subsample, each
+        Lloyd iteration a streamed assign+accumulate pass — dataset size
+        bounded by disk, not HBM x chips (the TPU analog of the
+        reference's cluster-memory-scaled ingest, utils.py:403-522)."""
+        from ..streaming import kmeans_streaming_fit
+
+        fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
+        p = self._tpu_params
+        seed = p.get("random_state")
+        seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
+        res = kmeans_streaming_fit(
+            path, fcol, fcols, weight_col,
+            k=int(p["n_clusters"]),
+            seed=seed,
+            max_iter=int(p["max_iter"]),
+            tol=float(p["tol"]),
+            init=str(p["init"]),
+            init_steps=int(p.get("init_steps") or 2),
+            oversample=float(p.get("oversampling_factor") or 2.0),
+            dtype=dtype,
+        )
+        dtype = np.dtype(dtype)
+        return {
+            "cluster_centers_": np.asarray(res["centers"]).astype(dtype),
+            "inertia_": float(res["cost"]),
+            "n_iter_": int(res["n_iter"]),
+            "n_cols": int(res["d"]),
+            "dtype": str(dtype.name),
+        }
+
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
         from ..ops.kmeans import kmeans_fit
 

@@ -543,6 +543,13 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         from ..parallel import TpuContext
         from ..parallel.mesh import RowStager
 
+        n_items = int(self.item_features.shape[0])
+        if k > n_items:
+            # search_cagra's top_k(beam) and the IVF shortlists all require
+            # k <= n; fail with a clear message instead of an XLA error
+            raise ValueError(
+                f"k={k} exceeds the number of indexed items ({n_items})"
+            )
         with TpuContext(self.num_workers) as ctx:
             mesh = ctx.mesh
         Q = np.ascontiguousarray(Q, dtype=np.float32)

@@ -471,6 +471,7 @@ class RandomForestRegressionModel(_RandomForestModel):
             Xs,
             jnp.asarray(self.feature),
             jnp.asarray(self.threshold.astype(Xs.dtype)),
+            jnp.asarray(self.left_child),
             max_depth=self.max_depth,
         )  # (T, n)
         stats = jnp.take_along_axis(

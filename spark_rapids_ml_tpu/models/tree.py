@@ -83,6 +83,11 @@ class _RandomForestClass:
             "min_samples_leaf": 1,
             "min_impurity_decrease": 0.0,
             "split_criterion": None,  # set per subclass (gini/variance)
+            # width budget of the active-node frontier per level (ops/forest
+            # builds exactly level-wise while 2^level <= max_active_nodes,
+            # then best-first under this width); program size and compile
+            # memory scale with it, not with 2^max_depth
+            "max_active_nodes": 256,
             "verbose": False,
         }
 
@@ -302,6 +307,7 @@ class _RandomForestEstimator(
             min_info_gain=float(p["min_impurity_decrease"]),
             bootstrap=bool(p["bootstrap"]),
             subsample=float(p["max_samples"]),
+            max_active=int(p.get("max_active_nodes", 256)),
             mesh=mesh,
         )
         from ..parallel.mesh import fetch_replicated
@@ -316,6 +322,7 @@ class _RandomForestEstimator(
             "leaf_stats": np.asarray(host.leaf_stats)[:n_trees],
             "gain": np.asarray(host.gain)[:n_trees],
             "count": np.asarray(host.count)[:n_trees],
+            "left_child": np.asarray(host.left_child)[:n_trees],
             "max_depth": max_depth,
             "n_cols": d,
             "dtype": str(np.dtype(fit_input.dtype).name),
@@ -334,6 +341,14 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
             "gain", np.zeros(self.feature.shape, np.float32)))
         self.count: np.ndarray = np.asarray(attrs.get(
             "count", np.zeros(self.feature.shape, np.float32)))
+        if "left_child" in attrs:
+            self.left_child: np.ndarray = np.asarray(attrs["left_child"])
+        else:
+            # models saved by the pre-node-table release used the implicit
+            # heap layout: children of i at 2i+1 / 2i+2
+            idx = np.arange(self.feature.shape[1], dtype=np.int32)
+            heap = np.where(self.feature >= 0, 2 * idx + 1, -1)
+            self.left_child = heap.astype(np.int32)
         self.max_depth: int = int(attrs["max_depth"])
         self.n_cols: int = int(attrs["n_cols"])
         self.dtype: str = str(attrs.get("dtype", "float32"))
@@ -348,17 +363,21 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
         return int(self._reachable_mask().sum())
 
     def _reachable_mask(self) -> np.ndarray:
-        """(T, max_nodes) bool: nodes actually part of each tree."""
-        T, max_nodes = self.feature.shape
-        reach = np.zeros((T, max_nodes), bool)
+        """(T, n_nodes) bool: nodes actually part of each tree.  Child
+        table ids are always greater than the parent's (children are
+        allocated level by level), so one ascending pass suffices."""
+        T, n_nodes = self.feature.shape
+        reach = np.zeros((T, n_nodes), bool)
         reach[:, 0] = True
-        for i in range(max_nodes):
-            li, ri = 2 * i + 1, 2 * i + 2
-            if li >= max_nodes:
-                break
+        rows = np.arange(T)
+        for i in range(n_nodes):
             split = reach[:, i] & (self.feature[:, i] >= 0)
-            reach[:, li] |= split
-            reach[:, ri] |= split
+            if not split.any():
+                continue
+            li = self.left_child[:, i]
+            sel = rows[split]
+            reach[sel, li[split]] = True
+            reach[sel, li[split] + 1] = True
         return reach
 
     @property
@@ -396,6 +415,7 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
             jnp.asarray(X),
             jnp.asarray(self.feature),
             jnp.asarray(self.threshold),
+            jnp.asarray(self.left_child),
             max_depth=self.max_depth,
         )
         return np.asarray(jax.device_get(leaves))  # (T, n)
@@ -415,9 +435,10 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
                     lines.append(f"{pad}Predict: {val.tolist()}")
                 else:
                     thr = float(self.threshold[t, node])
+                    lc = int(self.left_child[t, node])
                     lines.append(f"{pad}If (feature {f} <= {thr:.6g})")
-                    stack.append((2 * node + 2, indent + 1))
-                    stack.append((2 * node + 1, indent + 1))
+                    stack.append((lc + 1, indent + 1))
+                    stack.append((lc, indent + 1))
         return "\n".join(lines)
 
     def to_json(self) -> str:
@@ -428,12 +449,13 @@ class _RandomForestModel(_RandomForestClass, _TpuModel, _RandomForestParams):
             f = int(self.feature[t, i])
             if f < 0:
                 return {"leaf_value": self.leaf_stats[t, i].tolist()}
+            lc = int(self.left_child[t, i])
             return {
                 "split_feature": f,
                 "threshold": float(self.threshold[t, i]),
                 "default_left": True,
-                "left_child": node_dict(t, 2 * i + 1),
-                "right_child": node_dict(t, 2 * i + 2),
+                "left_child": node_dict(t, lc),
+                "right_child": node_dict(t, lc + 1),
             }
 
         return json.dumps(

@@ -8,12 +8,19 @@
 # (XGBoost-style binned) tree builder designed for XLA:
 #   - Quantile bin edges are computed per worker from the local shard (one
 #     sort per feature); rows are digitized once into int32 bin ids.
-#   - Trees grow LEVEL-WISE over a heap layout (node i -> children 2i+1,
-#     2i+2), so every level is a fixed-shape batch of nodes: one scatter-add
-#     builds the (stats, nodes, features, bins) histogram, cumulative sums
-#     over bins give every candidate split's left/right statistics, and an
-#     argmax picks the best (feature, bin) per node.  No recursion, no
-#     dynamic shapes, no host round-trips.
+#   - Trees grow LEVEL-WISE over a bounded ACTIVE-NODE frontier: each level
+#     processes at most `max_active` nodes (a fixed-shape batch), one
+#     scatter-add builds the (active-slot, bin, feature, stat) histogram,
+#     cumulative sums over bins give every candidate split's left/right
+#     statistics, and an argmax picks the best (feature, bin) per slot.
+#     Children are allocated in an explicit node TABLE (`left_child`
+#     pointers) whose size is 1 + sum_l 2*min(2^l, max_active) — linear in
+#     depth, NOT the 2^depth heap that capped the depth-6 compiler ceiling.
+#     When a level has more splittable children than `max_active`, the
+#     largest (by weighted count) keep growing and the rest become leaves
+#     (best-first growth under a width budget, LightGBM-style); with
+#     max_active >= 2^level the build is exact level-wise growth.
+#     No recursion, no dynamic shapes, no host round-trips.
 #   - Per-node feature subsets (featureSubsetStrategy) use the Gumbel
 #     top-K trick; bootstrap resampling uses Poisson(rate) weights (the
 #     standard large-n approximation of multinomial bootstrap, also used
@@ -96,11 +103,19 @@ def _impurity(stats: jax.Array, criterion: int) -> jax.Array:
 
 
 class TreeArrays(NamedTuple):
-    feature: jax.Array  # (T, max_nodes) int32 split feature, -1 = leaf
-    threshold: jax.Array  # (T, max_nodes) f32 raw-value threshold (go left if <=)
-    leaf_stats: jax.Array  # (T, max_nodes, S) per-leaf statistics
-    gain: jax.Array  # (T, max_nodes) impurity decrease of each split (0 = leaf)
-    count: jax.Array  # (T, max_nodes) weighted sample count reaching the node
+    feature: jax.Array  # (T, n_nodes) int32 split feature, -1 = leaf
+    threshold: jax.Array  # (T, n_nodes) f32 raw-value threshold (go left if <=)
+    leaf_stats: jax.Array  # (T, n_nodes, S) per-leaf statistics
+    gain: jax.Array  # (T, n_nodes) impurity decrease of each split (0 = leaf)
+    count: jax.Array  # (T, n_nodes) weighted sample count reaching the node
+    left_child: jax.Array  # (T, n_nodes) int32 node-table id of the left
+    # child (right child = left + 1); -1 for leaves
+
+
+def table_nodes(max_depth: int, max_active: int) -> int:
+    """Node-table size for a (max_depth, max_active) build: root + two
+    child slots per possible active node per level."""
+    return 1 + sum(2 * min(2**lv, max_active) for lv in range(max_depth))
 
 
 def _grow_one_tree(
@@ -117,10 +132,11 @@ def _grow_one_tree(
     min_info_gain: float,
     bootstrap: bool,
     subsample: float,
+    max_active: int,
 ):
     m, d = Xb.shape
     S = stats.shape[1]
-    max_nodes = 2 ** (max_depth + 1) - 1
+    n_nodes = table_nodes(max_depth, max_active)
 
     kb, kf = jax.random.split(key)
     # pcast marks the rate as device-varying to match the varying key inside
@@ -137,33 +153,42 @@ def _grow_one_tree(
     w = w * valid
     wstats = stats * w[:, None]  # (m, S)
 
-    feature = jnp.full((max_nodes,), -1, jnp.int32)
-    threshold = jnp.zeros((max_nodes,), edges.dtype)
-    gain_arr = jnp.zeros((max_nodes,), stats.dtype)
-    count_arr = jnp.zeros((max_nodes,), stats.dtype)
-    node = jnp.zeros((m,), jnp.int32)
+    # node-table arrays carry ONE trash row at index n_nodes: writes for
+    # empty frontier slots land there instead of corrupting real nodes
+    # (negative scatter ids would wrap in JAX)
+    feature = jnp.full((n_nodes + 1,), -1, jnp.int32)
+    threshold = jnp.zeros((n_nodes + 1,), edges.dtype)
+    gain_arr = jnp.zeros((n_nodes + 1,), stats.dtype)
+    count_arr = jnp.zeros((n_nodes + 1,), stats.dtype)
+    left_arr = jnp.full((n_nodes + 1,), -1, jnp.int32)
+
+    node = jnp.zeros((m,), jnp.int32)  # table id where each sample rests
+    # frontier slot of each sample; A_l (the level width) means inactive
+    slot = jnp.where(w > 0, 0, 1).astype(jnp.int32)
+    frontier = jnp.zeros((1,), jnp.int32)  # table ids of active nodes
+    base = 1  # next unallocated table id
 
     for level in range(max_depth):
-        start, n_l = 2**level - 1, 2**level
-        active = (node >= start) & (node < start + n_l) & (w > 0)
-        node_rel = jnp.where(active, node - start, 0)
+        A_l = min(2**level, max_active)
+        active = slot < A_l
+        slot_c = jnp.clip(slot, 0, A_l - 1)
 
-        # histogram: (n_l * B, d, S) via one batched scatter-add
-        idx = node_rel[:, None] * n_bins + Xb  # (m, d)
+        # histogram: (A_l * B, d, S) via one batched scatter-add
+        idx = slot_c[:, None] * n_bins + Xb  # (m, d)
         upd = jnp.where(active[:, None, None], wstats[:, None, :], 0.0)
         upd = jnp.broadcast_to(upd, (m, d, S))
-        hist = jnp.zeros((n_l * n_bins, d, S), stats.dtype)
+        hist = jnp.zeros((A_l * n_bins, d, S), stats.dtype)
         hist = hist.at[idx, jnp.arange(d)[None, :], :].add(upd)
-        hist = hist.reshape(n_l, n_bins, d, S).transpose(0, 2, 1, 3)
-        # (n_l, d, B, S)
+        hist = hist.reshape(A_l, n_bins, d, S).transpose(0, 2, 1, 3)
+        # (A_l, d, B, S)
 
         cum = jnp.cumsum(hist, axis=2)
-        total = cum[:, :, -1, :]  # (n_l, d, S) same for every feature
-        left = cum[:, :, : n_bins - 1, :]  # (n_l, d, B-1, S)
+        total = cum[:, :, -1, :]  # (A_l, d, S) same for every feature
+        left = cum[:, :, : n_bins - 1, :]  # (A_l, d, B-1, S)
         right = total[:, :, None, :] - left
 
-        imp_parent, n_parent = _impurity(total[:, 0, :], criterion)  # (n_l,)
-        imp_l, n_left = _impurity(left, criterion)  # (n_l, d, B-1)
+        imp_parent, n_parent = _impurity(total[:, 0, :], criterion)  # (A_l,)
+        imp_l, n_left = _impurity(left, criterion)  # (A_l, d, B-1)
         imp_r, n_right = _impurity(right, criterion)
         safe_np = jnp.maximum(n_parent, 1e-12)[:, None, None]
         gain = (
@@ -176,47 +201,85 @@ def _grow_one_tree(
         if max_features < d:
             # per-node feature subset: Gumbel top-K mask over features
             g = jax.random.gumbel(
-                jax.random.fold_in(kf, level), (n_l, d), stats.dtype
+                jax.random.fold_in(kf, level), (A_l, d), stats.dtype
             )
             kth = jnp.sort(g, axis=1)[:, d - max_features]
             fmask = g >= kth[:, None]  # exactly K True per node
             gain = jnp.where(fmask[:, :, None], gain, -jnp.inf)
 
-        flat = gain.reshape(n_l, -1)
+        flat = gain.reshape(A_l, -1)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // (n_bins - 1)).astype(jnp.int32)  # (n_l,)
+        bf = (best // (n_bins - 1)).astype(jnp.int32)  # (A_l,)
         bb = (best % (n_bins - 1)).astype(jnp.int32)
-        can_split = jnp.isfinite(best_gain) & (best_gain > min_info_gain)
+        real = frontier >= 0
+        can_split = jnp.isfinite(best_gain) & (best_gain > min_info_gain) & real
 
-        heap_ids = start + jnp.arange(n_l)
-        feature = feature.at[heap_ids].set(jnp.where(can_split, bf, -1))
-        threshold = threshold.at[heap_ids].set(
+        sids = jnp.where(real, frontier, n_nodes)  # dead slots -> trash row
+        left_ids = base + 2 * jnp.arange(A_l, dtype=jnp.int32)
+        feature = feature.at[sids].set(jnp.where(can_split, bf, -1))
+        threshold = threshold.at[sids].set(
             jnp.where(can_split, edges[bb, bf], 0.0)
         )
-        gain_arr = gain_arr.at[heap_ids].set(
+        gain_arr = gain_arr.at[sids].set(
             jnp.where(can_split, best_gain, 0.0)
         )
-        count_arr = count_arr.at[heap_ids].set(n_parent)
+        count_arr = count_arr.at[sids].set(n_parent)
+        left_arr = left_arr.at[sids].set(jnp.where(can_split, left_ids, -1))
 
         # route samples: left child if bin id <= split bin
-        samp_f = bf[node_rel]
-        samp_b = bb[node_rel]
+        samp_f = bf[slot_c]
+        samp_b = bb[slot_c]
         go_left = (
             jnp.take_along_axis(Xb, samp_f[:, None], axis=1)[:, 0] <= samp_b
         )
-        child = 2 * node + 1 + jnp.where(go_left, 0, 1)
-        node = jnp.where(active & can_split[node_rel], child, node)
+        splits = active & can_split[slot_c]
+        child_node = left_ids[slot_c] + jnp.where(go_left, 0, 1)
+        node = jnp.where(splits, child_node, node)
 
-    leaf_stats = jnp.zeros((max_nodes, S), stats.dtype).at[node].add(wstats)
-    return TreeArrays(feature, threshold, leaf_stats, gain_arr, count_arr)
+        if level + 1 < max_depth:
+            # next frontier: the up-to-A_next largest children (weighted
+            # count) that could still split; the rest rest as leaves
+            A_next = min(2 * A_l, max_active)
+            flat2 = n_left.reshape(A_l, -1)
+            nl_b = jnp.take_along_axis(flat2, best[:, None], axis=1)[:, 0]
+            nr_b = n_parent - nl_b
+            cand_counts = jnp.stack([nl_b, nr_b], axis=1).reshape(-1)
+            cand_valid = jnp.repeat(can_split, 2)
+            growable = cand_counts >= jnp.maximum(2.0 * min_instances, 1e-12)
+            score = jnp.where(cand_valid & growable, cand_counts, -jnp.inf)
+            if 2 * A_l <= max_active:
+                keep_vals = score
+                keep_idx = jnp.arange(2 * A_l, dtype=jnp.int32)
+            else:
+                keep_vals, keep_idx = jax.lax.top_k(score, A_next)
+                keep_idx = keep_idx.astype(jnp.int32)
+            kept = keep_vals > -jnp.inf
+            frontier = jnp.where(kept, base + keep_idx, -1)
+            # inverse map: candidate child -> next-level slot (A_next = none)
+            inv = jnp.full((2 * A_l,), A_next, jnp.int32).at[keep_idx].set(
+                jnp.where(kept, jnp.arange(A_next, dtype=jnp.int32), A_next)
+            )
+            cand_of_sample = 2 * slot_c + jnp.where(go_left, 0, 1)
+            slot = jnp.where(splits, inv[cand_of_sample], A_next)
+        base += 2 * A_l
+
+    leaf_stats = jnp.zeros((n_nodes + 1, S), stats.dtype).at[node].add(wstats)
+    return TreeArrays(
+        feature[:n_nodes],
+        threshold[:n_nodes],
+        leaf_stats[:n_nodes],
+        gain_arr[:n_nodes],
+        count_arr[:n_nodes],
+        left_arr[:n_nodes],
+    )
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "trees_per_worker", "max_depth", "n_bins", "criterion", "n_classes",
-        "max_features", "bootstrap", "subsample", "mesh",
+        "max_features", "bootstrap", "subsample", "max_active", "mesh",
     ),
 )
 def forest_fit(
@@ -234,6 +297,7 @@ def forest_fit(
     min_info_gain: float,
     bootstrap: bool,
     subsample: float,
+    max_active: int = 256,
     mesh=None,
 ):
     """Fit the whole forest: each device grows `trees_per_worker` trees on
@@ -269,6 +333,7 @@ def forest_fit(
             min_info_gain=min_info_gain,
             bootstrap=bootstrap,
             subsample=subsample,
+            max_active=max_active,
         )
         return jax.vmap(lambda k: grow(k))(keys)
 
@@ -276,7 +341,7 @@ def forest_fit(
         kernel,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=TreeArrays(*([P(DATA_AXIS)] * 5)),
+        out_specs=TreeArrays(*([P(DATA_AXIS)] * 6)),
     )
     return shard(X, y, valid)
 
@@ -284,14 +349,15 @@ def forest_fit(
 @partial(jax.jit, static_argnames=("max_depth",))
 def forest_apply(
     X: jax.Array,  # (n, d) query rows
-    feature: jax.Array,  # (T, max_nodes)
-    threshold: jax.Array,  # (T, max_nodes)
+    feature: jax.Array,  # (T, n_nodes)
+    threshold: jax.Array,  # (T, n_nodes)
+    left_child: jax.Array,  # (T, n_nodes)
     max_depth: int,
 ) -> jax.Array:
-    """Leaf heap index per (tree, row): vectorized heap traversal —
+    """Leaf node-table index per (tree, row): vectorized pointer traversal —
     `max_depth` rounds of gather + select, all trees at once."""
 
-    def one_tree(feat, thr):
+    def one_tree(feat, thr, lc):
         node = jnp.zeros((X.shape[0],), jnp.int32)
         for _ in range(max_depth):
             f = feat[node]  # (n,)
@@ -299,8 +365,8 @@ def forest_apply(
             x = jnp.take_along_axis(
                 X, jnp.maximum(f, 0)[:, None], axis=1
             )[:, 0]
-            child = 2 * node + 1 + jnp.where(x <= thr[node], 0, 1)
+            child = lc[node] + jnp.where(x <= thr[node], 0, 1)
             node = jnp.where(is_leaf, node, child)
         return node
 
-    return jax.vmap(one_tree)(feature, threshold)  # (T, n)
+    return jax.vmap(one_tree)(feature, threshold, left_child)  # (T, n)

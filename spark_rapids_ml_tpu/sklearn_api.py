@@ -338,12 +338,12 @@ class RandomForestClassifier(_FacadeBase):
     ) -> None:
         self._warn_ignored(_ignored)
         self.n_estimators = n_estimators
-        # sklearn's max_depth=None means unbounded; the level-wise histogram
-        # builder allocates a (2^level · n_bins, d, S) histogram per level
-        # under vmap, so depth is capped at 12 here (≈4096·n_bins leaf slots)
-        # to keep sklearn-default calls inside HBM.  Pass max_depth explicitly
-        # for deeper trees.
-        self.max_depth = max_depth if max_depth is not None else 12
+        # sklearn's max_depth=None means unbounded; the histogram builder
+        # grows trees over a bounded active-node frontier (max_active_nodes,
+        # ops/forest.py), so program size is linear in depth — 16 (cuML's
+        # default) is the practical cap here.  Pass max_depth explicitly for
+        # deeper trees.
+        self.max_depth = max_depth if max_depth is not None else 16
         self.criterion = criterion
         self.max_features = max_features
         self.bootstrap = bootstrap
@@ -394,8 +394,8 @@ class RandomForestRegressor(_FacadeBase):
     ) -> None:
         self._warn_ignored(_ignored)
         self.n_estimators = n_estimators
-        # depth-capped default: see RandomForestClassifier.__init__
-        self.max_depth = max_depth if max_depth is not None else 12
+        # depth default: see RandomForestClassifier.__init__
+        self.max_depth = max_depth if max_depth is not None else 16
         self.max_features = max_features
         self.bootstrap = bootstrap
         self.random_state = random_state

@@ -58,10 +58,9 @@ def is_spark_dataframe(obj: Any) -> bool:
         return False
 
 
-def spark_dataframe_to_pandas(df: Any, columns: Optional[List[str]] = None):
-    """Collect a Spark DataFrame to pandas via Arrow, unwrapping VectorUDT
-    columns to array columns first (the `vector_to_array` step of the
-    reference's `_pre_process_data`, core.py:493-537)."""
+def _unwrap_vectors(df: Any):
+    """VectorUDT columns -> array columns (the `vector_to_array` step of
+    the reference's `_pre_process_data`, core.py:493-537)."""
     vec_cols = [
         f.name
         for f in df.schema.fields
@@ -72,6 +71,68 @@ def spark_dataframe_to_pandas(df: Any, columns: Optional[List[str]] = None):
 
         for c in vec_cols:
             df = df.withColumn(c, vector_to_array(c))
+    return df
+
+
+def _estimate_bytes(df: Any) -> Optional[int]:
+    """Rough dataset size: rows x flattened-f64-width.  One Spark count job
+    + one head() row; never materializes data on the driver."""
+    try:
+        n = df.count()
+        head = df.head()
+        if head is None:
+            return 0
+        width = 0
+        for v in head:
+            try:
+                width += len(v)  # vectors / arrays
+            except TypeError:
+                width += 1
+        return int(n) * max(width, 1) * 8
+    except Exception:  # pragma: no cover — size probe must never be fatal
+        return None
+
+
+def spark_dataframe_to_staging(df: Any):
+    """Route a Spark DataFrame into the fit path WITHOUT collecting large
+    data through the controller: past `spark_collect_max_bytes` (and with
+    `spark_exchange_dir` configured) the EXECUTORS write the dataset as
+    parquet to the shared exchange directory and the streaming-ingest path
+    (`streaming.stage_parquet` / streamed statistics) takes over — the
+    analog of the reference's worker-side partition pulls
+    (core.py:742-1013).  Small data keeps the Arrow collect path.
+
+    Returns `(dataset, cleanup_path)`: `dataset` is a pandas DataFrame or
+    a parquet path; `cleanup_path` names the written exchange directory
+    (caller deletes after the fit) or None."""
+    import os
+    import uuid
+
+    from .config import get_config
+
+    exchange = str(get_config("spark_exchange_dir") or "")
+    if not exchange:
+        # no exchange dir -> the estimate could only feed a warning; skip
+        # the extra count() job and keep the collect path untouched
+        return spark_dataframe_to_pandas(df), None
+    limit = int(get_config("spark_collect_max_bytes"))
+    est = _estimate_bytes(df)
+    if est is None or est <= limit:
+        return spark_dataframe_to_pandas(df), None
+    path = os.path.join(exchange, f"srmt-exchange-{uuid.uuid4().hex}.parquet")
+    logger.info(
+        f"Routing ~{est/2**30:.1f} GiB Spark dataset around the "
+        f"controller: executors write parquet to {path}"
+    )
+    _unwrap_vectors(df).write.parquet(path)
+    return path, path
+
+
+def spark_dataframe_to_pandas(df: Any, columns: Optional[List[str]] = None):
+    """Collect a Spark DataFrame to pandas via Arrow, unwrapping VectorUDT
+    columns to array columns first (the `vector_to_array` step of the
+    reference's `_pre_process_data`, core.py:493-537)."""
+    df = _unwrap_vectors(df)
     if columns:
         df = df.select(*columns)
     try:
@@ -93,7 +154,15 @@ def spark_dataframe_to_pandas(df: Any, columns: Optional[List[str]] = None):
 
 def pandas_to_spark(pdf, like_df: Any):
     """pandas -> Spark DataFrame in the same session as `like_df`."""
+    import numpy as np
+
     spark = like_df.sparkSession
+    # 2D outputs (probability/rawPrediction) are stored as np.ndarray cells;
+    # older pyspark schema inference only understands Python lists
+    for c in pdf.columns:
+        if len(pdf) and isinstance(pdf[c].iloc[0], np.ndarray):
+            pdf = pdf.copy()
+            pdf[c] = pdf[c].map(lambda a: np.asarray(a).tolist())
     return spark.createDataFrame(pdf)
 
 

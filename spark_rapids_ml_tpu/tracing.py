@@ -114,8 +114,12 @@ def device_profile() -> Iterator[None]:
     finally:
         if owner:
             with _profile_lock:
-                import jax
+                # only stop a trace that actually started: if start_trace
+                # raised, _profile_active never became True and calling
+                # stop_trace would mask the original error
+                if _profile_active:
+                    import jax
 
-                jax.profiler.stop_trace()
-                _profile_active = False
-            logger.info(f"Wrote device profile to {profile_dir}")
+                    jax.profiler.stop_trace()
+                    _profile_active = False
+                    logger.info(f"Wrote device profile to {profile_dir}")

@@ -71,3 +71,46 @@ def test_install_hook(spark):
         assert LogisticRegression is tpu_cls.LogisticRegression
     finally:
         spark_interop.uninstall()
+
+
+def test_large_df_routes_around_driver(spark, tmp_path):
+    """Past spark_collect_max_bytes the executors write parquet to the
+    exchange dir and the fit streams it — no toPandas() of the dataset."""
+    from unittest import mock
+
+    from spark_rapids_ml_tpu import spark_interop
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    df, X, y = _make_df(spark, n=400)
+    set_config(
+        spark_collect_max_bytes=1024,  # 400x5 doubles >> 1 KiB
+        spark_exchange_dir=str(tmp_path),
+    )
+    try:
+        with mock.patch.object(
+            spark_interop,
+            "spark_dataframe_to_pandas",
+            side_effect=AssertionError("dataset was collected via toPandas"),
+        ):
+            model = LogisticRegression(regParam=0.01).fit(df)
+    finally:
+        reset_config()
+    preds = model._transform_array(X.astype(np.float32))["prediction"]
+    assert (np.asarray(preds) == y).mean() > 0.9
+    # the exchange directory is cleaned up after the fit
+    import os
+
+    assert not any(
+        name.startswith("srmt-exchange-") for name in os.listdir(tmp_path)
+    )
+
+
+def test_small_df_still_collects(spark):
+    """Below the limit the Arrow collect path is untouched."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    df, X, y = _make_df(spark, n=150, seed=2)
+    model = LogisticRegression(regParam=0.01).fit(df)
+    preds = model._transform_array(X.astype(np.float32))["prediction"]
+    assert (np.asarray(preds) == y).mean() > 0.9

@@ -199,3 +199,57 @@ def test_sample_weights(rng):
     )
     preds = model._transform_array(X)["prediction"]
     assert np.all(preds == 1)
+
+
+def test_deep_tree_depth16_quality(clf_data):
+    # cuML's default depth (16): the active-node frontier keeps program
+    # size linear in depth; quality must track sklearn at the same depth
+    X, y = clf_data
+    model = RandomForestClassifier(
+        numTrees=16, maxDepth=16, seed=42, num_workers=1
+    ).fit((X, y))
+    acc = accuracy_score(y, model._transform_array(X)["prediction"])
+    sk = SkRFC(n_estimators=16, max_depth=16, random_state=42).fit(X, y)
+    sk_acc = accuracy_score(y, sk.predict(X))
+    assert acc > sk_acc - 0.1, f"tpu acc {acc} vs sklearn {sk_acc}"
+
+
+def test_depth12_parity_vs_sklearn(clf_data):
+    # sklearn-facade default depth with default frontier width
+    X, y = clf_data
+    model = RandomForestClassifier(
+        numTrees=16, maxDepth=12, seed=7, num_workers=1
+    ).fit((X, y))
+    acc = accuracy_score(y, model._transform_array(X)["prediction"])
+    sk = SkRFC(n_estimators=16, max_depth=12, random_state=7).fit(X, y)
+    sk_acc = accuracy_score(y, sk.predict(X))
+    assert acc > sk_acc - 0.1, f"tpu acc {acc} vs sklearn {sk_acc}"
+
+
+def test_capped_frontier_still_learns(clf_data):
+    # a tiny width budget (8 active nodes/level) degrades gracefully:
+    # best-first growth keeps the largest nodes splitting
+    X, y = clf_data
+    est = RandomForestClassifier(numTrees=8, maxDepth=10, seed=5)
+    est._tpu_params["max_active_nodes"] = 8
+    model = est.fit((X, y))
+    acc = accuracy_score(y, model._transform_array(X)["prediction"])
+    assert acc > 0.8, f"capped-frontier acc {acc}"
+    # structure stays consistent: every split node has in-table children
+    lc = model.left_child[model.feature >= 0]
+    assert lc.min() >= 1 and lc.max() + 1 < model.feature.shape[1]
+
+
+def test_capped_matches_uncapped_when_wide_enough(clf_data):
+    # max_active >= 2^level for every level => exact level-wise growth,
+    # so widening the budget beyond the tree width changes nothing
+    X, y = clf_data
+    preds = []
+    for width in (64, 4096):
+        est = RandomForestClassifier(
+            numTrees=4, maxDepth=6, seed=3, num_workers=1
+        )
+        est._tpu_params["max_active_nodes"] = width
+        model = est.fit((X, y))
+        preds.append(np.asarray(model._transform_array(X)["prediction"]))
+    assert np.array_equal(preds[0], preds[1])
