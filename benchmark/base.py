@@ -10,7 +10,6 @@ import json
 import os
 import subprocess
 import time
-from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 

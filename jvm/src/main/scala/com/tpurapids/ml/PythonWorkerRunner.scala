@@ -35,6 +35,7 @@ object PythonWorkerRunner {
   private var process: Process = _
   private var stdin: BufferedWriter = _
   private var stdout: BufferedReader = _
+  private var stderrLog: java.io.File = _
 
   private def pythonExe: String =
     sys.env.getOrElse("SRMT_PYTHON_EXE", "python3")
@@ -53,7 +54,12 @@ object PythonWorkerRunner {
     if (process == null || !process.isAlive) {
       val pb = new ProcessBuilder(
         pythonExe, "-m", "spark_rapids_ml_tpu.connect_plugin")
-      pb.redirectErrorStream(false)
+      // stderr goes to a FILE, not a pipe: the worker logs every fit, and
+      // an undrained pipe buffer would eventually block the worker
+      // mid-request and deadlock the JVM's readLine()
+      stderrLog = java.io.File.createTempFile("srmt-worker-", ".stderr")
+      stderrLog.deleteOnExit()
+      pb.redirectError(ProcessBuilder.Redirect.appendTo(stderrLog))
       process = pb.start()
       stdin = new BufferedWriter(new OutputStreamWriter(
         process.getOutputStream, StandardCharsets.UTF_8))
@@ -88,13 +94,10 @@ object PythonWorkerRunner {
   }
 
   private def drainStderr(): String = {
-    val err = new BufferedReader(new InputStreamReader(
-      process.getErrorStream, StandardCharsets.UTF_8))
-    val sb = new StringBuilder
-    var line = err.readLine()
-    var n = 0
-    while (line != null && n < 50) { sb.append(line).append('\n'); n += 1; line = err.readLine() }
-    sb.toString
+    if (stderrLog == null || !stderrLog.exists()) return ""
+    val bytes = Files.readAllBytes(stderrLog.toPath)
+    val tail = math.max(0, bytes.length - 8192)
+    new String(bytes, tail, bytes.length - tail, StandardCharsets.UTF_8)
   }
 
   def fit(

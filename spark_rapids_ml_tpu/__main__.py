@@ -11,9 +11,11 @@ import sys
 
 
 _USAGE = (
-    "usage: python -m spark_rapids_ml_tpu (script.py | -m module) [args...]\n"
-    "Run a Python script with sklearn transparently accelerated by "
-    "spark_rapids_ml_tpu (reference: python -m spark_rapids_ml)."
+    "usage: python -m spark_rapids_ml_tpu [--pyspark] (script.py | -m module)"
+    " [args...]\n"
+    "Run a Python script with sklearn (default) or pyspark.ml (--pyspark,\n"
+    "the spark-rapids-ml-tpu-submit driver mode) transparently accelerated\n"
+    "by spark_rapids_ml_tpu (reference: python -m spark_rapids_ml)."
 )
 
 
@@ -24,9 +26,18 @@ def main() -> None:
         print(_USAGE)
         raise SystemExit(0 if argv else 2)
 
-    from .install import install
+    if argv[0] == "--pyspark":
+        argv = argv[1:]
+        if not argv:
+            print(_USAGE)
+            raise SystemExit(2)
+        from .spark_interop import install as install_pyspark
 
-    install()
+        install_pyspark()
+    else:
+        from .install import install
+
+        install()
 
     if argv[0] == "-m":
         if len(argv) < 2:

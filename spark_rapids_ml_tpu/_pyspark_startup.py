@@ -1,0 +1,11 @@
+# PYTHONSTARTUP hook for `pyspark-rapids-ml-tpu` (submit.py): install the
+# pyspark.ml accelerator before the shell's first prompt.
+try:
+    from spark_rapids_ml_tpu.spark_interop import install as _install_pyspark
+
+    _install_pyspark()
+except Exception as _e:  # the shell must still start without the hook
+    import sys as _sys
+
+    print(f"spark_rapids_ml_tpu: accelerator not installed ({_e})",
+          file=_sys.stderr)

@@ -69,9 +69,14 @@ _DEFAULTS: Dict[str, Any] = {
     "spark_collect_max_bytes": 2 * 1024 * 1024 * 1024,
     # Shared-filesystem directory for the parquet exchange (must be
     # readable from the controller and writable from the executors, e.g.
-    # NFS/GCS-fuse).  Empty -> always collect, with a warning past the
-    # size limit.
+    # NFS/GCS-fuse).  Empty -> always collect via Arrow (no size probe
+    # runs in that case).
     "spark_exchange_dir": "",
+    # Exact-kNN item sets up to this many bytes replicate on every host
+    # (simple model contract); above it, multi-process fits keep feature
+    # rows process-local and only the global id vector replicates (the
+    # analog of the reference's distributed block exchange, knn.py:688-779).
+    "knn_replicate_max_bytes": 1024 * 1024 * 1024,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

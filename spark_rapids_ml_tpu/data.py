@@ -15,7 +15,7 @@ from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from .utils import _ArrayBatch, _concat_and_free
+from .utils import _ArrayBatch
 
 try:  # scipy is baked in but keep the import soft
     import scipy.sparse as sp

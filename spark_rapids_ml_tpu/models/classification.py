@@ -31,7 +31,7 @@ from ..params import (
     TypeConverters,
     _TpuParams,
 )
-from ..utils import _ArrayBatch, get_logger
+from ..utils import _ArrayBatch
 
 
 def _label_range_kernel(y, w):

@@ -21,7 +21,6 @@ from ..params import (
     TypeConverters,
     _TpuParams,
 )
-from ..utils import _ArrayBatch, get_logger
 
 
 class _NNClass:
@@ -111,6 +110,40 @@ def _gather_items(X: np.ndarray, ids: np.ndarray, auto_ids: bool):
     else:
         ids = allgather_host_rows(ids)
     return X, ids
+
+
+def _item_layout_for(X: np.ndarray, ids: np.ndarray, auto_ids: bool):
+    """Decide the item layout for an exact-kNN fit: replicate the full set
+    on every host (small data — the simple contract), or keep FEATURES
+    process-local past `knn_replicate_max_bytes` and replicate only the
+    cheap global id vector (the analog of the reference's distributed
+    block exchange, knn.py:688-779, where no worker holds the full item
+    matrix).  Returns (X, ids_global, distributed, n_items_global)."""
+    import jax
+
+    from ..config import get_config
+    from ..parallel.mesh import allgather_host_rows
+
+    if jax.process_count() == 1:
+        X, ids = _gather_items(X, ids, auto_ids)
+        return X, ids, False, X.shape[0]
+    from jax.experimental import multihost_utils
+
+    counts = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray(X.shape[0], np.int64)
+        )
+    ).reshape(-1)
+    n_global = int(counts.sum())
+    total_bytes = n_global * int(X.shape[1]) * X.dtype.itemsize
+    if total_bytes <= int(get_config("knn_replicate_max_bytes")):
+        X, ids = _gather_items(X, ids, auto_ids)
+        return X, ids, False, n_global
+    if auto_ids:
+        ids_global = np.arange(n_global, dtype=np.int64)
+    else:
+        ids_global = allgather_host_rows(ids)
+    return X, ids_global, True, n_global
 
 
 def _assemble_knn_df(q_ids, indices, dist, sort_by_query_id: bool):
@@ -250,15 +283,22 @@ class NearestNeighbors(_NNClass, _TpuEstimator, _KNNParams):
 
     def _fit(self, dataset: DatasetLike) -> "NearestNeighborsModel":
         X, ids, df, auto_ids = _extract_with_ids(self, dataset)
-        # multi-process: each process fit() sees its local items; the model
-        # holds the replicated full item set (the framework contract: model
-        # attributes are identical host state on every process)
-        X, ids = _gather_items(np.asarray(X), np.asarray(ids), auto_ids)
+        # multi-process: each process fit() sees its local items.  Small
+        # item sets replicate on every host (simple model contract); past
+        # `knn_replicate_max_bytes` features stay PROCESS-LOCAL and only
+        # the id vector replicates — kneighbors stages each process's
+        # block into the global sharded layout, so no host or device ever
+        # holds the full N x d matrix.
+        X, ids, distributed, n_global = _item_layout_for(
+            np.asarray(X), np.asarray(ids), auto_ids
+        )
         model = NearestNeighborsModel(
             item_features=np.asarray(X),
             item_ids=ids,
             n_cols=int(X.shape[1]),
             dtype=str(X.dtype),
+            distributed_items=distributed,
+            n_items_global=n_global,
         )
         return _finalize_nn_fit(self, model, df)
 
@@ -278,26 +318,57 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         self.item_ids: np.ndarray = np.asarray(attrs["item_ids"])
         self.n_cols = int(attrs.get("n_cols", self.item_features.shape[1]))
         self.dtype = str(attrs.get("dtype", self.item_features.dtype))
+        # distributed-item layout: `item_features` holds only THIS
+        # process's rows; `item_ids` is the (cheap) global id vector
+        self.distributed_items = bool(attrs.get("distributed_items", False))
+        self.n_items_global = int(
+            attrs.get("n_items_global", self.item_features.shape[0])
+        )
         self._item_df = None
         self._device_items = None  # lazily cached device-resident item shards
 
     def _staged_items(self, mesh, dtype):
         """Item rows + validity + positional ids staged onto the mesh once
-        and reused across kneighbors calls.  The item arrays are replicated
-        host state (model attributes), so `RowStager.for_replicated` shards
-        them without duplication across processes; positional ids
-        (remapped to user ids on the host afterwards, as the reference
-        remaps cuml row ids, knn.py:787-801) come from the same layout."""
+        and reused across kneighbors calls.  Replicated item arrays shard
+        via `RowStager.for_replicated` (each process stages its even block
+        of the global rows); distributed item arrays stage each process's
+        LOCAL block directly — either way positional ids come from the
+        same layout in global process-major order and are remapped to user
+        ids on the host afterwards (as the reference remaps cuml row ids,
+        knn.py:787-801)."""
         from ..parallel.mesh import RowStager
 
         key = (id(mesh), str(dtype))
         if self._device_items is not None and self._device_items[0] == key:
             return self._device_items[1]
-        st = RowStager.for_replicated(self.item_features.shape[0], mesh)
+        if self.distributed_items:
+            st = RowStager(self.item_features.shape[0], mesh)
+        else:
+            st = RowStager.for_replicated(self.item_features.shape[0], mesh)
         staged = (st.stage(self.item_features, dtype), st.mask(dtype),
                   st.row_ids())
         self._device_items = (key, staged)
         return staged
+
+    def save(self, path: str) -> None:
+        if self.distributed_items:
+            raise NotImplementedError(
+                "A distributed-item NearestNeighborsModel holds only this "
+                "process's feature rows; persist the source dataset (or "
+                "lower knn_replicate_max_bytes to refit replicated) "
+                "instead of saving the model."
+            )
+        super().save(path)
+
+    def cpu(self):
+        if self.distributed_items:
+            # sklearn on the local block would silently search a fraction
+            # of the items with positions that don't match the global ids
+            raise NotImplementedError(
+                "cpu() needs the full item set; this distributed-item "
+                "model holds only this process's rows"
+            )
+        return super().cpu()
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Distributed ring brute force; (metric distances, positional
@@ -306,7 +377,7 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         from ..parallel import TpuContext
         from ..parallel.mesh import RowStager
 
-        n_items = self.item_features.shape[0]
+        n_items = self.n_items_global
         if k > n_items:
             raise ValueError(f"k={k} exceeds the number of items ({n_items})")
         with TpuContext(self.num_workers, require_p2p=True) as ctx:

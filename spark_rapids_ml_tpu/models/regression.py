@@ -28,7 +28,7 @@ from ..params import (
     TypeConverters,
     _TpuParams,
 )
-from ..utils import _ArrayBatch, get_logger
+from ..utils import _ArrayBatch
 
 
 class LinearRegressionClass:

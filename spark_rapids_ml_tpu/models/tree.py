@@ -26,7 +26,6 @@ from ..params import (
     TypeConverters,
     _TpuParams,
 )
-from ..utils import _ArrayBatch
 
 
 class _RandomForestClass:

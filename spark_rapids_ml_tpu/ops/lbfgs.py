@@ -14,7 +14,6 @@
 #
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable, NamedTuple, Tuple
 
 import jax

@@ -14,7 +14,7 @@ import numpy as np
 
 from .core import Estimator, Model, Transformer, _TpuEstimator
 from .data import DatasetLike
-from .params import Param, Params, TypeConverters
+from .params import Param, TypeConverters
 from .utils import get_logger
 
 

@@ -1,0 +1,75 @@
+#
+# Submit-wrapper CLIs — the analog of the reference's console scripts
+# `spark-rapids-submit` / `pyspark-rapids` (reference pyproject.toml:41-43,
+# spark_rapids_submit.py, pyspark_rapids.py): launch a Spark application or
+# shell with the zero-import-change accelerator pre-installed, so
+# `from pyspark.ml.classification import LogisticRegression` resolves to the
+# TPU-backed estimator with no source edits.
+#
+#   spark-rapids-ml-tpu-submit [spark-submit options] app.py [app args]
+#   pyspark-rapids-ml-tpu      [pyspark options]
+#
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Tuple
+
+# spark-submit options that take NO value (everything else that starts with
+# a dash is assumed to consume the next argv entry)
+_BOOLEAN_FLAGS = {"--verbose", "-v", "--supervise"}
+_PASSTHROUGH = {"--help", "-h", "--version"}
+
+
+def _split_launcher_args(argv: List[str], tool: str, alias: str) -> Tuple[List[str], List[str]]:
+    """Split `[launcher options] app [app args]` at the first non-option
+    token, mirroring spark-submit's own CLI contract."""
+    i = 0
+    while i < len(argv) and argv[i].startswith("-"):
+        if argv[i] in _PASSTHROUGH:
+            out = subprocess.run(
+                [tool, argv[i]], capture_output=True, text=True
+            )
+            sys.stderr.write(
+                (out.stderr or out.stdout).replace(tool, alias)
+            )
+            raise SystemExit(0)
+        # `--opt=value` carries its value; boolean flags carry none;
+        # everything else consumes the next token (spark-submit contract)
+        if argv[i] in _BOOLEAN_FLAGS or "=" in argv[i]:
+            i += 1
+        else:
+            i += 2
+    return argv[:i], argv[i:]
+
+
+def _runner_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "__main__.py")
+
+
+def submit_main() -> None:
+    """spark-submit wrapper: the driver runs this package's __main__ with
+    the pyspark.ml hook installed, then the user's application unmodified."""
+    opts, app = _split_launcher_args(
+        sys.argv[1:], "spark-submit", "spark-rapids-ml-tpu-submit"
+    )
+    if not app:
+        raise ValueError("No application file supplied.")
+    cmd = ["spark-submit", *opts, _runner_path(), "--pyspark", *app]
+    raise SystemExit(subprocess.run(cmd).returncode)
+
+
+def pyspark_main() -> None:
+    """pyspark wrapper: the interactive shell starts with the pyspark.ml
+    hook installed (PYTHONSTARTUP runs the install module)."""
+    opts, rest = _split_launcher_args(
+        sys.argv[1:], "pyspark", "pyspark-rapids-ml-tpu"
+    )
+    env = dict(os.environ)
+    env["PYTHONSTARTUP"] = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_pyspark_startup.py"
+    )
+    raise SystemExit(
+        subprocess.run(["pyspark", *opts, *rest], env=env).returncode
+    )

@@ -4,7 +4,6 @@
 # recall via utils_knn.py), full-probe exactness, ivfpq smoke, joins.
 #
 import numpy as np
-import pandas as pd
 import pytest
 from sklearn.neighbors import NearestNeighbors as SkNN
 

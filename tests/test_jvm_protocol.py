@@ -12,7 +12,6 @@ import re
 
 import numpy as np
 import pandas as pd
-import pytest
 
 _SCALA = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -16,7 +16,6 @@ import sys
 import textwrap
 
 import numpy as np
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -103,6 +102,27 @@ WORKER = textwrap.dedent(
     assert nn.item_features.shape[0] == 1003, nn.item_features.shape
     d_knn, idx_knn = nn._search(X[:32].astype(np.float32), 3)
     out["knn_idx"] = idx_knn.tolist()
+
+    # distributed-item kNN: past knn_replicate_max_bytes the model keeps
+    # feature rows PROCESS-LOCAL (no host/device ever holds the full
+    # N x d matrix) and only the id vector replicates; results must still
+    # match the replicated model exactly
+    set_config(knn_replicate_max_bytes=1024)  # 1003x8 f32 >> 1 KiB
+    nn_d = NearestNeighbors(k=3).fit(Xl)
+    set_config(knn_replicate_max_bytes=1024 * 1024 * 1024)
+    if nproc > 1:
+        assert nn_d.distributed_items, "expected distributed-item layout"
+        # the memory probe: this process holds ONLY its local rows
+        assert nn_d.item_features.shape[0] == (hi - lo), (
+            nn_d.item_features.shape, hi - lo
+        )
+        try:
+            nn_d.save(os.path.join(os.path.dirname(outfile), "nn_d"))
+            raise AssertionError("distributed model save must refuse")
+        except NotImplementedError:
+            pass
+    _, idx_knn_d = nn_d._search(X[:32].astype(np.float32), 3)
+    out["knn_idx_dist"] = idx_knn_d.tolist()
 
     # DBSCAN transform on a replicated input (deterministic labels)
     from spark_rapids_ml_tpu.clustering import DBSCAN
@@ -213,6 +233,9 @@ def test_two_process_fit_matches_single_process(tmp_path):
         multi["pca_var"], single["pca_var"], rtol=1e-4
     )
     assert multi["knn_idx"] == single["knn_idx"]
+    # the distributed-item layout must search identically to replication
+    assert multi["knn_idx_dist"] == single["knn_idx"]
+    assert single["knn_idx_dist"] == single["knn_idx"]
     assert multi["db_labels"] == single["db_labels"]
     assert multi["rf_acc"] > 0.85 and single["rf_acc"] > 0.85, (
         multi["rf_acc"],

@@ -4,14 +4,18 @@
 # script runs against the TPU backend after install(), and the __main__
 # runner executes scripts end to end.
 #
+import os
 import subprocess
 import sys
 import textwrap
+from unittest import mock
 
 import numpy as np
 import pytest
 
 from spark_rapids_ml_tpu.install import install, uninstall
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture
@@ -179,3 +183,93 @@ def test_main_runner_propagates_failure(tmp_path):
     )
     # non-zero exit must propagate (reference run_test.sh:27-46 checks this)
     assert out.returncode == 3
+
+
+# ---------------------------------------------------------------------------
+# Submit-wrapper CLIs (the spark-rapids-submit / pyspark-rapids analogs)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_arg_splitting():
+    from spark_rapids_ml_tpu.submit import _split_launcher_args
+
+    opts, app = _split_launcher_args(
+        ["--master", "local[2]", "--verbose", "--conf", "a=b",
+         "app.py", "--user-flag", "1"],
+        "spark-submit", "x",
+    )
+    assert opts == ["--master", "local[2]", "--verbose", "--conf", "a=b"]
+    assert app == ["app.py", "--user-flag", "1"]
+
+
+def test_submit_requires_app():
+    import pytest
+
+    from spark_rapids_ml_tpu.submit import submit_main
+
+    with mock.patch.object(sys, "argv", ["spark-rapids-ml-tpu-submit"]):
+        with pytest.raises(ValueError, match="No application file"):
+            submit_main()
+
+
+def test_submit_builds_spark_submit_command(tmp_path):
+    import subprocess as sp
+
+    from spark_rapids_ml_tpu import submit
+
+    captured = {}
+
+    def fake_run(cmd, **kw):
+        captured["cmd"] = cmd
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    with mock.patch.object(sp, "run", fake_run), mock.patch.object(
+        sys, "argv",
+        ["spark-rapids-ml-tpu-submit", "--master", "local", "app.py", "x"],
+    ):
+        try:
+            submit.submit_main()
+        except SystemExit as e:
+            assert e.code == 0
+    cmd = captured["cmd"]
+    assert cmd[0] == "spark-submit" and cmd[1:3] == ["--master", "local"]
+    assert cmd[3].endswith("__main__.py")
+    assert cmd[4:] == ["--pyspark", "app.py", "x"]
+
+
+def test_runner_pyspark_mode_without_pyspark(tmp_path):
+    # --pyspark mode installs the pyspark.ml hook; without pyspark in the
+    # image the install raises cleanly (ModuleNotFoundError), proving the
+    # mode routes to spark_interop.install rather than the sklearn hook
+    script = tmp_path / "noop.py"
+    script.write_text("print('ran')\n")
+    import subprocess as sp
+
+    r = sp.run(
+        [sys.executable, "-m", "spark_rapids_ml_tpu", "--pyspark",
+         str(script)],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    try:
+        import pyspark  # noqa: F401
+
+        assert r.returncode == 0 and "ran" in r.stdout
+    except ImportError:
+        assert r.returncode != 0
+        assert "pyspark" in (r.stderr + r.stdout).lower()
+
+
+def test_submit_arg_splitting_equals_form():
+    from spark_rapids_ml_tpu.submit import _split_launcher_args
+
+    opts, app = _split_launcher_args(
+        ["--master=local[2]", "--verbose", "app.py", "x"],
+        "spark-submit", "x",
+    )
+    assert opts == ["--master=local[2]", "--verbose"]
+    assert app == ["app.py", "x"]
