@@ -45,7 +45,7 @@ CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 100_000))
 WORKLOADS = [
     w.strip()
     for w in os.environ.get(
-        "BENCH_WORKLOADS", "logreg,pca,kmeans,rf,ann,umap"
+        "BENCH_WORKLOADS", "logreg,pca,kmeans,rf,ann,umap,streaming"
     ).split(",")
 ]
 
@@ -257,6 +257,51 @@ def bench_ann(extra: dict):
     extra["ann_cagra_recall_at_10"] = round(hits / want.size, 4)
 
 
+def bench_streaming(extra: dict):
+    """Beyond-HBM epoch-streaming LogReg: parquet re-streamed per L-BFGS
+    evaluation (the reachability path for BASELINE's 1B x 256 north star;
+    dataset size here is IO-bound, so rows/sec/epoch is the metric that
+    extrapolates)."""
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.models.classification import LogisticRegression
+
+    extra["streaming_intended_config"] = (
+        "BASELINE north star: 1Bx256 (~1 TB, disk-bound); run: 2Mx64 "
+        "parquet (~512 MB) with the same epoch-streaming engine"
+    )
+    n, d = 2_000_000, 64
+    X, y = _gen_binary(n, d, seed=6)
+    td = tempfile.mkdtemp()
+    path = f"{td}/stream.parquet"
+    pd.DataFrame(
+        {"features": list(X), "label": y.astype(np.float64)}
+    ).to_parquet(path)
+    del X, y
+    set_config(force_streaming_stats=True)
+    try:
+        t0 = time.perf_counter()
+        model = LogisticRegression(regParam=1e-4, maxIter=10, tol=0.0).fit(path)
+        el = time.perf_counter() - t0
+        # TRUE dataset passes (accepted iterates + line-search backtracks),
+        # counted by the solver itself
+        epochs = int(model._model_attributes.get("streaming_epochs", 0)) or 1
+        extra["streaming_logreg_2Mx64_fit_sec"] = round(el, 2)
+        extra["streaming_logreg_rows_per_sec_per_epoch"] = round(
+            n * epochs / el, 1
+        )
+        extra["streaming_logreg_epochs"] = epochs
+    finally:
+        reset_config()
+        import shutil
+
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def bench_umap(extra: dict):
     """UMAP (BASELINE 10M x 128 scaled to the one-worker fit: 100k x 32)."""
     from spark_rapids_ml_tpu.umap import UMAP
@@ -321,6 +366,7 @@ def main() -> None:
         "rf": bench_rf,
         "ann": bench_ann,
         "umap": bench_umap,
+        "streaming": bench_streaming,
     }
     # logreg is the headline and ALWAYS runs (the driver needs the metric
     # line); a failure is still recorded as a JSON line rather than a crash

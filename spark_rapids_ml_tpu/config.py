@@ -72,6 +72,14 @@ _DEFAULTS: Dict[str, Any] = {
     # NFS/GCS-fuse).  Empty -> always collect via Arrow (no size probe
     # runs in that case).
     "spark_exchange_dir": "",
+    # Decode the next parquet chunk on a background thread while the
+    # device consumes the current one (streaming.iter_chunks_prefetch);
+    # costs one extra chunk of host memory.
+    "streaming_prefetch": True,
+    # When set, epoch-streaming fits (hours-long at beyond-HBM scale)
+    # write their full optimizer state here after every iteration and
+    # RESUME the identical trajectory after a preemption/crash.
+    "streaming_checkpoint_dir": "",
     # Exact-kNN item sets up to this many bytes replicate on every host
     # (simple model contract); above it, multi-process fits keep feature
     # rows process-local and only the global id vector replicates (the

@@ -7,6 +7,7 @@
 #
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Union
 
 import numpy as np
@@ -322,6 +323,9 @@ class LogisticRegression(
             self.getOrDefault("elasticNetParam")
         )
         fit_intercept = bool(p["fit_intercept"])
+        from ..config import get_config
+
+        ckpt_dir = str(get_config("streaming_checkpoint_dir") or "")
         res = logreg_streaming_fit(
             path, fcol, fcols, label_col, weight_col,
             family=str(self.getOrDefault("family")),
@@ -334,6 +338,10 @@ class LogisticRegression(
             history=int(p.get("lbfgs_memory", 10)),
             ls_max=int(p.get("linesearch_max_iter", 20)),
             dtype=dtype,
+            checkpoint_path=(
+                os.path.join(ckpt_dir, f"logreg-{self.uid}.npz")
+                if ckpt_dir else None
+            ),
         )
         dtype = np.dtype(dtype)
         if "degenerate_label" in res:
@@ -372,6 +380,9 @@ class LogisticRegression(
             "num_iters": int(res["n_iter"]),
             "objective": float(hist[-1]) if hist else 0.0,
             "objective_history": hist,
+            # true dataset passes incl. line-search backtracks (bench.py
+            # computes rows/sec/epoch from this)
+            "streaming_epochs": int(res.get("epochs", 0)),
         }
 
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:

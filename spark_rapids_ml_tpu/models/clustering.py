@@ -183,10 +183,15 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         reference's cluster-memory-scaled ingest, utils.py:403-522)."""
         from ..streaming import kmeans_streaming_fit
 
+        import os as _os
+
+        from ..config import get_config
+
         fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
         p = self._tpu_params
         seed = p.get("random_state")
         seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
+        ckpt_dir = str(get_config("streaming_checkpoint_dir") or "")
         res = kmeans_streaming_fit(
             path, fcol, fcols, weight_col,
             k=int(p["n_clusters"]),
@@ -197,6 +202,10 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
             init_steps=int(p.get("init_steps") or 2),
             oversample=float(p.get("oversampling_factor") or 2.0),
             dtype=dtype,
+            checkpoint_path=(
+                _os.path.join(ckpt_dir, f"kmeans-{self.uid}.npz")
+                if ckpt_dir else None
+            ),
         )
         dtype = np.dtype(dtype)
         return {

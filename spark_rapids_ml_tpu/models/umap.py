@@ -35,7 +35,8 @@ class UMAPClass:
         return {
             n: n
             for n in (
-                "n_neighbors", "n_components", "metric", "n_epochs",
+                "n_neighbors", "n_components", "metric", "metric_kwds",
+                "n_epochs",
                 "learning_rate", "init", "min_dist", "spread",
                 "set_op_mix_ratio", "local_connectivity",
                 "repulsion_strength", "negative_sample_rate", "a", "b",
@@ -46,8 +47,12 @@ class UMAPClass:
 
     @classmethod
     def _param_value_mapping(cls):
+        from ..ops.distances import SUPPORTED_METRICS
+
         return {
-            "metric": lambda x: x if x in ("euclidean", "l2", "cosine") else None,
+            # the cuML metric zoo minus sparse-only jaccard (reference
+            # umap.py:203-212); ops/distances.py implements the kernels
+            "metric": lambda x: x if x in SUPPORTED_METRICS else None,
             "init": lambda x: x if x in ("spectral", "random") else None,
         }
 
@@ -57,6 +62,7 @@ class UMAPClass:
             "n_neighbors": 15,
             "n_components": 2,
             "metric": "euclidean",
+            "metric_kwds": None,
             "n_epochs": None,
             "learning_rate": 1.0,
             "init": "spectral",
@@ -86,6 +92,9 @@ class _UMAPParams(
     n_components = Param("_", "n_components", "Embedding dimension.",
                          TypeConverters.toInt)
     metric = Param("_", "metric", "Distance metric.", TypeConverters.toString)
+    metric_kwds = Param("_", "metric_kwds",
+                        "Metric arguments (e.g. {'p': 3} for minkowski).",
+                        TypeConverters.identity)
     n_epochs = Param("_", "n_epochs", "Training epochs (None = auto).",
                      TypeConverters.identity)
     learning_rate = Param("_", "learning_rate", "Initial learning rate.",
@@ -190,7 +199,6 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         import jax.numpy as jnp
 
         from ..ops import umap as umap_ops
-        from ..ops.knn import knn_topk_blocked
 
         t0 = time.time()
         batch = self._extract(dataset)
@@ -224,19 +232,25 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         if k >= n:
             raise ValueError(f"n_neighbors={k} must be < n_samples={n}")
 
+        from ..ops.distances import metric_kind, preprocess_rows, umap_knn_graph
+
         metric = str(p.get("metric", "euclidean"))
+        pw = float(dict(p.get("metric_kwds") or {}).get("p", 2.0))
         X_graph = X_fit
-        if metric == "cosine":
-            X_graph = X_fit / np.maximum(
-                np.linalg.norm(X_fit, axis=1, keepdims=True), 1e-12
-            )
+        if metric_kind(metric) == "matmul":
+            # row transform folds cosine/correlation/hellinger onto the
+            # MXU euclidean kernel (ops/distances.py); asarray keeps the
+            # identity metrics (euclidean/l2/sqeuclidean) copy-free
+            X_graph = np.asarray(preprocess_rows(X_fit, metric), dtype=dtype)
 
         # 1. exact kNN graph on one device (self excluded)
         Xd = jnp.asarray(X_graph)
         ones = jnp.ones((n,), Xd.dtype)
         ids = jnp.arange(n, dtype=jnp.int32)
-        d2, inds = knn_topk_blocked(Xd, ones, ids, Xd, k=k + 1)
-        knn_d = jnp.sqrt(jnp.maximum(d2[:, 1:], 0.0))
+        dists, inds = umap_knn_graph(
+            Xd, ones, ids, Xd, k=k + 1, metric=metric, p=pw
+        )
+        knn_d = dists[:, 1:]
         knn_i = inds[:, 1:]
 
         # 2. fuzzy simplicial set
@@ -372,7 +386,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
     def _transform_array(self, X: np.ndarray) -> Dict[str, np.ndarray]:
         import jax.numpy as jnp
 
-        from ..ops.knn import knn_ring_topk, knn_topk_blocked
+        from ..ops.distances import metric_kind, preprocess_rows, umap_knn_graph
         from ..ops.umap import transform_init
         from ..parallel import TpuContext
 
@@ -387,11 +401,18 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             )
         Xq = np.ascontiguousarray(X, dtype=self._out_dtype(X))
         items = self.raw_data_
-        if str(self._tpu_params.get("metric", "euclidean")) == "cosine":
-            items = items / np.maximum(
-                np.linalg.norm(items, axis=1, keepdims=True), 1e-12
-            )
-            Xq = Xq / np.maximum(np.linalg.norm(Xq, axis=1, keepdims=True), 1e-12)
+        metric = str(self._tpu_params.get("metric", "euclidean"))
+        pw = float(
+            dict(self._tpu_params.get("metric_kwds") or {}).get("p", 2.0)
+        )
+        if metric_kind(metric) == "matmul":
+            # the same row transform the fit applied, so the distances
+            # match the fit's rho/sigma scales (NOTE: since round 3 the
+            # cosine/correlation convention is 1-cos, not the chord
+            # distance older saved models were fitted with)
+            dt = Xq.dtype
+            items = np.asarray(preprocess_rows(items, metric), dtype=dt)
+            Xq = np.asarray(preprocess_rows(Xq, metric), dtype=dt)
 
         with TpuContext(self.num_workers, require_p2p=True) as ctx:
             mesh = ctx.mesh
@@ -404,11 +425,9 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         idsd = ist.row_ids()
         qst = RowStager.for_replicated(Xq.shape[0], mesh)
         Qs = qst.stage(Xq, dtype)
-        if mesh.devices.size == 1:
-            d2, inds = knn_topk_blocked(Xi, validd, idsd, Qs, k=k)
-        else:
-            d2, inds = knn_ring_topk(Xi, validd, idsd, Qs, k=k, mesh=mesh)
-        knn_d = jnp.sqrt(jnp.maximum(d2, 0.0))
+        knn_d, inds = umap_knn_graph(
+            Xi, validd, idsd, Qs, k=k, metric=metric, p=pw, mesh=mesh
+        )
         emb = transform_init(
             inds,
             knn_d,

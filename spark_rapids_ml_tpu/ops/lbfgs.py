@@ -195,6 +195,8 @@ def lbfgs_minimize_host(
     l1: float = 0.0,
     l1_mask=None,
     ls_max: int = 20,
+    checkpoint_path: str = None,
+    checkpoint_tag: str = "",
 ):
     """HOST-driven L-BFGS/OWL-QN for EPOCH-STREAMING fits: the oracle is a
     full pass over out-of-core data (each evaluation re-streams parquet
@@ -207,9 +209,17 @@ def lbfgs_minimize_host(
     cluster-memory ingest (reference utils.py:403-522): dataset size here
     is bounded by DISK, not HBM x chips.
 
+    `checkpoint_path`: epoch-streaming fits can run for hours; when set,
+    the full optimizer state is written (atomically) after every accepted
+    iteration and a later call with the same path RESUMES the identical
+    trajectory — the beyond-HBM analog of a training-job preemption
+    recovery.  The file is removed on successful completion.
+
     Returns (w, n_iter, converged, history) with history the full
     (penalty-inclusive) objective per accepted iterate, entry 0 = initial.
     """
+    import os
+
     import numpy as np
 
     n = w0.shape[0]
@@ -235,6 +245,39 @@ def lbfgs_minimize_host(
     rho = np.zeros((m,))
     k = 0
 
+    def _is_writer() -> bool:
+        # multi-process pods run this loop in lockstep on every process
+        # (the oracle all-reduces); only rank 0 writes the shared file to
+        # avoid concurrent savez/replace races
+        try:
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def save_checkpoint(state: dict) -> None:
+        if not _is_writer():
+            return
+        tmp = checkpoint_path + ".tmp.npz"
+        np.savez(tmp, tag=np.asarray(checkpoint_tag), **state)
+        os.replace(tmp, checkpoint_path)
+
+    resumed = None
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path, allow_pickle=False) as z:
+            resumed = {kk: z[kk] for kk in z.files}
+        # a checkpoint is only trusted for the SAME problem: the tag binds
+        # it to (data, params, shapes); anything else starts fresh
+        if str(resumed.get("tag", "")) != checkpoint_tag:
+            import warnings
+
+            warnings.warn(
+                f"Ignoring checkpoint {checkpoint_path}: it belongs to a "
+                "different fit (tag mismatch)"
+            )
+            resumed = None
+
     def direction(pg):
         q = pg.astype(np.float64).copy()
         alpha = np.zeros((m,))
@@ -258,11 +301,23 @@ def lbfgs_minimize_host(
             r += (alpha[idx] - b) * S[idx]
         return -r
 
-    w = np.asarray(w0, np.float64).copy()
-    f, g = value_and_grad(w)
-    hist = [float(f + full_term(w))]
-    converged = False
-    it = 0
+    if resumed is not None:
+        w = resumed["w"]
+        f = float(resumed["f"])
+        g = resumed["g"]
+        S[:] = resumed["S"]
+        Y[:] = resumed["Y"]
+        rho[:] = resumed["rho"]
+        k = int(resumed["k"])
+        it = int(resumed["it"])
+        hist = [float(v) for v in resumed["hist"]]
+        converged = bool(resumed["converged"])
+    else:
+        w = np.asarray(w0, np.float64).copy()
+        f, g = value_and_grad(w)
+        hist = [float(f + full_term(w))]
+        converged = False
+        it = 0
     while it < max_iter and not converged:
         pg = pseudo_grad(w, g)
         p = direction(pg)
@@ -304,4 +359,12 @@ def lbfgs_minimize_host(
         w, f, g = w_new, f_new, g_new
         hist.append(new_full)
         it += 1
+        if checkpoint_path:
+            save_checkpoint({
+                "w": w, "f": np.asarray(f), "g": g, "S": S, "Y": Y,
+                "rho": rho, "k": np.asarray(k), "it": np.asarray(it),
+                "hist": np.asarray(hist), "converged": np.asarray(converged),
+            })
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        os.remove(checkpoint_path)
     return w, it, converged, hist

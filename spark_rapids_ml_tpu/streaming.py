@@ -158,6 +158,63 @@ def iter_chunks(
         yield bufX, bufy, bufw, fill
 
 
+def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
+    """`iter_chunks` with the parquet decode running on a background
+    thread, one chunk ahead: the device consumes chunk i while the host
+    reads chunk i+1 (the streaming analog of the reference's overlapped
+    reserved-memory copies, utils.py:403-522).  `iter_chunks` reuses its
+    buffers, so each prefetched chunk is copied out — one extra chunk of
+    host memory buys IO/compute overlap.  Disable via the
+    `streaming_prefetch` conf."""
+    if not get_config("streaming_prefetch"):
+        yield from iter_chunks(*args, **kwargs)
+        return
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    _DONE = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded puts so an abandoned consumer (exception/GC closes the
+        # generator) cannot pin the producer thread + chunk copies forever
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for cX, cy, cw, n_c in iter_chunks(*args, **kwargs):
+                if not _put((
+                    cX.copy(),
+                    None if cy is None else cy.copy(),
+                    None if cw is None else cw.copy(),
+                    n_c,
+                )):
+                    return
+            _put(_DONE)
+        except BaseException as e:  # surface reader errors on the consumer
+            _put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
 # ---------------------------------------------------------------------------
 # Mechanism A: stream-stage into a sharded HBM buffer
 # ---------------------------------------------------------------------------
@@ -265,7 +322,7 @@ def stage_parquet(
 
     off = 0
     n_chunks = 0
-    for cX, cy, cw, n_c in iter_chunks(
+    for cX, cy, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype,
     ):
@@ -365,7 +422,7 @@ def linreg_streaming_stats(
         "sy": jnp.zeros((), dtype),
         "syy": jnp.zeros((), dtype),
     }
-    for cX, cy, cw, n_c in iter_chunks(
+    for cX, cy, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
     ):
@@ -413,7 +470,7 @@ def pca_streaming_stats(
         "s1": jnp.zeros((d,), dtype),
         "sw": jnp.zeros((), dtype),
     }
-    for cX, _, cw, n_c in iter_chunks(
+    for cX, _, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, None, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
     ):
@@ -533,6 +590,7 @@ def logreg_streaming_fit(
     ls_max: int = 20,
     dtype=np.float32,
     chunk_rows: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
 ) -> dict:
     """Epoch-streaming logistic regression: host L-BFGS/OWL-QN
     (`ops/lbfgs.py lbfgs_minimize_host`) whose every evaluation streams the
@@ -615,7 +673,7 @@ def logreg_streaming_fit(
         theta = jnp.asarray(theta_np.astype(np.float32))
         acc_l = jnp.zeros((), jnp.float32)
         acc_g = jnp.zeros((n_param,), jnp.float32)
-        for cX, cy, cw, n_c in iter_chunks(
+        for cX, cy, cw, n_c in iter_chunks_prefetch(
             path, features_col, features_cols, label_col, weight_col,
             chunk_rows, dtype, row_range=(lo, hi),
         ):
@@ -647,6 +705,11 @@ def logreg_streaming_fit(
         l1=l1,
         l1_mask=coef_mask,
         ls_max=ls_max,
+        checkpoint_path=checkpoint_path,
+        checkpoint_tag=(
+            f"logreg|{path}|n={scan['n_total']}|d={d}|C={n_classes}|"
+            f"l2={l2}|l1={l1}|int={fit_intercept}|std={standardization}"
+        ),
     )
     logger.info(
         f"Epoch-streaming logreg: {n_iter} iterations, {epochs['n']} data "
@@ -671,6 +734,8 @@ def logreg_streaming_fit(
         "mean": mean,
         "std": std,
         "binomial": binomial,
+        # TRUE dataset passes (accepted iterates + line-search backtracks)
+        "epochs": epochs["n"],
     }
 
 
@@ -689,12 +754,15 @@ def kmeans_streaming_fit(
     dtype=np.float32,
     chunk_rows: Optional[int] = None,
     init_rows: int = 262_144,
+    checkpoint_path: Optional[str] = None,
 ) -> dict:
     """Epoch-streaming Lloyd: centers are seeded from a strided global
     subsample (k-means|| on device), then each iteration streams the
     chunks through a jitted assign+accumulate step (per-cluster sums /
     counts / cost in a donated accumulator) and updates centers on host.
-    Convergence matches `ops/kmeans.py kmeans_fit` (max center shift)."""
+    Convergence matches `ops/kmeans.py kmeans_fit` (max center shift).
+    `checkpoint_path`: per-iteration center checkpoint for preemption
+    recovery (same contract as `lbfgs_minimize_host`)."""
     import jax
     import jax.numpy as jnp
 
@@ -788,7 +856,7 @@ def kmeans_streaming_fit(
         C_dev = jnp.asarray(C_host.astype(dtype))
         acc = (jnp.zeros((k, d), jnp.float32), jnp.zeros((), jnp.float32))
         counts = jnp.zeros((k,), jnp.float32)
-        for cX, _, cw, n_c in iter_chunks(
+        for cX, _, cw, n_c in iter_chunks_prefetch(
             path, features_col, features_cols, None, weight_col,
             chunk_rows, dtype, row_range=(lo, hi),
         ):
@@ -804,10 +872,28 @@ def kmeans_streaming_fit(
         )
         return agg["sums"], agg["counts"], float(agg["cost"])
 
+    ckpt_tag = f"kmeans|{path}|n={n_total}|d={d}|k={k}|seed={seed}"
+
+    def save_ckpt(C_host, it) -> None:
+        if checkpoint_path and jax.process_index() == 0:
+            tmp = checkpoint_path + ".tmp.npz"
+            np.savez(tmp, tag=np.asarray(ckpt_tag), centers=C_host,
+                     it=np.asarray(it))
+            os.replace(tmp, checkpoint_path)
+
     C_host = np.asarray(jax.device_get(centers), np.float64)
-    n_iter = 0
+    start_it = 0
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path, allow_pickle=False) as z:
+            if str(z["tag"]) == ckpt_tag:
+                C_host = np.asarray(z["centers"], np.float64)
+                start_it = int(z["it"])
+                logger.info(
+                    f"Resuming epoch-streaming kmeans at iteration {start_it}"
+                )
+    n_iter = start_it
     cost = 0.0
-    for n_iter in range(1, max_iter + 1):
+    for n_iter in range(start_it + 1, max_iter + 1):
         sums, counts, cost = one_pass(C_host)
         new_C = np.where(
             counts[:, None] > 0,
@@ -816,10 +902,13 @@ def kmeans_streaming_fit(
         )
         shift2 = float(((new_C - C_host) ** 2).sum(axis=1).max())
         C_host = new_C
+        save_ckpt(C_host, n_iter)
         if shift2 <= tol * tol:
             break
     # final cost under the final centers
     _, _, cost = one_pass(C_host)
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        os.remove(checkpoint_path)
     logger.info(
         f"Epoch-streaming kmeans: {n_iter} Lloyd passes over {n_total} rows"
     )

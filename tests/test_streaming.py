@@ -294,3 +294,128 @@ def test_budget_triggered_epoch_streaming(tmp_path, rng):
     df = pd.DataFrame({"features": list(X), "label": y})
     m_mem = LogisticRegression(regParam=0.01).fit(df)
     np.testing.assert_allclose(m.coef_, m_mem.coef_, rtol=5e-3, atol=5e-4)
+
+
+def test_epoch_streaming_checkpoint_resume(tmp_path, rng):
+    """A CRASHED epoch-streaming solve resumes its exact trajectory from
+    the per-iteration checkpoint: kill the oracle mid-run, restart with
+    the same checkpoint path, and the final iterates match one
+    uninterrupted solve bit-for-bit (deterministic oracle)."""
+    from spark_rapids_ml_tpu.ops.lbfgs import lbfgs_minimize_host
+
+    d = 6
+    A = rng.normal(size=(200, d))
+    b = rng.normal(size=200)
+
+    def make_oracle(crash_after=None):
+        calls = {"n": 0}
+
+        def oracle(w):
+            calls["n"] += 1
+            if crash_after is not None and calls["n"] > crash_after:
+                raise RuntimeError("simulated preemption")
+            r = A @ w - b
+            return float(r @ r), 2.0 * A.T @ r
+
+        return oracle
+
+    ckpt = str(tmp_path / "state.npz")
+    kw = dict(max_iter=30, tol=1e-12, history=5)
+
+    with pytest.raises(RuntimeError, match="simulated preemption"):
+        lbfgs_minimize_host(
+            make_oracle(crash_after=5), np.zeros(d),
+            checkpoint_path=ckpt, **kw,
+        )
+    assert (tmp_path / "state.npz").exists(), "crash must leave the state"
+    w_res, it_res, _, hist_res = lbfgs_minimize_host(
+        make_oracle(), np.zeros(d), checkpoint_path=ckpt, **kw
+    )
+    w_full, it_full, _, hist_full = lbfgs_minimize_host(
+        make_oracle(), np.zeros(d), **kw
+    )
+    np.testing.assert_array_equal(w_res, w_full)
+    assert it_res == it_full and hist_res == hist_full
+    assert not (tmp_path / "state.npz").exists(), (
+        "a completed solve consumes its checkpoint"
+    )
+
+
+def test_epoch_streaming_fit_uses_checkpoint_dir(tmp_path, rng):
+    """The model layer threads streaming_checkpoint_dir through; a
+    completed fit leaves the directory clean."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = _write_parquet(tmp_path, X, y)
+    ckpt = tmp_path / "ckpts"
+    ckpt.mkdir()
+    set_config(
+        force_streaming_stats=True,
+        streaming_checkpoint_dir=str(ckpt),
+        host_batch_bytes=8192,
+    )
+    m = LogisticRegression(regParam=0.01, maxIter=20).fit(path)
+    reset_config()
+    assert m.coef_.shape == (1, 4)
+    assert not list(ckpt.glob("*.npz")), "completed fit must clean up"
+
+
+def test_prefetch_off_matches_on(tmp_path, rng):
+    """The background-prefetch reader is a pure pipelining change: results
+    must match the synchronous reader exactly."""
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(700, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = _write_parquet(tmp_path, X, y)
+    set_config(force_streaming_stats=True, host_batch_bytes=4096,
+               streaming_prefetch=True)
+    m_on = LogisticRegression(regParam=0.01, tol=1e-9).fit(path)
+    set_config(streaming_prefetch=False)
+    m_off = LogisticRegression(regParam=0.01, tol=1e-9).fit(path)
+    reset_config()
+    np.testing.assert_array_equal(m_on.coef_, m_off.coef_)
+    assert m_on.summary.objectiveHistory == m_off.summary.objectiveHistory
+
+
+def test_kmeans_streaming_checkpoint_resume(tmp_path, rng):
+    """A crashed streaming Lloyd resumes from the per-iteration center
+    checkpoint; a mismatched tag (different k) is ignored."""
+    from sklearn.datasets import make_blobs
+
+    from spark_rapids_ml_tpu.streaming import kmeans_streaming_fit
+
+    X, _ = make_blobs(n_samples=1500, n_features=5, centers=4, random_state=9)
+    X = X.astype(np.float32)
+    path = _write_parquet(tmp_path, X)
+    ckpt = str(tmp_path / "km.npz")
+    # partial run leaves a checkpoint (simulate preemption by max_iter cap
+    # + keeping the file: copy it aside before the completed-run cleanup)
+    res_a = kmeans_streaming_fit(
+        path, "features", (), None, k=4, seed=3, max_iter=2, tol=0.0,
+        chunk_rows=256, checkpoint_path=ckpt,
+    )
+    assert not (tmp_path / "km.npz").exists()  # completed fit cleans up
+    # write a synthetic mid-run checkpoint with the right tag, resume
+    import os
+
+    n_total = 1500
+    tag = f"kmeans|{path}|n={n_total}|d=5|k=4|seed=3"
+    np.savez(ckpt, tag=np.asarray(tag),
+             centers=np.asarray(res_a["centers"]), it=np.asarray(2))
+    res_b = kmeans_streaming_fit(
+        path, "features", (), None, k=4, seed=3, max_iter=30, tol=1e-6,
+        chunk_rows=256, checkpoint_path=ckpt,
+    )
+    assert res_b["n_iter"] > 2  # continued past the resumed iteration
+    assert not os.path.exists(ckpt)
+    # wrong-problem checkpoint (different k in the tag) is ignored
+    np.savez(ckpt, tag=np.asarray("kmeans|other|k=9"),
+             centers=np.zeros((4, 5)), it=np.asarray(7))
+    res_c = kmeans_streaming_fit(
+        path, "features", (), None, k=4, seed=3, max_iter=30, tol=1e-6,
+        chunk_rows=256, checkpoint_path=ckpt,
+    )
+    assert res_c["cost"] <= res_b["cost"] * 1.05

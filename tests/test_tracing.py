@@ -108,8 +108,9 @@ def test_streaming_oom_fallback(tmp_path, rng, monkeypatch):
 
 
 def test_streaming_oom_no_fallback_raises(tmp_path, rng, monkeypatch):
+    # RandomForest has no streamed fit: a staging OOM must surface clearly
     import spark_rapids_ml_tpu.streaming as streaming
-    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
 
     X = rng.normal(size=(200, 3)).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float64)
@@ -122,7 +123,30 @@ def test_streaming_oom_no_fallback_raises(tmp_path, rng, monkeypatch):
 
     monkeypatch.setattr(streaming, "stage_parquet", boom)
     with pytest.raises(RuntimeError, match="exceeds device memory"):
-        LogisticRegression().fit(path)
+        RandomForestClassifier(numTrees=2, maxDepth=3).fit(path)
+
+
+def test_streaming_oom_logreg_falls_back_to_epoch_streaming(
+    tmp_path, rng, monkeypatch
+):
+    # since round 3 LogReg CAN fit from streamed passes: an OOM while
+    # stream-staging retries as the epoch-streaming fit instead of raising
+    import spark_rapids_ml_tpu.streaming as streaming
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    path = str(tmp_path / "d.parquet")
+    df.to_parquet(path)
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+
+    monkeypatch.setattr(streaming, "stage_parquet", boom)
+    model = LogisticRegression(regParam=0.01).fit(path)
+    preds = model._transform_array(X)["prediction"]
+    assert (np.asarray(preds) == y).mean() > 0.9
 
 
 def test_transform_oom_backoff(rng, monkeypatch):

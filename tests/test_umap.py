@@ -171,3 +171,90 @@ def test_supervised_umap_regression_target_rejected(rng):
     )
     with pytest.raises(ValueError, match="target_metric"):
         est.fit(df)
+
+
+# ---------------------------------------------------------------------------
+# Metric zoo (ops/distances.py — the cuML metric list minus sparse jaccard)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "metric,kw",
+    [("manhattan", {}), ("chebyshev", {}), ("canberra", {}),
+     ("minkowski", {"p": 3}), ("hamming", {})],
+)
+def test_elementwise_knn_matches_sklearn(rng, metric, kw):
+    import jax.numpy as jnp
+    from sklearn.neighbors import NearestNeighbors as SkNN
+
+    from spark_rapids_ml_tpu.ops.distances import knn_topk_metric
+
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    if metric == "hamming":
+        X = (X > 0).astype(np.float32)
+    Q = X[:40]
+    k = 5
+    d, i = knn_topk_metric(
+        jnp.asarray(X), jnp.ones((300,), jnp.float32),
+        jnp.arange(300, dtype=jnp.int32), jnp.asarray(Q),
+        k=k, metric=metric, p=float(kw.get("p", 2.0)),
+        qblock=16, iblock=64,  # force real tiling
+    )
+    sk = SkNN(n_neighbors=k, algorithm="brute", metric=metric,
+              p=kw.get("p", 2)).fit(X)
+    want_d, _ = sk.kneighbors(Q)
+    np.testing.assert_allclose(np.asarray(d), want_d, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("metric", ["correlation", "hellinger"])
+def test_matmul_metric_preprocess(rng, metric):
+    from scipy.spatial.distance import cdist
+
+    from spark_rapids_ml_tpu.ops.distances import (
+        finalize_sqdist, preprocess_rows,
+    )
+
+    X = rng.normal(size=(50, 8)).astype(np.float64)
+    if metric == "hellinger":
+        X = np.abs(X)
+    Xp = preprocess_rows(X, metric)
+    d2 = (
+        (Xp * Xp).sum(1)[:, None] - 2 * Xp @ Xp.T + (Xp * Xp).sum(1)[None, :]
+    )
+    got = np.asarray(finalize_sqdist(np.maximum(d2, 0), metric))
+    if metric == "correlation":
+        want = cdist(X, X, metric="correlation")
+    else:
+        want = cdist(np.sqrt(X), np.sqrt(X), metric="euclidean") / np.sqrt(2)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_umap_manhattan_fit_transform(rng):
+    from sklearn.datasets import make_blobs
+
+    X, y = make_blobs(n_samples=600, n_features=8, centers=4, random_state=2)
+    X = X.astype(np.float32)
+    um = UMAP(n_neighbors=10, n_epochs=50, random_state=0, metric="manhattan")
+    model = um.fit(X)
+    emb = model._transform_array(X)[model.getOrDefault("outputCol")]
+    emb = np.asarray(emb)
+    assert emb.shape == (600, 2)
+    # blob structure survives: same-cluster points embed closer than
+    # cross-cluster on average
+    from sklearn.metrics import silhouette_score
+
+    assert silhouette_score(emb, y) > 0.3
+
+
+def test_umap_minkowski_kwds(rng):
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    um = UMAP(n_neighbors=8, n_epochs=20, random_state=0,
+              metric="minkowski", metric_kwds={"p": 3})
+    model = um.fit(X)
+    emb = model._transform_array(X[:10])[model.getOrDefault("outputCol")]
+    assert np.asarray(emb).shape == (10, 2)
+
+
+def test_umap_rejects_unknown_metric():
+    with pytest.raises(ValueError):
+        UMAP(metric="mahalanobis").fit(np.zeros((30, 3), np.float32))
