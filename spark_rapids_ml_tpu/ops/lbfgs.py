@@ -73,9 +73,6 @@ def lbfgs_minimize(
 
     value_and_grad = jax.value_and_grad(loss_fn)
 
-    def full_obj(w):
-        return loss_fn(w) + (l1 * l1_mask * jnp.abs(w)).sum()
-
     def direction(pg, S, Y, rho, k):
         def bwd(j, carry):
             q, alpha = carry
@@ -103,6 +100,9 @@ def lbfgs_minimize(
         r = jax.lax.fori_loop(0, m, fwd, r)
         return -r
 
+    def penalty(w):
+        return (l1 * l1_mask * jnp.abs(w)).sum()
+
     def body(state):
         w, f, g, S, Y, rho, k, it, _, hist = state
         pg = _pseudo_gradient(w, g, l1, l1_mask)
@@ -116,29 +116,35 @@ def lbfgs_minimize(
         # linesearch_max_iter analog).  Displacement form
         # φ(π(w+tp)) ≤ φ(w) + c₁·pg·(π(w+tp)−w) — required for OWL-QN
         # where the orthant projection changes the actual step.
+        #
+        # Data passes are the cost unit here (each loss evaluation sweeps
+        # the sharded dataset): φ(w) comes FREE from the carried smooth
+        # loss (+ the parameter-only penalty), and each trial evaluates
+        # value_and_grad once so the accepted point needs no re-evaluation
+        # — 1 fwd+bwd per accepted step instead of 3 fwd + 1 bwd.
         t0 = jnp.where(k == 0, 1.0 / jnp.maximum(jnp.linalg.norm(p), 1.0), 1.0)
-        fw_full = full_obj(w)
+        fw_full = f + penalty(w)
 
         def project(w_t):
             return jnp.where(l1 > 0, jnp.where(w_t * xi >= 0, w_t, 0.0), w_t)
 
         def ls_cond(ls_state):
-            t, w_t, f_t, j = ls_state
-            armijo = f_t <= fw_full + 1e-4 * (pg @ (w_t - w))
+            t, w_t, f_t, g_t, j = ls_state
+            armijo = f_t + penalty(w_t) <= fw_full + 1e-4 * (pg @ (w_t - w))
             return (~armijo) & (j < ls_max)
 
         def ls_body(ls_state):
-            t, _, _, j = ls_state
+            t, _, _, _, j = ls_state
             t = t * 0.5
             w_t = project(w + t * p)
-            return t, w_t, full_obj(w_t), j + 1
+            f_t, g_t = value_and_grad(w_t)
+            return t, w_t, f_t, g_t, j + 1
 
         w_1 = project(w + t0 * p)
-        t, w_new, f_new_full, _ = jax.lax.while_loop(
-            ls_cond, ls_body, (t0, w_1, full_obj(w_1), jnp.array(0, jnp.int32))
+        f_1, g_1 = value_and_grad(w_1)
+        t, w_new, f_new, g_new, _ = jax.lax.while_loop(
+            ls_cond, ls_body, (t0, w_1, f_1, g_1, jnp.array(0, jnp.int32))
         )
-
-        f_new, g_new = value_and_grad(w_new)
         s = w_new - w
         y = g_new - g
         sy = s @ y
@@ -149,8 +155,8 @@ def lbfgs_minimize(
         rho = jnp.where(update_ok, rho.at[idx].set(1.0 / jnp.maximum(sy, 1e-30)), rho)
         k = jnp.where(update_ok, k + 1, k)
 
-        new_full = f_new + (l1 * l1_mask * jnp.abs(w_new)).sum()
-        old_full = f + (l1 * l1_mask * jnp.abs(w)).sum()
+        new_full = f_new + penalty(w_new)
+        old_full = f + penalty(w)
         rel_impr = (old_full - new_full) / jnp.maximum(jnp.abs(old_full), 1e-30)
         pg_new = _pseudo_gradient(w_new, g_new, l1, l1_mask)
         gnorm = jnp.linalg.norm(pg_new)
@@ -166,7 +172,7 @@ def lbfgs_minimize(
 
     f0, g0 = value_and_grad(w0)
     hist0 = jnp.full((max_iter + 1,), jnp.nan, dtype).at[0].set(
-        f0 + (l1 * l1_mask * jnp.abs(w0)).sum()
+        f0 + penalty(w0)
     )
     state0 = (
         w0,

@@ -31,7 +31,7 @@ def test_budget_triggered_streamed_stats_pca(tmp_path, rng):
     X[:, 0] *= 5.0  # give the spectrum structure
     path = str(tmp_path / "pca.parquet")
     pd.DataFrame({"features": list(X)}).to_parquet(path)
-    # budget: n*d*4 = 51 MB; set per-device budget so need > budget
+    # dataset: n*d*4 = 19.2 MB; set per-device budget so need > budget
     set_config(hbm_bytes=1024 * 1024, host_batch_bytes=4 * 1024 * 1024)
     m_stream = PCA(k=3).setInputCol("features").setOutputCol("o").fit(path)
     reset_config()
