@@ -352,6 +352,37 @@ def main() -> None:
     # exact padding keeps rows/sec honest
     set_config(shape_bucketing=False)
 
+    # record the platform; if the TPU tunnel is down (init can hang ~25
+    # min then raise UNAVAILABLE), fall back to CPU so the bench still
+    # emits a LABELED result rather than nothing
+    import jax
+
+    try:
+        devs = jax.devices()
+        _state["extra"]["platform"] = ",".join(
+            sorted({d.platform for d in devs})
+        ) + f" x{len(devs)}"
+    except Exception as e:
+        # a loudly-failing accelerator backend (the axon tunnel raising
+        # UNAVAILABLE): drop to CPU but still emit a LABELED result
+        jax.config.update("jax_platforms", "cpu")
+        devs = jax.devices()
+        _state["extra"]["platform"] = (
+            f"cpu x{len(devs)} (TPU backend unavailable: "
+            f"{str(e)[:120]})"
+        )
+        print(f"bench: TPU unavailable, falling back to CPU: {e}",
+              file=sys.stderr, flush=True)
+    if all(d.platform == "cpu" for d in devs):
+        # jax may also fall back to CPU SILENTLY (plugin absent / quiet
+        # registration failure).  CPU can't carry the chip-sized matrix
+        # in the driver's budget: shrink whatever the caller didn't pin.
+        global N_ROWS
+        if "BENCH_ROWS" not in os.environ:
+            N_ROWS = min(N_ROWS, 200_000)
+        if "BENCH_WORKLOADS" not in os.environ:
+            WORKLOADS[:] = ["pca", "streaming"]
+
     def _on_term(signum, frame):  # a driver timeout still records progress
         _state["extra"]["terminated"] = f"signal {signum}"
         _emit()
