@@ -715,6 +715,77 @@ class LogisticRegressionModel(
             self.getOrDefault("rawPredictionCol"): raw,
         }
 
+    # -- single-sample API (pyspark Model surface).  The reference falls
+    # back to the pyspark CPU model here (classification.py:1593-1615);
+    # the coefficient math is host-resident, so compute directly. --------
+
+    def _margins(self, value) -> np.ndarray:
+        v = np.asarray(value, np.float64).reshape(-1)
+        if v.shape[0] != self.n_cols:
+            raise ValueError(
+                f"feature vector has {v.shape[0]} entries; model expects "
+                f"{self.n_cols}"
+            )
+        return self.coef_.astype(np.float64) @ v + self.intercept_.astype(
+            np.float64
+        )
+
+    def predictRaw(self, value) -> np.ndarray:
+        """Raw margin vector for one sample (Spark: [-m, m] for binomial)."""
+        m = self._margins(value)
+        if self._is_binomial():
+            return np.array([-m[0], m[0]])
+        return m
+
+    def predictProbability(self, value) -> np.ndarray:
+        m = self._margins(value)
+        if self._is_binomial():
+            p1 = 1.0 / (1.0 + np.exp(-m[0]))
+            return np.array([1.0 - p1, p1])
+        e = np.exp(m - m.max())
+        return e / e.sum()
+
+    def predict(self, value) -> float:
+        probs = self.predictProbability(value)
+        if self._is_binomial():
+            threshold = float(self.getOrDefault("threshold"))
+            return float(probs[1] > threshold)
+        return float(np.argmax(probs))
+
+    def evaluate(self, dataset) -> "LogisticRegressionSummary":
+        """Metrics of this model on `dataset` (pyspark
+        LogisticRegressionModel.evaluate; the reference delegates to the
+        pyspark CPU model — here the TPU transform + the metrics
+        subsystem compute them natively).  Goes through the standard
+        `_transform`, so featuresCol/featuresCols resolution, chunked
+        distributed inference, and the full predictions frame (original
+        columns + prediction/probability/rawPrediction) all apply."""
+        import pandas as pd
+
+        from ..data import _to_pandas
+        from ..metrics import MulticlassMetrics
+
+        pdf = dataset if isinstance(dataset, pd.DataFrame) else _to_pandas(
+            dataset
+        )
+        label_col = self.getOrDefault("labelCol")
+        if label_col not in pdf.columns:
+            raise ValueError(f"evaluate requires the label column '{label_col}'")
+        if len(pdf) == 0:
+            raise ValueError("Dataset is empty: nothing to evaluate")
+        out_df = self._transform(pdf)
+        y = np.asarray(out_df[label_col], np.float64)
+        preds = np.asarray(
+            out_df[self.getOrDefault("predictionCol")], np.float64
+        )
+        weights = None
+        if self.hasParam("weightCol") and self.isSet("weightCol"):
+            wc = self.getOrDefault("weightCol")
+            if wc in out_df.columns:
+                weights = np.asarray(out_df[wc], np.float64)
+        mm = MulticlassMetrics.from_predictions(y, preds, weights=weights)
+        return LogisticRegressionSummary(predictions=out_df, metrics=mm)
+
     def cpu(self):
         from sklearn.linear_model import LogisticRegression as SkLR
 
@@ -729,6 +800,31 @@ class LogisticRegressionModel(
             sk.classes_ = np.array(self.classes_)
         sk.n_features_in_ = self.n_cols
         return sk
+
+
+class LogisticRegressionSummary:
+    """Evaluation summary (pyspark LogisticRegressionSummary surface over
+    the metrics subsystem)."""
+
+    def __init__(self, predictions, metrics) -> None:
+        self.predictions = predictions
+        self._m = metrics
+
+    @property
+    def accuracy(self) -> float:
+        return float(self._m.accuracy)
+
+    @property
+    def weightedPrecision(self) -> float:
+        return float(self._m.weighted_precision)
+
+    @property
+    def weightedRecall(self) -> float:
+        return float(self._m.weighted_recall)
+
+    @property
+    def weightedFMeasure(self) -> float:
+        return float(self._m.weighted_f_measure())
 
 
 # ---------------------------------------------------------------------------

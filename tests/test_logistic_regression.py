@@ -261,3 +261,67 @@ def test_objective_history_l1_consistency(rng):
     h = m.summary.objectiveHistory
     assert len(h) == m.summary.totalIterations + 1
     assert abs(h[-1] - m.objective) < 1e-12
+
+
+def test_single_sample_api_and_evaluate(rng):
+    """pyspark Model surface: predict/predictRaw/predictProbability on one
+    vector + evaluate(dataset) — computed natively (the reference falls
+    back to the pyspark CPU model, classification.py:1593-1615)."""
+    import pandas as pd
+
+    X = rng.normal(size=(400, 4)).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = LogisticRegression(regParam=0.01).fit(df)
+
+    v = X[0]
+    raw = m.predictRaw(v)
+    probs = m.predictProbability(v)
+    assert raw.shape == (2,) and np.isclose(raw[0], -raw[1])
+    assert np.isclose(probs.sum(), 1.0)
+    # consistent with the batch transform
+    out = m._transform_array(X[:1])
+    np.testing.assert_allclose(
+        probs, np.asarray(out["probability"])[0], rtol=1e-5, atol=1e-6
+    )
+    assert m.predict(v) == float(np.asarray(out["prediction"])[0])
+
+    s = m.evaluate(df)
+    assert s.accuracy > 0.9
+    assert 0.0 < s.weightedPrecision <= 1.0
+    assert 0.0 < s.weightedFMeasure <= 1.0
+    assert len(s.predictions) == 400
+
+    # multinomial path
+    W = rng.normal(size=(3, 4))
+    y3 = np.argmax(X @ W.T, axis=1).astype(np.float64)
+    m3 = LogisticRegression(regParam=0.01).fit(
+        pd.DataFrame({"features": list(X), "label": y3})
+    )
+    p3 = m3.predictProbability(v)
+    assert p3.shape == (3,) and np.isclose(p3.sum(), 1.0)
+    assert m3.predict(v) == float(np.argmax(p3))
+
+
+def test_evaluate_with_features_cols_and_weights(rng):
+    """evaluate() rides the standard transform: multi-column features and
+    sample weights are honored, and the predictions frame keeps the raw
+    prediction column."""
+    import pandas as pd
+
+    X = rng.normal(size=(300, 3)).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.float64)
+    w = np.where(y > 0, 2.0, 1.0)
+    df = pd.DataFrame(
+        {"a": X[:, 0], "b": X[:, 1], "c": X[:, 2], "label": y, "w": w}
+    )
+    m = (
+        LogisticRegression(regParam=0.01)
+        .setFeaturesCol(["a", "b", "c"])
+        .setWeightCol("w")
+        .fit(df)
+    )
+    s = m.evaluate(df)
+    assert s.accuracy > 0.9
+    assert "rawPrediction" in s.predictions.columns
+    assert set("abc") <= set(s.predictions.columns)
