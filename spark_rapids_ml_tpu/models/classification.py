@@ -781,8 +781,12 @@ class LogisticRegressionModel(
         weights = None
         if self.hasParam("weightCol") and self.isSet("weightCol"):
             wc = self.getOrDefault("weightCol")
-            if wc in out_df.columns:
-                weights = np.asarray(out_df[wc], np.float64)
+            if wc not in out_df.columns:
+                raise ValueError(
+                    f"weightCol '{wc}' is set on the model but absent "
+                    "from the evaluation dataset"
+                )
+            weights = np.asarray(out_df[wc], np.float64)
         mm = MulticlassMetrics.from_predictions(y, preds, weights=weights)
         return LogisticRegressionSummary(predictions=out_df, metrics=mm)
 
@@ -822,9 +826,9 @@ class LogisticRegressionSummary:
     def weightedRecall(self) -> float:
         return float(self._m.weighted_recall)
 
-    @property
-    def weightedFMeasure(self) -> float:
-        return float(self._m.weighted_f_measure())
+    def weightedFMeasure(self, beta: float = 1.0) -> float:
+        # a METHOD, matching pyspark's LogisticRegressionSummary surface
+        return float(self._m.weighted_f_measure(beta))
 
 
 # ---------------------------------------------------------------------------
@@ -973,6 +977,26 @@ class RandomForestClassificationModel(
         converts treelite -> Spark model, utils.py:585-809; here the model
         arrays themselves are the portable format)."""
         return _NumpyForestPredictor(self, classification=True)
+
+    # single-sample API (the reference falls back to the pyspark CPU
+    # model, classification.py:606-616; the node-table forest is
+    # host-resident, so the numpy predictor answers directly)
+
+    def predictProbability(self, value) -> np.ndarray:
+        v = np.asarray(value, np.float64).reshape(1, -1)
+        if v.shape[1] != self.n_cols:
+            raise ValueError(
+                f"feature vector has {v.shape[1]} entries; model expects "
+                f"{self.n_cols}"
+            )
+        return self.cpu().predict_proba(v)[0]
+
+    def predictRaw(self, value) -> np.ndarray:
+        # rawPrediction = per-tree normalized class votes summed
+        return self.predictProbability(value) * self.numTrees
+
+    def predict(self, value) -> float:
+        return float(np.argmax(self.predictProbability(value)))
 
 
 class _NumpyForestPredictor:

@@ -303,6 +303,20 @@ class KMeansModel(KMeansClass, _TpuModel, _KMeansTpuParams):
             numIter=self.n_iter_,
         )
 
+    def predict(self, value) -> int:
+        """Nearest-center id for ONE sample (pyspark KMeansModel.predict;
+        the reference falls back to the pyspark CPU model,
+        clustering.py:551 — the centers are host-resident, so compute
+        directly)."""
+        v = np.asarray(value, np.float64).reshape(-1)
+        C = self.cluster_centers_.astype(np.float64)
+        if v.shape[0] != C.shape[1]:
+            raise ValueError(
+                f"feature vector has {v.shape[0]} entries; model expects "
+                f"{C.shape[1]}"
+            )
+        return int(np.argmin(((C - v) ** 2).sum(axis=1)))
+
     def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp
 

@@ -392,6 +392,19 @@ class LinearRegressionModel(
             totalIterations=self.n_iter_,
         )
 
+    def predict(self, value) -> float:
+        """Prediction for ONE sample (pyspark LinearRegressionModel.predict;
+        the reference falls back to the pyspark CPU model,
+        regression.py:764)."""
+        v = np.asarray(value, np.float64).reshape(-1)
+        coef = np.asarray(self.coef_, np.float64).reshape(-1)
+        if v.shape[0] != coef.shape[0]:
+            raise ValueError(
+                f"feature vector has {v.shape[0]} entries; model expects "
+                f"{coef.shape[0]}"
+            )
+        return float(coef @ v + float(self.intercept_))
+
     def _transform_device(self, Xs) -> Dict[str, Any]:
         import jax.numpy as jnp
 
@@ -486,3 +499,14 @@ class RandomForestRegressionModel(_RandomForestModel):
         from .classification import _NumpyForestPredictor
 
         return _NumpyForestPredictor(self, classification=False)
+
+    def predict(self, value) -> float:
+        """Single-sample forest mean (the reference falls back to the
+        pyspark CPU model; the node-table forest is host-resident)."""
+        v = np.asarray(value, np.float64).reshape(1, -1)
+        if v.shape[1] != self.n_cols:
+            raise ValueError(
+                f"feature vector has {v.shape[1]} entries; model expects "
+                f"{self.n_cols}"
+            )
+        return float(self.cpu().predict(v)[0])

@@ -132,3 +132,16 @@ def test_kmeans_summary_training_cost(rng):
     s = m.summary
     assert s.trainingCost == m.inertia_
     assert s.k == 3 and s.numIter == m.n_iter_
+
+
+def test_single_sample_predict(rng):
+    from sklearn.datasets import make_blobs
+
+    X, _ = make_blobs(n_samples=300, n_features=4, centers=3, random_state=1)
+    X = X.astype(np.float32)
+    m = KMeans(k=3, seed=0).fit(pd.DataFrame({"features": list(X)}))
+    batch = np.asarray(m._transform_array(X[:10])["prediction"])
+    for i in range(10):
+        assert m.predict(X[i]) == int(batch[i])
+    with pytest.raises(ValueError, match="expects"):
+        m.predict(np.zeros(7))

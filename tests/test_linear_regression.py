@@ -197,3 +197,12 @@ def test_training_summary_no_intercept_through_origin(rng):
     sse = float(((y - pred) ** 2).sum())
     r2_origin = 1.0 - sse / float((y * y).sum())
     np.testing.assert_allclose(m.summary.r2, r2_origin, rtol=1e-6)
+
+
+def test_single_sample_predict(rng):
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5, 3.0]) + 0.7).astype(np.float64)
+    m = LinearRegression().fit(pd.DataFrame({"features": list(X), "label": y}))
+    batch = np.asarray(m._transform_array(X[:5])["prediction"], np.float64)
+    for i in range(5):
+        assert np.isclose(m.predict(X[i]), batch[i], rtol=1e-4, atol=1e-4)

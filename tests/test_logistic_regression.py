@@ -289,7 +289,8 @@ def test_single_sample_api_and_evaluate(rng):
     s = m.evaluate(df)
     assert s.accuracy > 0.9
     assert 0.0 < s.weightedPrecision <= 1.0
-    assert 0.0 < s.weightedFMeasure <= 1.0
+    assert 0.0 < s.weightedFMeasure() <= 1.0
+    assert 0.0 < s.weightedFMeasure(beta=0.5) <= 1.0
     assert len(s.predictions) == 400
 
     # multinomial path

@@ -253,3 +253,25 @@ def test_capped_matches_uncapped_when_wide_enough(clf_data):
         model = est.fit((X, y))
         preds.append(np.asarray(model._transform_array(X)["prediction"]))
     assert np.array_equal(preds[0], preds[1])
+
+
+def test_single_sample_api(clf_data, reg_data):
+    # the reference answers these via pyspark CPU fallback; here the
+    # node-table forest answers directly
+    Xc, yc = clf_data
+    mc = RandomForestClassifier(numTrees=8, maxDepth=6, seed=1).fit((Xc, yc))
+    batch = mc._transform_array(Xc[:5])
+    for i in range(5):
+        p = mc.predictProbability(Xc[i])
+        np.testing.assert_allclose(
+            p, np.asarray(batch["probability"])[i], rtol=1e-5, atol=1e-6
+        )
+        assert mc.predict(Xc[i]) == float(np.asarray(batch["prediction"])[i])
+        np.testing.assert_allclose(
+            mc.predictRaw(Xc[i]), p * mc.numTrees, rtol=1e-6
+        )
+    Xr, yr = reg_data
+    mr = RandomForestRegressor(numTrees=8, maxDepth=6, seed=1).fit((Xr, yr))
+    br = np.asarray(mr._transform_array(Xr[:5])["prediction"])
+    for i in range(5):
+        assert np.isclose(mr.predict(Xr[i]), br[i], rtol=1e-4, atol=1e-4)
