@@ -842,6 +842,37 @@ class _TpuModel(Model, _TpuCaller):
         raise NotImplementedError
 
 
+def _evaluate_frame(model: "_TpuModel", dataset: DatasetLike):
+    """Shared front half of the Model.evaluate() surfaces (LogReg/LinReg):
+    coerce to pandas, validate label/weight columns, run the standard
+    `_transform`, and return `(out_df, labels, predictions, weights)`."""
+    import pandas as pd
+
+    from .data import _to_pandas
+
+    pdf = dataset if isinstance(dataset, pd.DataFrame) else _to_pandas(dataset)
+    label_col = model.getOrDefault("labelCol")
+    if label_col not in pdf.columns:
+        raise ValueError(f"evaluate requires the label column '{label_col}'")
+    if len(pdf) == 0:
+        raise ValueError("Dataset is empty: nothing to evaluate")
+    out_df = model._transform(pdf)
+    y = np.asarray(out_df[label_col], np.float64)
+    preds = np.asarray(
+        out_df[model.getOrDefault("predictionCol")], np.float64
+    )
+    weights = None
+    if model.hasParam("weightCol") and model.isSet("weightCol"):
+        wc = model.getOrDefault("weightCol")
+        if wc not in out_df.columns:
+            raise ValueError(
+                f"weightCol '{wc}' is set on the model but absent from "
+                "the evaluation dataset"
+            )
+        weights = np.asarray(out_df[wc], np.float64)
+    return out_df, y, preds, weights
+
+
 class _CombinedModel:
     """N models evaluated against one dataset staging (the analog of the
     reference's multi-model `_transform_evaluate_internal` pass with

@@ -760,33 +760,10 @@ class LogisticRegressionModel(
         `_transform`, so featuresCol/featuresCols resolution, chunked
         distributed inference, and the full predictions frame (original
         columns + prediction/probability/rawPrediction) all apply."""
-        import pandas as pd
-
-        from ..data import _to_pandas
+        from ..core import _evaluate_frame
         from ..metrics import MulticlassMetrics
 
-        pdf = dataset if isinstance(dataset, pd.DataFrame) else _to_pandas(
-            dataset
-        )
-        label_col = self.getOrDefault("labelCol")
-        if label_col not in pdf.columns:
-            raise ValueError(f"evaluate requires the label column '{label_col}'")
-        if len(pdf) == 0:
-            raise ValueError("Dataset is empty: nothing to evaluate")
-        out_df = self._transform(pdf)
-        y = np.asarray(out_df[label_col], np.float64)
-        preds = np.asarray(
-            out_df[self.getOrDefault("predictionCol")], np.float64
-        )
-        weights = None
-        if self.hasParam("weightCol") and self.isSet("weightCol"):
-            wc = self.getOrDefault("weightCol")
-            if wc not in out_df.columns:
-                raise ValueError(
-                    f"weightCol '{wc}' is set on the model but absent "
-                    "from the evaluation dataset"
-                )
-            weights = np.asarray(out_df[wc], np.float64)
+        out_df, y, preds, weights = _evaluate_frame(self, dataset)
         mm = MulticlassMetrics.from_predictions(y, preds, weights=weights)
         return LogisticRegressionSummary(predictions=out_df, metrics=mm)
 

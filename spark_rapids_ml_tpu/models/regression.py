@@ -348,6 +348,38 @@ class LinearRegressionTrainingSummary:
         self.totalIterations = int(totalIterations)
 
 
+class LinearRegressionSummary:
+    """Evaluation summary on a given dataset (pyspark
+    LinearRegressionSummary surface over the metrics subsystem)."""
+
+    def __init__(self, predictions, metrics, fit_intercept: bool = True) -> None:
+        self.predictions = predictions
+        self._m = metrics
+        self._fit_intercept = bool(fit_intercept)
+
+    @property
+    def rootMeanSquaredError(self) -> float:
+        return float(self._m.root_mean_squared_error)
+
+    @property
+    def meanSquaredError(self) -> float:
+        return float(self._m.mean_squared_error)
+
+    @property
+    def meanAbsoluteError(self) -> float:
+        return float(self._m.mean_absolute_error)
+
+    @property
+    def r2(self) -> float:
+        # Spark passes throughOrigin=!fitIntercept (RegressionMetrics),
+        # matching the training summary's through-origin SStot
+        return float(self._m.r2(through_origin=not self._fit_intercept))
+
+    @property
+    def explainedVariance(self) -> float:
+        return float(self._m.explained_variance)
+
+
 class LinearRegressionModel(
     LinearRegressionClass, _TpuModel, _LinearRegressionTpuParams
 ):
@@ -390,6 +422,24 @@ class LinearRegressionModel(
             meanSquaredError=self.mse_,
             r2=self.r2_,
             totalIterations=self.n_iter_,
+        )
+
+    def evaluate(self, dataset) -> "LinearRegressionSummary":
+        """Metrics of this model on `dataset` (pyspark
+        LinearRegressionModel.evaluate; the reference delegates to the
+        pyspark CPU model, regression.py:770 — here the TPU transform +
+        the metrics subsystem compute them natively)."""
+        from ..core import _evaluate_frame
+        from ..metrics import RegressionMetrics
+
+        out_df, y, preds, weights = _evaluate_frame(self, dataset)
+        # the SPARK param is what _copyValues propagates onto the model
+        # (the backend _tpu_params dict stays at defaults here)
+        fit_intercept = bool(self.getOrDefault("fitIntercept"))
+        return LinearRegressionSummary(
+            predictions=out_df,
+            metrics=RegressionMetrics.from_predictions(y, preds, weights),
+            fit_intercept=fit_intercept,
         )
 
     def predict(self, value) -> float:

@@ -206,3 +206,32 @@ def test_single_sample_predict(rng):
     batch = np.asarray(m._transform_array(X[:5])["prediction"], np.float64)
     for i in range(5):
         assert np.isclose(m.predict(X[i]), batch[i], rtol=1e-4, atol=1e-4)
+
+
+def test_evaluate_on_dataset(rng):
+    """evaluate(dataset) computes metrics natively (the reference falls
+    back to the pyspark CPU model, regression.py:770)."""
+    X = rng.normal(size=(300, 3)).astype(np.float32)
+    y = (X @ np.array([2.0, -1.0, 0.5]) + 1.0
+         + 0.1 * rng.normal(size=300)).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = LinearRegression().fit(df)
+    s = m.evaluate(df)
+    # matches the training summary computed from sufficient statistics
+    assert abs(s.rootMeanSquaredError - m.summary.rootMeanSquaredError) < 1e-3
+    assert abs(s.r2 - m.summary.r2) < 1e-3
+    assert 0.0 <= s.meanAbsoluteError < 0.2
+    assert s.explainedVariance > 0
+    assert "prediction" in s.predictions.columns
+
+
+def test_evaluate_r2_through_origin(rng):
+    """fitIntercept=False evaluates r2 through the origin (Spark's
+    throughOrigin=!fitIntercept), matching the training summary even with
+    a large label offset."""
+    X = rng.normal(size=(300, 2)).astype(np.float32)
+    y = (X @ np.array([1.5, -0.5]) + 10.0).astype(np.float64)  # big offset
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = LinearRegression(fitIntercept=False).fit(df)
+    s = m.evaluate(df)
+    assert abs(s.r2 - m.summary.r2) < 1e-3, (s.r2, m.summary.r2)
