@@ -843,9 +843,10 @@ class _TpuModel(Model, _TpuCaller):
 
 
 def _evaluate_frame(model: "_TpuModel", dataset: DatasetLike):
-    """Shared front half of the Model.evaluate() surfaces (LogReg/LinReg):
-    coerce to pandas, validate label/weight columns, run the standard
-    `_transform`, and return `(out_df, labels, predictions, weights)`."""
+    """Shared front half of the Model.evaluate() surfaces (LogReg, LinReg,
+    RandomForestClassifier): coerce to pandas, validate label/weight
+    columns, run the standard `_transform`, and return
+    `(out_df, labels, predictions, weights)`."""
     import pandas as pd
 
     from .data import _to_pandas

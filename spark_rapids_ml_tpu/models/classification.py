@@ -760,12 +760,7 @@ class LogisticRegressionModel(
         `_transform`, so featuresCol/featuresCols resolution, chunked
         distributed inference, and the full predictions frame (original
         columns + prediction/probability/rawPrediction) all apply."""
-        from ..core import _evaluate_frame
-        from ..metrics import MulticlassMetrics
-
-        out_df, y, preds, weights = _evaluate_frame(self, dataset)
-        mm = MulticlassMetrics.from_predictions(y, preds, weights=weights)
-        return LogisticRegressionSummary(predictions=out_df, metrics=mm)
+        return _evaluate_classification(self, dataset, LogisticRegressionSummary)
 
     def cpu(self):
         from sklearn.linear_model import LogisticRegression as SkLR
@@ -783,9 +778,9 @@ class LogisticRegressionModel(
         return sk
 
 
-class LogisticRegressionSummary:
-    """Evaluation summary (pyspark LogisticRegressionSummary surface over
-    the metrics subsystem)."""
+class _ClassificationSummary:
+    """Shared evaluation summary (the pyspark classification summary
+    surface over the metrics subsystem)."""
 
     def __init__(self, predictions, metrics) -> None:
         self.predictions = predictions
@@ -804,8 +799,29 @@ class LogisticRegressionSummary:
         return float(self._m.weighted_recall)
 
     def weightedFMeasure(self, beta: float = 1.0) -> float:
-        # a METHOD, matching pyspark's LogisticRegressionSummary surface
+        # a METHOD, matching pyspark's summary surface
         return float(self._m.weighted_f_measure(beta))
+
+
+class LogisticRegressionSummary(_ClassificationSummary):
+    pass
+
+
+class RandomForestClassificationSummary(_ClassificationSummary):
+    pass
+
+
+def _evaluate_classification(model, dataset, summary_cls):
+    """Shared evaluate() tail for the classification models: the standard
+    transform front half + multiclass metrics -> summary."""
+    from ..core import _evaluate_frame
+    from ..metrics import MulticlassMetrics
+
+    out_df, y, preds, weights = _evaluate_frame(model, dataset)
+    return summary_cls(
+        predictions=out_df,
+        metrics=MulticlassMetrics.from_predictions(y, preds, weights=weights),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -974,6 +990,14 @@ class RandomForestClassificationModel(
 
     def predict(self, value) -> float:
         return float(np.argmax(self.predictProbability(value)))
+
+    def evaluate(self, dataset) -> "RandomForestClassificationSummary":
+        """Metrics of this model on `dataset` (pyspark
+        RandomForestClassificationModel.evaluate; absent from the
+        reference entirely)."""
+        return _evaluate_classification(
+            self, dataset, RandomForestClassificationSummary
+        )
 
 
 class _NumpyForestPredictor:

@@ -275,3 +275,13 @@ def test_single_sample_api(clf_data, reg_data):
     br = np.asarray(mr._transform_array(Xr[:5])["prediction"])
     for i in range(5):
         assert np.isclose(mr.predict(Xr[i]), br[i], rtol=1e-4, atol=1e-4)
+
+
+def test_evaluate_on_dataset(clf_data):
+    X, y = clf_data
+    df = pd.DataFrame({"features": list(X), "label": y})
+    m = RandomForestClassifier(numTrees=8, maxDepth=6, seed=2).fit(df)
+    s = m.evaluate(df)
+    assert s.accuracy > 0.85
+    assert 0.0 < s.weightedFMeasure() <= 1.0
+    assert "rawPrediction" in s.predictions.columns
