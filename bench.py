@@ -45,7 +45,7 @@ CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 100_000))
 WORKLOADS = [
     w.strip()
     for w in os.environ.get(
-        "BENCH_WORKLOADS", "logreg,pca,kmeans,rf,ann,umap,streaming"
+        "BENCH_WORKLOADS", "logreg,pca,kmeans,rf,ann,knn,umap,streaming"
     ).split(",")
 ]
 
@@ -257,6 +257,51 @@ def bench_ann(extra: dict):
     extra["ann_cagra_recall_at_10"] = round(hits / want.size, 4)
 
 
+def bench_knn(extra: dict):
+    """Exact brute-force kNN: the fused Pallas distance+top-k kernel
+    (ops/pallas_knn.py) vs the XLA materialize-then-top_k path on the same
+    data — the HBM-traffic experiment (the intermediate (q, n) distance
+    tile is the dominant traffic XLA can't fuse away)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_blocked
+    from spark_rapids_ml_tpu.ops.pallas_knn import knn_topk_fused
+
+    extra["knn_intended_config"] = (
+        "BASELINE: exact kNN over cluster-sharded items (ring); run: "
+        "100kx64 items, 10k queries, k=32 single-chip brute force"
+    )
+    n, d, q, k = 100_000, 64, 10_000, 32
+    X = jnp.asarray(_rng(8).standard_normal((n, d)).astype("float32"))
+    Q = X[:q]
+    valid = jnp.ones((n,), jnp.float32)
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def timed(fn):
+        # block on BOTH outputs: the fused path's id-gather runs outside
+        # its jit and must be timed like the XLA path's in-jit gather
+        jax.block_until_ready(fn(X, valid, ids, Q, k=k))  # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(X, valid, ids, Q, k=k))
+        return time.perf_counter() - t0
+
+    el_xla = timed(knn_topk_blocked)
+    extra["knn_100kx64_xla_qps"] = round(q / el_xla, 1)
+    if jax.default_backend() != "tpu":
+        # knn_topk_fused would run the Pallas INTERPRETER off-TPU — not a
+        # hang exactly, but hours at this size; the comparison only means
+        # anything on the chip anyway
+        extra["knn_pallas_skipped"] = "non-TPU backend (interpret mode)"
+        return
+    try:
+        el_pl = timed(knn_topk_fused)
+        extra["knn_100kx64_pallas_qps"] = round(q / el_pl, 1)
+        extra["knn_pallas_speedup"] = round(el_xla / el_pl, 2)
+    except Exception as e:
+        extra["knn_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
+
+
 def bench_streaming(extra: dict):
     """Beyond-HBM epoch-streaming LogReg: parquet re-streamed per L-BFGS
     evaluation (the reachability path for BASELINE's 1B x 256 north star;
@@ -396,6 +441,7 @@ def main() -> None:
         "kmeans": bench_kmeans,
         "rf": bench_rf,
         "ann": bench_ann,
+        "knn": bench_knn,
         "umap": bench_umap,
         "streaming": bench_streaming,
     }

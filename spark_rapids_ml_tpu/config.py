@@ -80,6 +80,12 @@ _DEFAULTS: Dict[str, Any] = {
     # write their full optimizer state here after every iteration and
     # RESUME the identical trajectory after a preemption/crash.
     "streaming_checkpoint_dir": "",
+    # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
+    # fusedL2Knn analog, ops/pallas_knn.py): "auto" uses it on real TPU
+    # backends, "on" forces it everywhere (CPU runs the Pallas
+    # interpreter — slow, for tests), "off" keeps the XLA
+    # materialize-then-top_k kernels.
+    "pallas_knn": "auto",
     # Exact-kNN item sets up to this many bytes replicate on every host
     # (simple model contract); above it, multi-process fits keep feature
     # rows process-local and only the global id vector replicates (the

@@ -373,7 +373,7 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         """Distributed ring brute force; (metric distances, positional
         indices) trimmed of padding."""
-        from ..ops.knn import knn_ring_topk, knn_topk_blocked
+        from ..ops.knn import knn_ring_topk, knn_topk_single
         from ..parallel import TpuContext
         from ..parallel.mesh import RowStager
 
@@ -387,7 +387,7 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
         queries = qst.stage(np.asarray(Q), dtype)
         if mesh.devices.size == 1:
-            d2, idx = knn_topk_blocked(items, valid, ids, queries, k=k)
+            d2, idx = knn_topk_single(items, valid, ids, queries, k=k)
         else:
             d2, idx = knn_ring_topk(items, valid, ids, queries, k=k, mesh=mesh)
         return self._apply_metric(qst.fetch(d2)), qst.fetch(idx)

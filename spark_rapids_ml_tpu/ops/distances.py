@@ -181,7 +181,7 @@ def umap_knn_graph(
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import DATA_AXIS
-    from .knn import knn_ring_topk, knn_topk_blocked
+    from .knn import knn_ring_topk, knn_topk_single
 
     if metric_kind(metric) == "matmul":
         if mesh is not None and mesh.devices.size > 1:
@@ -189,7 +189,7 @@ def umap_knn_graph(
                 X_items, item_valid, item_ids, queries, k=k, mesh=mesh
             )
         else:
-            d2, ids = knn_topk_blocked(
+            d2, ids = knn_topk_single(
                 X_items, item_valid, item_ids, queries, k=k
             )
         return finalize_sqdist(d2, metric), ids

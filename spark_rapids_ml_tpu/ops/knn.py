@@ -108,6 +108,29 @@ def knn_topk_local(items, item_valid, item_ids, queries, k: int):
     return -neg_d, jnp.take(item_ids, pos)
 
 
+def knn_topk_single(items, item_valid, item_ids, queries, k: int):
+    """Single-device brute force with automatic kernel dispatch: the fused
+    Pallas distance+top-k kernel (ops/pallas_knn.py) when the `pallas_knn`
+    config enables it for this backend/shape/dtype, else the XLA blocked
+    kernel.  One owner for the enable check — model/_search and
+    umap_knn_graph both route through here."""
+    from .pallas_knn import knn_topk_fused, pallas_knn_enabled
+
+    if pallas_knn_enabled(int(queries.shape[1]), queries.dtype):
+        try:
+            return knn_topk_fused(items, item_valid, item_ids, queries, k=k)
+        except Exception as e:  # Mosaic lowering/compile failure at an
+            # untested shape must degrade to the XLA kernel, not kill the
+            # fit — the kernels are exact-equivalent
+            import logging
+
+            logging.getLogger("spark_rapids_ml_tpu").warning(
+                f"fused Pallas kNN kernel failed ({type(e).__name__}: "
+                f"{str(e)[:200]}); falling back to the XLA blocked kernel"
+            )
+    return knn_topk_blocked(items, item_valid, item_ids, queries, k=k)
+
+
 @partial(jax.jit, static_argnames=("k", "block"))
 def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
                      block: int = 1024):

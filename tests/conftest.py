@@ -14,16 +14,23 @@ import sys
 # platform: tests validate the SPMD sharding path on an 8-device virtual
 # mesh, not single-chip numerics.  A sitecustomize may have already
 # *imported* jax, so set both the env and the live config.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+#
+# SRML_TEST_PLATFORM=tpu opts out of the CPU pin and runs the suite against
+# the ambient accelerator (single chip): the hardware-evidence pass.  Mesh
+# sizes > the real device count are skipped by the num_workers fixture.
+_platform = os.environ.get("SRML_TEST_PLATFORM", "cpu")
+if _platform == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if _platform == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -34,6 +41,11 @@ import pytest  # noqa: E402
 @pytest.fixture(params=[1, 2, 4])
 def num_workers(request):
     """Mesh sizes exercised per test (reference `gpu_number` fixture)."""
+    if request.param > jax.device_count():
+        pytest.skip(
+            f"mesh size {request.param} exceeds the {jax.device_count()} "
+            "real device(s) (SRML_TEST_PLATFORM != cpu)"
+        )
     return request.param
 
 

@@ -1,0 +1,153 @@
+#
+# Fused Pallas distance+top-k kernel (ops/pallas_knn.py) — exactness vs the
+# XLA materialize-then-top_k kernels, tail/padding semantics, and the
+# config-flag dispatch.  On the CPU test mesh the kernel runs in Pallas
+# interpret mode; on a real TPU the same tests exercise the compiled path.
+#
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_ml_tpu.config import reset_config, set_config
+from spark_rapids_ml_tpu.ops.knn import knn_topk_blocked
+from spark_rapids_ml_tpu.ops.pallas_knn import (
+    fused_topk_sqdist,
+    knn_topk_fused,
+    pallas_knn_enabled,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    reset_config()
+    yield
+    reset_config()
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@pytest.mark.parametrize("n,d,q,k", [(700, 24, 130, 7), (64, 8, 64, 5),
+                                     (1500, 40, 33, 20)])
+def test_fused_matches_xla(n, d, q, k):
+    rng = np.random.default_rng(n + q)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Q = rng.normal(size=(q, d)).astype(np.float32)
+    valid = np.ones(n, np.float32)
+    valid[-max(1, n // 16):] = 0.0
+    ids = np.arange(n, dtype=np.int32)
+    d2p, ip = fused_topk_sqdist(
+        jnp.asarray(X), jnp.asarray(valid), jnp.asarray(Q), k,
+        bq=64, bn=128, interpret=_interpret(),
+    )
+    d2r, ir = knn_topk_blocked(
+        jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+        jnp.asarray(Q), k=k,
+    )
+    np.testing.assert_allclose(np.asarray(d2p), np.asarray(d2r), atol=1e-4)
+    # identical neighbor sets; order can swap only between exact ties
+    assert (np.asarray(ip) == np.asarray(ir)).mean() > 0.999
+
+
+def test_fused_tail_when_k_exceeds_valid():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 6)).astype(np.float32)
+    Q = rng.normal(size=(10, 6)).astype(np.float32)
+    valid = np.zeros(300, np.float32)
+    valid[:4] = 1.0
+    d2, idx = fused_topk_sqdist(
+        jnp.asarray(X), jnp.asarray(valid), jnp.asarray(Q), 7,
+        bq=8, bn=128, interpret=_interpret(),
+    )
+    idx = np.asarray(idx)
+    d2 = np.asarray(d2)
+    assert set(idx[0, :4]) == {0, 1, 2, 3}
+    assert (idx[:, 4:] == -1).all()
+    assert np.isinf(d2[:, 4:]).all()
+    assert np.isfinite(d2[:, :4]).all()
+
+
+def test_fused_global_id_mapping():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 12)).astype(np.float32)
+    Q = X[:15]  # self-queries: nearest id must be the row's own global id
+    valid = np.ones(200, np.float32)
+    gids = (np.arange(200, dtype=np.int32) * 3 + 100)  # non-contiguous
+    d2, ids = knn_topk_fused(
+        jnp.asarray(X), jnp.asarray(valid), jnp.asarray(gids),
+        jnp.asarray(Q), k=3,
+    )
+    assert (np.asarray(ids)[:, 0] == gids[:15]).all()
+    np.testing.assert_allclose(np.asarray(d2)[:, 0], 0.0, atol=1e-4)
+
+
+def test_dispatch_flag():
+    # default "auto": only on real TPU backends
+    assert pallas_knn_enabled(64) == (jax.default_backend() == "tpu")
+    set_config(pallas_knn="on")
+    assert pallas_knn_enabled(64)
+    assert not pallas_knn_enabled(8192)  # VMEM guard regardless of mode
+    # f64 inputs (float32_inputs=False) must keep the XLA path: the fused
+    # kernel computes in f32 and would silently change results
+    assert pallas_knn_enabled(64, np.float32)
+    assert not pallas_knn_enabled(64, np.float64)
+    set_config(pallas_knn="off")
+    assert not pallas_knn_enabled(64)
+
+
+def test_exact_knn_end_to_end_parity():
+    """NearestNeighbors results are identical with the fused kernel forced
+    on (interpret mode on CPU) and forced off."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 16)).astype(np.float32)
+    Q = rng.normal(size=(25, 16)).astype(np.float32)
+    item_df = pd.DataFrame({"features": list(X), "id": np.arange(400)})
+    qdf = pd.DataFrame({"features": list(Q),
+                        "id": np.arange(25) + 1000})
+
+    outs = {}
+    for mode in ("off", "on"):
+        set_config(pallas_knn=mode)
+        m = NearestNeighbors(k=5, num_workers=1).setIdCol("id").fit(item_df)
+        _, _, knn_df = m.kneighbors(qdf)
+        outs[mode] = knn_df
+    a, b = outs["off"], outs["on"]
+    ia = np.stack([np.asarray(r) for r in a["indices"]])
+    ib = np.stack([np.asarray(r) for r in b["indices"]])
+    # near-ties at the k boundary may legitimately swap between the two
+    # kernels' rounding (compiled MXU vs one-fusion XLA); sets must agree
+    assert (ia == ib).mean() > 0.99
+    assert all(set(ra) == set(rb) for ra, rb in zip(ia, ib))
+    da = np.stack([np.asarray(r) for r in a["distances"]])
+    db = np.stack([np.asarray(r) for r in b["distances"]])
+    np.testing.assert_allclose(da, db, atol=1e-3)
+
+
+def test_umap_graph_dispatch_parity():
+    """umap_knn_graph (the UMAP fit/transform kNN) routes through the fused
+    kernel when enabled and returns identical graphs."""
+    from spark_rapids_ml_tpu.ops.distances import umap_knn_graph
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(350, 10)).astype(np.float32)
+    valid = np.ones(350, np.float32)
+    ids = np.arange(350, dtype=np.int32)
+    outs = {}
+    for mode in ("off", "on"):
+        set_config(pallas_knn=mode)
+        d, i = umap_knn_graph(
+            jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+            jnp.asarray(X), k=8, metric="euclidean",
+        )
+        outs[mode] = (np.asarray(d), np.asarray(i))
+    # sqrt amplifies the f32 cancellation noise of ~0 self-distances to
+    # ~2e-3 (and the two kernels associate the identity differently there)
+    np.testing.assert_allclose(outs["off"][0], outs["on"][0], atol=5e-3)
+    assert (outs["off"][1] == outs["on"][1]).mean() > 0.999
