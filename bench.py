@@ -436,14 +436,17 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_term)
 
     extra = _state["extra"]
+    # rf runs LAST: a failed TPU remote-compile of the deep-forest program
+    # has been observed to crash the TPU worker process, and every workload
+    # after it in this dict then fails UNAVAILABLE (BENCH r03, 2026-07-31)
     benches = {
         "pca": bench_pca,
         "kmeans": bench_kmeans,
-        "rf": bench_rf,
         "ann": bench_ann,
         "knn": bench_knn,
         "umap": bench_umap,
         "streaming": bench_streaming,
+        "rf": bench_rf,
     }
     # logreg is the headline and ALWAYS runs (the driver needs the metric
     # line); a failure is still recorded as a JSON line rather than a crash

@@ -81,11 +81,16 @@ _DEFAULTS: Dict[str, Any] = {
     # RESUME the identical trajectory after a preemption/crash.
     "streaming_checkpoint_dir": "",
     # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
-    # fusedL2Knn analog, ops/pallas_knn.py): "auto" uses it on real TPU
+    # fusedL2Knn analog, ops/pallas_knn.py): "off" (default) keeps the XLA
+    # materialize-then-top_k kernels, "auto" enables it on real TPU
     # backends, "on" forces it everywhere (CPU runs the Pallas
-    # interpreter — slow, for tests), "off" keeps the XLA
-    # materialize-then-top_k kernels.
-    "pallas_knn": "auto",
+    # interpreter — slow, for tests).  Default is "off" on measurement:
+    # on a v5e chip at 100k items x 10k queries x k=32 the VPU selection
+    # loop runs 3.5x slower than XLA's matmul+top_k pipeline (the
+    # hypothesis that the (q, n) HBM round-trip dominates was wrong —
+    # XLA's top-k sort is the actual bottleneck, and it beats a k-round
+    # VPU sweep).  BENCH_r03 records both numbers.
+    "pallas_knn": "off",
     # Exact-kNN item sets up to this many bytes replicate on every host
     # (simple model contract); above it, multi-process fits keep feature
     # rows process-local and only the global id vector replicates (the

@@ -482,10 +482,15 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 * len(jax.devices())
             )
             if need > budget or get_config("force_streaming_stats"):
+                why = (
+                    f"~{need/2**30:.1f} GiB exceeds the device budget "
+                    f"({budget/2**30:.1f} GiB)"
+                    if need > budget
+                    else "force_streaming_stats is set"
+                )
                 self.logger.info(
-                    f"Dataset ~{need/2**30:.1f} GiB exceeds the device "
-                    f"budget ({budget/2**30:.1f} GiB); fitting from "
-                    f"multi-pass streamed statistics."
+                    f"Dataset {why}; fitting from multi-pass streamed "
+                    "statistics."
                 )
                 return self._fit_streaming(path)
         ds_dev = fit_input = None

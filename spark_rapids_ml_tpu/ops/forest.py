@@ -166,10 +166,19 @@ def _grow_one_tree(
     # frontier slot of each sample; A_l (the level width) means inactive
     slot = jnp.where(w > 0, 0, 1).astype(jnp.int32)
     frontier = jnp.zeros((1,), jnp.int32)  # table ids of active nodes
-    base = 1  # next unallocated table id
+    base = jnp.int32(1)  # next unallocated table id
 
-    for level in range(max_depth):
-        A_l = min(2**level, max_active)
+    # Program-size structure: levels where the frontier is still widening
+    # (A_l < max_active) have level-specific shapes and unroll; once the
+    # frontier saturates at max_active every remaining level has IDENTICAL
+    # shapes, so all of them but the last share ONE lax.fori_loop body —
+    # compiled program size is O(log2(max_active)), independent of
+    # max_depth.  (The fully-unrolled deep build overwhelmed the TPU
+    # compile helper at depth 16, BENCH r03.)  `level` may be traced (the
+    # fori index): it only feeds fold_in.
+    def level_step(level, A_l, state, last):
+        (feature, threshold, gain_arr, count_arr, left_arr,
+         node, slot, frontier, base) = state
         active = slot < A_l
         slot_c = jnp.clip(slot, 0, A_l - 1)
 
@@ -237,7 +246,7 @@ def _grow_one_tree(
         child_node = left_ids[slot_c] + jnp.where(go_left, 0, 1)
         node = jnp.where(splits, child_node, node)
 
-        if level + 1 < max_depth:
+        if not last:
             # next frontier: the up-to-A_next largest children (weighted
             # count) that could still split; the rest rest as leaves
             A_next = min(2 * A_l, max_active)
@@ -262,7 +271,32 @@ def _grow_one_tree(
             )
             cand_of_sample = 2 * slot_c + jnp.where(go_left, 0, 1)
             slot = jnp.where(splits, inv[cand_of_sample], A_next)
-        base += 2 * A_l
+        base = base + 2 * A_l
+        return (feature, threshold, gain_arr, count_arr, left_arr,
+                node, slot, frontier, base)
+
+    state = (feature, threshold, gain_arr, count_arr, left_arr,
+             node, slot, frontier, base)
+    # first level whose frontier width reaches max_active
+    sat = 0
+    while (1 << sat) < max_active and sat < max_depth:
+        sat += 1
+    for lv in range(min(sat, max_depth)):
+        state = level_step(
+            lv, min(1 << lv, max_active), state, last=(lv == max_depth - 1)
+        )
+    if sat < max_depth:
+        if max_depth - 1 > sat:
+            state = jax.lax.fori_loop(
+                sat,
+                max_depth - 1,
+                lambda lv, st: level_step(lv, max_active, st, last=False),
+                state,
+            )
+        # final level: no next-frontier bookkeeping (nothing grows past it)
+        state = level_step(max_depth - 1, max_active, state, last=True)
+    (feature, threshold, gain_arr, count_arr, left_arr,
+     node, slot, frontier, base) = state
 
     leaf_stats = jnp.zeros((n_nodes + 1, S), stats.dtype).at[node].add(wstats)
     return TreeArrays(

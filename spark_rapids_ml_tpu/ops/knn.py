@@ -122,9 +122,9 @@ def knn_topk_single(items, item_valid, item_ids, queries, k: int):
         except Exception as e:  # Mosaic lowering/compile failure at an
             # untested shape must degrade to the XLA kernel, not kill the
             # fit — the kernels are exact-equivalent
-            import logging
+            from ..utils import get_logger
 
-            logging.getLogger("spark_rapids_ml_tpu").warning(
+            get_logger("knn").warning(
                 f"fused Pallas kNN kernel failed ({type(e).__name__}: "
                 f"{str(e)[:200]}); falling back to the XLA blocked kernel"
             )
