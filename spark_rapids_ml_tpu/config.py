@@ -91,6 +91,12 @@ _DEFAULTS: Dict[str, Any] = {
     # XLA's top-k sort is the actual bottleneck, and it beats a k-round
     # VPU sweep).  BENCH_r03 records both numbers.
     "pallas_knn": "off",
+    # MXU matmul precision for rank/threshold-critical distance kernels
+    # (kNN/ANN/DBSCAN; ops/precision.py).  "highest" = exact f32 (cuML
+    # parity; TPU default bf16 passes mis-rank near-tied neighbors —
+    # measured CAGRA recall 0.996 -> 0.58), "high" = 3-pass bf16,
+    # "default" = fastest.  Read at trace time.
+    "distance_precision": "highest",
     # Exact-kNN item sets up to this many bytes replicate on every host
     # (simple model contract); above it, multi-process fits keep feature
     # rows process-local and only the global id vector replicates (the

@@ -478,6 +478,8 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
       - refine_ratio: ivfpq exact re-rank multiplier (default 2)
       - graph_degree / nn_descent_niter: cagra graph degree (default 32)
         and NN-descent build rounds (default 8)
+      - nn_descent_sample: cagra local-join width per round (default
+        graph_degree; pass 2*graph_degree for the exhaustive join)
       - itopk_size / max_iterations: cagra search beam width (default 64)
         and traversal iterations (default 12) — cuVS search param names
 
@@ -542,8 +544,13 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
             deg = int(ap.get("graph_degree", 32))
             deg = max(1, min(deg, n - 1))
             rounds = int(ap.get("nn_descent_niter", 8))
+            sample = ap.get("nn_descent_sample")
             graph = build_cagra_graph(
-                jnp.asarray(X), seed=0, deg=deg, rounds=max(rounds, 1)
+                jnp.asarray(X),
+                seed=0,
+                deg=deg,
+                rounds=max(rounds, 1),
+                sample=None if sample is None else int(sample),
             )
             attrs.update(cagra_graph=np.asarray(graph))
         elif algo == "ivfflat":
@@ -610,9 +617,10 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         return self._device_index[1]
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
-        from ..ops import ivf as ivf_ops
+        """Chunked search: bounds the per-dispatch candidate working set
+        (IVF gathers nprobe·bucket·d floats per query, CAGRA beam·deg·d —
+        at 10k+ queries one dispatch would materialize tens of GB)."""
         from ..parallel import TpuContext
-        from ..parallel.mesh import RowStager
 
         n_items = int(self.item_features.shape[0])
         if k > n_items:
@@ -621,13 +629,55 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
             raise ValueError(
                 f"k={k} exceeds the number of indexed items ({n_items})"
             )
-        with TpuContext(self.num_workers) as ctx:
-            mesh = ctx.mesh
         Q = np.ascontiguousarray(Q, dtype=np.float32)
         if self._metric() == "cosine":
+            # normalize once for all chunks (index is built on unit vectors)
             Q = Q / np.maximum(
                 np.linalg.norm(Q, axis=1, keepdims=True), 1e-12
             ).astype(np.float32)
+        with TpuContext(self.num_workers) as ctx:
+            mesh = ctx.mesh
+        nq = int(Q.shape[0])
+        per_q = self._per_query_candidate_bytes(k)
+        from ..config import get_config
+
+        budget = int(get_config("hbm_bytes")) // 8
+        chunk = max(64, min(nq, budget // max(per_q, 1)))
+        if nq <= chunk:
+            return self._search_chunk(Q, k, mesh)
+        outs = [
+            self._search_chunk(Q[lo : lo + chunk], k, mesh)
+            for lo in range(0, nq, chunk)
+        ]
+        return (
+            np.concatenate([d for d, _ in outs]),
+            np.concatenate([p for _, p in outs]),
+        )
+
+    def _per_query_candidate_bytes(self, k: int) -> int:
+        ap = dict(self._tpu_params.get("algo_params") or {})
+        d = int(self.n_cols)
+        if self.algorithm_ == "cagra":
+            deg = int(self._attrs["cagra_graph"].shape[1])
+            beam = max(int(ap.get("itopk_size", 64)), k)
+            width = beam * (1 + deg) + deg
+        elif self.algorithm_ == "ivfflat":
+            mb = int(self._attrs["ivf_buckets"].shape[1])
+            width = max(1, min(int(ap.get("nprobe", 20)), self.nlist_)) * mb
+        else:  # ivfpq: LUTs + codes dominate; refine gathers run host-side
+            mb = int(self._attrs["pq_codes"].shape[1])
+            M = int(self._attrs.get("pq_M", 8))
+            width = max(1, min(int(ap.get("nprobe", 20)), self.nlist_)) * mb
+            return width * (M + 8) * 4
+        # distances + gathered vectors + dedup/sort keys, ~2x slack
+        return width * (d + 4) * 4 * 2
+
+    def _search_chunk(
+        self, Q: np.ndarray, k: int, mesh
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        from ..ops import ivf as ivf_ops
+        from ..parallel.mesh import RowStager
+
         qst = RowStager.for_replicated(Q.shape[0], mesh)
         Qs = qst.stage(Q, np.float32)
         ap = dict(self._tpu_params.get("algo_params") or {})

@@ -6,11 +6,20 @@
 # fixed-shape XLA programs:
 #
 #   - Build (`build_cagra_graph`): NN-descent rounds.  Every round expands
-#     each node's candidate set to {current neighbors} U {neighbors of
-#     neighbors} U {random draws}, scores all candidates with one batched
-#     gather + MXU einsum per row-block, masks self/duplicates, and keeps
-#     the top `deg`.  Rows are processed in `block`-sized tiles under
-#     `lax.map` so peak memory is block x C x d, independent of n.
+#     each node's candidate set to {current neighbors} U {reverse edges}
+#     U {neighbors of neighbors} U {random draws}, scores all candidates
+#     with one batched gather + MXU einsum per row-block, masks
+#     self/duplicates, and keeps the top `deg`.  Rows are processed in
+#     `block`-sized tiles under `lax.map` so peak memory is block x C x d,
+#     independent of n.  Rounds are dispatched FROM THE HOST — one jitted
+#     program per round, compiled once — rather than as one
+#     `lax.fori_loop(rounds)` mega-program.  Two reasons: (a) dispatch
+#     overhead is microseconds while each round is seconds of device time,
+#     so there is nothing to fuse; (b) single device programs whose
+#     runtime approaches the axon-tunnel RPC deadline (~60s) poison every
+#     subsequent host transfer ("TPU worker crashed"; see
+#     TPU_STATUS_r03.md) — per-round dispatch keeps each execution far
+#     below it at any n.
 #
 #   - Search (`search_cagra`): beam search.  Every iteration expands the
 #     beam's graph neighbors, scores them (gather + einsum), deduplicates,
@@ -19,6 +28,24 @@
 #     upper bound the GPU search also enforces via max_iterations).  Queries
 #     shard over the mesh: the graph and items are replicated, every step is
 #     row-wise per query, so XLA runs it SPMD with zero collectives.
+#
+# Candidate deduplication must see the full candidate width: in a
+# converged neighborhood every good id appears ~2·deg times across the
+# concatenated neighbor lists, so a top-k shortlist fills up with copies
+# of the few best ids (measured: graph recall 0.99 → 0.42 with shortlist
+# dedup).  Two full-width O(C)-ish schemes are implemented, picked by id
+# range (both measured on the v5e chip at 200k×64):
+#
+#   - `_dedup_sorted` (default, n·C < 2^31): pack (id << pos_bits | pos)
+#     into ONE int32, single-operand `jnp.sort`, mark adjacent equal ids,
+#     gather d2 by the embedded position.  No scatter, no multi-operand
+#     argsort — the cheapest full-width dedup on TPU (−18% round time vs
+#     the scatter scheme).
+#   - `_dedup_inf` (fallback for huge n): hash each id to a slot,
+#     scatter-min an encoded (quantized-distance | position) key, mask
+#     every candidate that did not win its slot.  Exact for duplicates
+#     (same id ⇒ same slot); distinct ids that collide lose one candidate
+#     for that salted call only.
 #
 # Distances are squared euclidean throughout (the IVF kernels' convention;
 # the model layer applies the metric transform).
@@ -30,79 +57,176 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from .distance import sqdist_gathered
 
-def _dedup_penalty(ids: jax.Array, d2: jax.Array) -> jax.Array:
-    """+inf on every duplicate occurrence of an id (first occurrence, in
-    stable-sort order, survives), so top_k yields unique ids."""
-    order = jnp.argsort(ids)
-    sid = jnp.take(ids, order)
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+def _dedup_inf(ids: jax.Array, d2: jax.Array, salt) -> jax.Array:
+    """Row-wise duplicate masking: returns d2 with every duplicate
+    occurrence of an id (beyond one winner) set to +inf.
+
+    ids, d2: (rows, C).  One scatter-min + one gather per row, O(C).
+    The winner per hash slot is the candidate with the smallest
+    (quantized d2, position) key; true duplicates carry identical d2, so
+    the position tiebreak picks exactly one.
+    """
+    C = ids.shape[-1]
+    n_slots = _next_pow2(2 * C)
+    pos = jnp.arange(C, dtype=jnp.int32)
+    bits = jax.lax.bitcast_convert_type(d2.astype(jnp.float32), jnp.int32)
+    # d2 >= 0 so the bitcast is order-preserving; clear the low pb
+    # mantissa bits (relative quantization 2^-(23-pb), selection-grade) to
+    # make room for the position tiebreak, keeping the key int32 and
+    # unique per candidate at any C
+    pb = _pos_bits(C)
+    enc = (bits & jnp.int32(~((1 << pb) - 1))) | pos
+    salt = jnp.asarray(salt, jnp.int32)
+    slot = ((ids ^ salt) * jnp.int32(-1640531535)) % jnp.int32(n_slots)
+
+    def row(slotr, encr, d2r):
+        table = jnp.full((n_slots,), jnp.iinfo(jnp.int32).max, jnp.int32)
+        table = table.at[slotr].min(encr)
+        return jnp.where(table[slotr] == encr, d2r, jnp.inf)
+
+    return jax.vmap(row)(slot, enc, d2)
+
+
+def _pos_bits(C: int) -> int:
+    return max(1, (C - 1)).bit_length()
+
+
+def _dedup_sorted(
+    ids: jax.Array, d2: jax.Array, n: int
+) -> "tuple[jax.Array, jax.Array] | None":
+    """Row-wise duplicate masking without scatter: returns
+    (d2_sorted_masked, ids_sorted) — the candidate list REORDERED by id
+    with every duplicate occurrence's d2 at +inf — or None when id and
+    position don't fit one int32 key (caller falls back to `_dedup_inf`).
+    Selection downstream is order-free (top_k), so reordering is free.
+    """
+    C = ids.shape[-1]
+    pb = _pos_bits(C)
+    if n > (1 << (31 - pb)):
+        return None
+    pos = jnp.arange(C, dtype=jnp.int32)
+    keys = (ids << pb) | pos
+    sk = jnp.sort(keys, axis=-1)
+    sid = sk >> pb
+    spos = sk & jnp.int32((1 << pb) - 1)
     dup = jnp.concatenate(
-        [jnp.zeros((1,), bool), sid[1:] == sid[:-1]]
+        [jnp.zeros_like(sid[..., :1], bool), sid[..., 1:] == sid[..., :-1]],
+        axis=-1,
     )
-    pen = jnp.zeros_like(d2).at[order].set(
-        jnp.where(dup, jnp.inf, 0.0)
-    )
-    return d2 + pen
+    d2s = jnp.take_along_axis(d2, spos, axis=-1)
+    return jnp.where(dup, jnp.inf, d2s), sid
 
 
-@partial(jax.jit, static_argnames=("deg", "rounds", "block"))
+@partial(jax.jit, static_argnames=("deg", "block", "nb", "sample"))
+def _nn_descent_round(
+    X: jax.Array,  # (n, d)
+    x2: jax.Array,  # (n,)
+    graph: jax.Array,  # (n, deg) int32
+    rkey: jax.Array,
+    salt: jax.Array,
+    deg: int,
+    block: int,
+    nb: int,
+    sample: int,
+):
+    n = X.shape[0]
+    # approximate REVERSE graph (the NN-descent ingredient forward-only
+    # candidate sets miss): scatter each edge head into a hashed slot of
+    # its tail's reverse list; collisions overwrite (random subset),
+    # never-written slots keep random init (extra exploration)
+    heads = jnp.repeat(jnp.arange(n, dtype=jnp.int32), deg)
+    tails = graph.reshape(-1)
+    slot = (heads * jnp.int32(-1640531535)) % deg  # Knuth hash (int32 wrap)
+    slot = jnp.abs(slot)
+    rev = jax.random.randint(
+        jax.random.fold_in(rkey, 997), (n, deg), 0, n, jnp.int32
+    )
+    rev = rev.at[tails, slot].set(heads, mode="drop")
+
+    def process_block(b):
+        bkey = jax.random.fold_in(rkey, b)
+        rows = jnp.minimum(
+            b * block + jnp.arange(block, dtype=jnp.int32), n - 1
+        )
+        base = jnp.concatenate([graph[rows], rev[rows]], axis=1)  # (block, 2deg)
+        if sample >= 2 * deg:
+            expand = base
+        else:
+            # sampled local join (the standard NN-descent ρ-sampling, and
+            # the dominant cost knob: candidate count — hence gather count,
+            # dedup width, and top_k width — scales with sample·deg)
+            sidx = jax.random.randint(
+                jax.random.fold_in(bkey, 1), (block, sample), 0, 2 * deg,
+                jnp.int32,
+            )
+            expand = jnp.take_along_axis(base, sidx, axis=1)
+        two_hop = graph[expand].reshape(block, expand.shape[1] * deg)
+        rand = jax.random.randint(
+            jax.random.fold_in(bkey, 2), (block, deg), 0, n, jnp.int32
+        )
+        cand = jnp.concatenate([base, two_hop, rand], axis=1)  # (block, C)
+        Xb = X[rows]
+        Xc = X[cand]  # (block, C, d)
+        d2 = sqdist_gathered(Xb, Xc, x2[rows], x2[cand])
+        d2 = jnp.where(cand == rows[:, None], jnp.inf, d2)  # no self
+        ds = _dedup_sorted(cand, d2, n)
+        if ds is None:
+            d2 = _dedup_inf(cand, d2, salt)
+            _, idx = jax.lax.top_k(-d2, deg)
+            return jnp.take_along_axis(cand, idx, axis=1)
+        d2s, sid = ds
+        _, idx = jax.lax.top_k(-d2s, deg)
+        return jnp.take_along_axis(sid, idx, axis=1)
+
+    blocks = jax.lax.map(process_block, jnp.arange(nb, dtype=jnp.int32))
+    return blocks.reshape(nb * block, deg)[:n]
+
+
 def build_cagra_graph(
     X: jax.Array,  # (n, d) item vectors (replicated)
     seed,
     deg: int = 32,
     rounds: int = 8,
     block: int = 256,
+    sample: int | None = None,
 ):
     """NN-descent kNN graph build.  Returns (n, deg) int32 neighbor ids
-    (approximate k-nearest, self excluded)."""
+    (approximate k-nearest, self excluded).  Host-driven round loop: one
+    compiled program per round (see header for why not fori_loop).
+    `sample` bounds the per-node local-join width (default deg, i.e.
+    ρ=0.5 of the 2·deg base — the cuVS NN-descent default rate class);
+    pass 2·deg for the exhaustive join."""
+    X = jnp.asarray(X)
     n, d = X.shape
+    if sample is None:
+        sample = deg
+    sample = max(1, min(sample, 2 * deg))
     key = jax.random.PRNGKey(seed)
-    g0 = jax.random.randint(jax.random.fold_in(key, 0), (n, deg), 0, n, jnp.int32)
+    graph = jax.random.randint(
+        jax.random.fold_in(key, 0), (n, deg), 0, n, jnp.int32
+    )
     x2 = (X * X).sum(axis=1)
     nb = -(-n // block)
-
-    def round_fn(r, graph):
-        rkey = jax.random.fold_in(key, r + 1)
-        # approximate REVERSE graph (the NN-descent ingredient forward-only
-        # candidate sets miss): scatter each edge head into a hashed slot of
-        # its tail's reverse list; collisions overwrite (random subset),
-        # never-written slots keep random init (extra exploration)
-        heads = jnp.repeat(jnp.arange(n, dtype=jnp.int32), deg)
-        tails = graph.reshape(-1)
-        slot = (heads * jnp.int32(-1640531535)) % deg  # Knuth hash (int32 wrap)
-        slot = jnp.abs(slot)
-        rev = jax.random.randint(
-            jax.random.fold_in(rkey, 997), (n, deg), 0, n, jnp.int32
+    for r in range(rounds):
+        graph = _nn_descent_round(
+            X,
+            x2,
+            graph,
+            jax.random.fold_in(key, r + 1),
+            jnp.int32((0x9E3779B9 * (r + 1)) & 0x7FFFFFFF),
+            deg,
+            block,
+            nb,
+            sample,
         )
-        rev = rev.at[tails, slot].set(heads, mode="drop")
-
-        def process_block(b):
-            rows = jnp.minimum(
-                b * block + jnp.arange(block, dtype=jnp.int32), n - 1
-            )
-            base = jnp.concatenate([graph[rows], rev[rows]], axis=1)  # (block, 2deg)
-            two_hop = graph[base].reshape(block, 2 * deg * deg)
-            rand = jax.random.randint(
-                jax.random.fold_in(rkey, b), (block, deg), 0, n, jnp.int32
-            )
-            cand = jnp.concatenate([base, two_hop, rand], axis=1)  # (block, C)
-            Xb = X[rows]
-            Xc = X[cand]  # (block, C, d)
-            d2 = (
-                x2[rows][:, None]
-                - 2.0 * jnp.einsum("bd,bcd->bc", Xb, Xc)
-                + x2[cand]
-            )
-            d2 = jnp.maximum(d2, 0.0)
-            d2 = jnp.where(cand == rows[:, None], jnp.inf, d2)  # no self
-            d2 = jax.vmap(_dedup_penalty)(cand, d2)
-            _, idx = jax.lax.top_k(-d2, deg)
-            return jnp.take_along_axis(cand, idx, axis=1)
-
-        blocks = jax.lax.map(process_block, jnp.arange(nb, dtype=jnp.int32))
-        return blocks.reshape(nb * block, deg)[:n]
-
-    return jax.lax.fori_loop(0, rounds, round_fn, g0)
+    return graph
 
 
 @partial(jax.jit, static_argnames=("k", "beam", "iters"))
@@ -124,18 +248,26 @@ def search_cagra(
     q2 = (Q * Q).sum(axis=1)
 
     def dists(ids):  # (nq, C) -> (nq, C)
-        Xc = X[ids]
-        d2 = q2[:, None] - 2.0 * jnp.einsum("qd,qcd->qc", Q, Xc) + x2[ids]
-        return jnp.maximum(d2, 0.0)
+        return sqdist_gathered(Q, X[ids], q2, x2[ids])
 
     # multi-entry start: per-query best of a 4x random entry sample (graph
     # ANN on weakly-structured data needs good starts more than long walks)
     key = jax.random.PRNGKey(0)
     entry = jax.random.randint(key, (nq, 4 * beam), 0, n, jnp.int32)
-    de = jax.vmap(_dedup_penalty)(entry, dists(entry))
-    nege, eidx = jax.lax.top_k(-de, beam)
-    beam_ids = jnp.take_along_axis(entry, eidx, axis=1)
-    d2b = -nege
+
+    def dedup_select(cand, d2c, m, salt):
+        ds = _dedup_sorted(cand, d2c, n)
+        if ds is None:
+            # per-iteration salt so a distinct-id hash collision costs a
+            # candidate once, not on every step (exactness note in header)
+            d2m = _dedup_inf(cand, d2c, salt)
+            negd, idx = jax.lax.top_k(-d2m, m)
+            return jnp.take_along_axis(cand, idx, axis=1), -negd
+        d2s, sid = ds
+        negd, idx = jax.lax.top_k(-d2s, m)
+        return jnp.take_along_axis(sid, idx, axis=1), -negd
+
+    beam_ids, d2b = dedup_select(entry, dists(entry), beam, jnp.int32(0))
 
     def step(t, carry):
         beam_ids, d2b = carry
@@ -148,9 +280,7 @@ def search_cagra(
         ext = jnp.concatenate([nbrs, rnd], axis=1)
         cand = jnp.concatenate([beam_ids, ext], axis=1)
         d2c = jnp.concatenate([d2b, dists(ext)], axis=1)
-        d2c = jax.vmap(_dedup_penalty)(cand, d2c)
-        negd, idx = jax.lax.top_k(-d2c, beam)
-        return jnp.take_along_axis(cand, idx, axis=1), -negd
+        return dedup_select(cand, d2c, beam, t + 1)
 
     beam_ids, d2b = jax.lax.fori_loop(0, iters, step, (beam_ids, d2b))
     negd, idx = jax.lax.top_k(-d2b, k)

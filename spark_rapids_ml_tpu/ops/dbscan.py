@@ -45,9 +45,10 @@ _BLOCK = 8192
 
 
 def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
-    a2 = (A * A).sum(axis=1, keepdims=True)
-    b2 = (B * B).sum(axis=1)
-    return jnp.maximum(a2 - 2.0 * (A @ B.T) + b2, 0.0)
+    """Pairwise squared distances (shared rank-critical form)."""
+    from .distance import sqdist
+
+    return sqdist(A, B)
 
 
 @partial(jax.jit, static_argnames=("mesh", "max_sweeps", "adj_budget", "block"))

@@ -29,6 +29,9 @@ from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from .distance import sqdist, sqdist_gathered
+from .precision import distance_precision
 import numpy as np
 
 
@@ -85,8 +88,7 @@ def search_ivfflat(
     """Probe the nprobe nearest lists per query; exact distances within the
     gathered candidates.  Returns (sq_distances (q,k), ids (q,k), -1 = none)."""
     q2 = (queries * queries).sum(axis=1, keepdims=True)
-    c2 = (centers * centers).sum(axis=1)
-    dc = q2 - 2.0 * (queries @ centers.T) + c2  # (q, nlist)
+    dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
     _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
 
     cand_x = jnp.take(buckets, probe, axis=0)  # (q, nprobe, mb, d)
@@ -95,9 +97,8 @@ def search_ivfflat(
     qn, np_, mb, d = cand_x.shape
     cand_x = cand_x.reshape(qn, np_ * mb, d)
     x2 = (cand_x * cand_x).sum(axis=2)
-    dot = jnp.einsum("qd,qcd->qc", queries, cand_x)
-    d2 = q2 + x2 - 2.0 * dot
-    d2 = jnp.where(cand_v > 0, jnp.maximum(d2, 0.0), jnp.inf)
+    d2 = sqdist_gathered(queries, cand_x, q2[:, 0], x2)
+    d2 = jnp.where(cand_v > 0, d2, jnp.inf)
     kk = min(k, d2.shape[1])
     neg_d, pos = jax.lax.top_k(-d2, kk)
     ids = jnp.take_along_axis(cand_id, pos, axis=1)
@@ -182,8 +183,7 @@ def search_ivfpq(
     M, ksub, dsub = codebooks.shape
     qn, d = queries.shape
     q2 = (queries * queries).sum(axis=1, keepdims=True)
-    c2 = (centers * centers).sum(axis=1)
-    dc = q2 - 2.0 * (queries @ centers.T) + c2  # (q, nlist)
+    dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
     _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
 
     # residual of each query to each probed coarse center: (q, nprobe, d)
@@ -191,7 +191,10 @@ def search_ivfpq(
     resid_sub = resid.reshape(qn, nprobe, M, dsub)
     # lookup tables: ||r_m - c_{m,j}||^2 for each subspace code j
     cb2 = (codebooks * codebooks).sum(axis=2)  # (M, ksub)
-    dot = jnp.einsum("qpmd,mjd->qpmj", resid_sub, codebooks)
+    dot = jnp.einsum(
+        "qpmd,mjd->qpmj", resid_sub, codebooks,
+        precision=distance_precision(),
+    )
     r2 = (resid_sub * resid_sub).sum(axis=3, keepdims=True)  # (q,nprobe,M,1)
     luts = r2 + cb2[None, None] - 2.0 * dot  # (q, nprobe, M, ksub)
 

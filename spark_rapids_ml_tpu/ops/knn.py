@@ -32,10 +32,9 @@ from ..parallel.mesh import DATA_AXIS
 
 def _block_sqdist(Q: jax.Array, X: jax.Array) -> jax.Array:
     """(q, m) squared euclidean distances via the matmul identity."""
-    q2 = (Q * Q).sum(axis=1, keepdims=True)
-    x2 = (X * X).sum(axis=1)
-    d2 = q2 - 2.0 * (Q @ X.T) + x2
-    return jnp.maximum(d2, 0.0)
+    from .distance import sqdist
+
+    return sqdist(Q, X)
 
 
 def _merge_topk(run_d, run_i, blk_d, blk_i, k: int):

@@ -1,0 +1,41 @@
+#
+# Matmul precision for distance kernels whose OUTPUT IS A RANKING or a
+# threshold decision (kNN / ANN neighbor ids, DBSCAN eps tests).
+#
+# TPU MXU "default" precision feeds f32 operands through bf16 passes:
+# relative product error ~2^-8, i.e. up to ~0.8% of |x||y|.  Squared
+# euclidean distances computed via the matmul identity then mis-rank
+# neighbors whose true distance gap is below that error — measured on a
+# v5e: CAGRA recall@10 fell from 0.996 (CPU, exact f32) to 0.58 (TPU,
+# default precision) on 200k x 64 gaussian data.  Reference parity also
+# demands exactness: cuML/cuVS brute-force and IVF kernels accumulate in
+# true f32 (reference knn.py:688-779, 1516-1657).
+#
+# `distance_precision()` is read at TRACE time — set the config before
+# the first fit/search.  "highest" = true f32 (6-pass); "high" = 3-pass
+# bf16 (~2^-14 relative, usually rank-safe at small dims); "default" =
+# fastest, rank-unsafe.  Iterative solvers that merely CONVERGE through
+# distances (KMeans Lloyd) keep XLA's default and are not routed here.
+#
+from __future__ import annotations
+
+import jax
+
+from ..config import get_config
+
+_LEVELS = {
+    "highest": jax.lax.Precision.HIGHEST,
+    "high": jax.lax.Precision.HIGH,
+    "default": jax.lax.Precision.DEFAULT,
+}
+
+
+def distance_precision() -> jax.lax.Precision:
+    """Precision for rank/threshold-critical distance matmuls
+    (config key `distance_precision`, default "highest")."""
+    name = str(get_config("distance_precision")).lower()
+    if name not in _LEVELS:
+        raise ValueError(
+            f"distance_precision must be one of {sorted(_LEVELS)}, got {name!r}"
+        )
+    return _LEVELS[name]

@@ -239,3 +239,39 @@ def test_bad_metric_rejected_at_fit(rng):
     X = rng.normal(size=(50, 4)).astype(np.float32)
     with pytest.raises(ValueError, match="metric"):
         ApproximateNearestNeighbors(metric="manhattan").fit(X)
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("ivfflat", {"nlist": 10, "nprobe": 10}),
+    ("cagra", {"graph_degree": 8}),
+])
+def test_search_query_chunking_matches_unchunked(blobs, algo, params):
+    """_search bounds the per-dispatch candidate working set by chunking
+    queries (at 10k+ queries one IVF dispatch would materialize tens of
+    GB); chunked and unchunked searches must return identical neighbors."""
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    k = 4
+    model = ApproximateNearestNeighbors(
+        k=k, algorithm=algo, algoParams=params
+    ).fit(blobs)
+    Q = blobs[:130]
+    d_full, p_full = model._search(Q, k)
+    assert model._per_query_candidate_bytes(k) > 0
+    try:
+        # shrink the budget so 130 queries split into several chunks
+        set_config(hbm_bytes=8 * model._per_query_candidate_bytes(k) * 40)
+        d_chunk, p_chunk = model._search(Q, k)
+    finally:
+        reset_config()
+    if algo == "ivfflat":
+        # deterministic search: chunking must be invisible
+        np.testing.assert_array_equal(p_full, p_chunk)
+        np.testing.assert_allclose(d_full, d_chunk, rtol=1e-5, atol=1e-5)
+    else:
+        # cagra's random entry sampling is shaped by the query batch, so
+        # chunked results differ bitwise; both must stay near-exact
+        sk = SkNN(n_neighbors=k, algorithm="brute").fit(blobs)
+        _, want = sk.kneighbors(Q)
+        assert _recall(p_chunk, want) >= _recall(p_full, want) - 0.05
+        assert _recall(p_chunk, want) >= 0.9
