@@ -144,14 +144,37 @@ def get_config(key: str, default: Optional[Any] = None) -> Any:
     return _config.get(key, _DEFAULTS.get(key, default))
 
 
+def _invalidate_traced(old: Any, new: Any) -> None:
+    """`distance_precision` is baked into kernels at trace time; a change
+    must drop compiled programs or same-shape calls silently keep the old
+    precision.  jax.clear_caches() is coarse but correct, and precision
+    flips are rare (benchmarking / explicit opt-out)."""
+    import sys
+
+    if old == new or "jax" not in sys.modules:
+        # jax never imported -> nothing compiled to drop (and configuring
+        # the library must not pay the multi-second jax import)
+        return
+    import jax
+
+    jax.clear_caches()
+
+
 def set_config(**kwargs: Any) -> None:
+    # effective (env-aware) value before/after: the env layer also feeds
+    # get_config, so invalidation must see through it (get_config takes
+    # the lock itself, hence computed outside the critical section)
+    prev = get_config("distance_precision")
     with _lock:
         for k, v in kwargs.items():
             if k not in _DEFAULTS:
                 raise KeyError(f"Unknown config key: {k}")
-            _config[k] = v
+        _config.update(kwargs)
+    _invalidate_traced(prev, get_config("distance_precision"))
 
 
 def reset_config() -> None:
+    prev = get_config("distance_precision")
     with _lock:
         _config.clear()
+    _invalidate_traced(prev, get_config("distance_precision"))

@@ -275,3 +275,42 @@ def test_search_query_chunking_matches_unchunked(blobs, algo, params):
         _, want = sk.kneighbors(Q)
         assert _recall(p_chunk, want) >= _recall(p_full, want) - 0.05
         assert _recall(p_chunk, want) >= 0.9
+
+
+def test_distance_precision_config_retraces():
+    """Changing `distance_precision` must invalidate compiled kernels —
+    it is baked in at trace time (ops/precision.py), so without cache
+    invalidation a same-shape call would silently keep the old precision."""
+    import jax
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.ops.distance import sqdist
+
+    f = jax.jit(sqdist)
+    try:
+        set_config(distance_precision="highest")
+        assert "HIGHEST" in str(jax.make_jaxpr(sqdist)(
+            np.ones((4, 3), np.float32), np.ones((5, 3), np.float32)
+        ))
+        f(np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+        set_config(distance_precision="default")
+        # fresh trace picks up the new precision (cache was dropped)
+        assert "HIGHEST" not in str(jax.make_jaxpr(sqdist)(
+            np.ones((4, 3), np.float32), np.ones((5, 3), np.float32)
+        ))
+        out = f(np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+        assert out.shape == (4, 5)
+    finally:
+        reset_config()
+
+
+def test_distance_precision_invalid_value():
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.ops.precision import distance_precision
+
+    try:
+        set_config(distance_precision="sloppy")
+        with pytest.raises(ValueError, match="distance_precision"):
+            distance_precision()
+    finally:
+        reset_config()
