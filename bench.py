@@ -301,8 +301,11 @@ def bench_knn(extra: dict):
         # sync by FETCHING results: on the axon tunnel block_until_ready
         # returns before the device finishes (TPU_STATUS_r03.md) — a host
         # transfer is the only true sync point, and it is part of the
-        # user-visible latency anyway
-        np.asarray(fn(X, valid, ids, Q, k=k)[0])  # compile + sync
+        # user-visible latency anyway.  Warm-up fetches BOTH outputs: the
+        # fused path's id-gather runs outside its jit and must compile
+        # before the timed iteration
+        w_d, w_i = fn(X, valid, ids, Q, k=k)
+        np.asarray(w_d), np.asarray(w_i)
         t0 = time.perf_counter()
         out_d, out_i = fn(X, valid, ids, Q, k=k)
         np.asarray(out_d), np.asarray(out_i)
@@ -313,16 +316,19 @@ def bench_knn(extra: dict):
     # the exactness tax: same kernel at XLA default (bf16-pass) precision —
     # rank-unsafe (see distance_precision in docs/configuration.md) but the
     # config escape hatch users may pick for speed
-    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.config import get_config, set_config
 
+    prev_precision = get_config("distance_precision")
     try:
-        # set_config drops compiled kernels on a precision change
+        # set_config drops compiled kernels on a precision change; restore
+        # ONLY this key after (reset_config would wipe the whole-run
+        # settings like shape_bucketing=False from main())
         set_config(distance_precision="default")
         extra["knn_100kx64_xla_bf16pass_qps"] = round(
             q / timed(knn_topk_blocked), 1
         )
     finally:
-        reset_config()
+        set_config(distance_precision=prev_precision)
     if jax.default_backend() != "tpu":
         # knn_topk_fused would run the Pallas INTERPRETER off-TPU — not a
         # hang exactly, but hours at this size; the comparison only means

@@ -41,7 +41,7 @@ class UMAPClass:
                 "set_op_mix_ratio", "local_connectivity",
                 "repulsion_strength", "negative_sample_rate", "a", "b",
                 "random_state", "sample_fraction", "target_metric",
-                "target_weight",
+                "target_weight", "build_algo", "build_kwds",
             )
         }
 
@@ -54,6 +54,9 @@ class UMAPClass:
             # umap.py:203-212); ops/distances.py implements the kernels
             "metric": lambda x: x if x in SUPPORTED_METRICS else None,
             "init": lambda x: x if x in ("spectral", "random") else None,
+            "build_algo": lambda x: x
+            if x in ("auto", "brute_force_knn", "nn_descent")
+            else None,
         }
 
     @classmethod
@@ -80,6 +83,8 @@ class UMAPClass:
             "sample_fraction": 1.0,
             "target_metric": "categorical",
             "target_weight": 0.5,
+            "build_algo": "auto",
+            "build_kwds": None,
             "verbose": False,
         }
 
@@ -122,6 +127,17 @@ class _UMAPParams(
                             TypeConverters.toFloat)
     random_state = Param("_", "random_state", "Random seed.",
                          TypeConverters.identity)
+    build_algo = Param(
+        "_", "build_algo",
+        "kNN graph build: 'auto' (brute force <= 50k rows, else "
+        "nn_descent), 'brute_force_knn', or 'nn_descent' (reference "
+        "umap.py:362-370).",
+        TypeConverters.toString)
+    build_kwds = Param(
+        "_", "build_kwds",
+        "nn_descent arguments: nnd_graph_degree, nnd_max_iterations "
+        "(reference umap.py:372-380).",
+        TypeConverters.identity)
 
     def __init__(self) -> None:
         super().__init__()
@@ -140,6 +156,7 @@ class _UMAPParams(
             negative_sample_rate=5,
             sample_fraction=1.0,
             random_state=None,
+            build_algo="auto",
             outputCol="embedding",
         )
 
@@ -243,15 +260,47 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
             # identity metrics (euclidean/l2/sqeuclidean) copy-free
             X_graph = np.asarray(preprocess_rows(X_fit, metric), dtype=dtype)
 
-        # 1. exact kNN graph on one device (self excluded)
-        Xd = jnp.asarray(X_graph)
-        ones = jnp.ones((n,), Xd.dtype)
-        ids = jnp.arange(n, dtype=jnp.int32)
-        dists, inds = umap_knn_graph(
-            Xd, ones, ids, Xd, k=k + 1, metric=metric, p=pw
+        # 1. kNN graph (self excluded).  build_algo mirrors cuML UMAP
+        # (reference umap.py:362-370): brute force for small n, NN-descent
+        # (ops/cagra.py) past 50k rows — O(n·deg·rounds) instead of O(n²).
+        build_algo = str(p.get("build_algo") or "auto")
+        bk = dict(p.get("build_kwds") or {})
+        use_nnd = build_algo == "nn_descent" or (
+            build_algo == "auto" and n > 50_000
         )
-        knn_d = dists[:, 1:]
-        knn_i = inds[:, 1:]
+        if use_nnd and metric_kind(metric) != "matmul":
+            # the NN-descent kernel scores candidates with the euclidean
+            # MXU identity; elementwise metrics keep the brute path
+            self.logger.warning(
+                f"build_algo={build_algo!r} resolved to nn_descent, which "
+                f"does not support metric={metric!r}; using "
+                "brute_force_knn (O(n\u00b2) at this row count)"
+            )
+            use_nnd = False
+        Xd = jnp.asarray(X_graph)
+        if use_nnd:
+            from ..ops.cagra import knn_graph_nn_descent
+            from ..ops.distances import finalize_sqdist
+
+            seed_p = p.get("random_state")
+            d2k, knn_i = knn_graph_nn_descent(
+                Xd,
+                k=k,
+                deg=(int(bk["nnd_graph_degree"])
+                     if "nnd_graph_degree" in bk else None),
+                rounds=int(bk.get("nnd_max_iterations", 8)),
+                seed=0 if seed_p is None else int(seed_p),
+            )
+            knn_d = finalize_sqdist(d2k, metric)
+            knn_i = jnp.asarray(knn_i)
+        else:
+            ones = jnp.ones((n,), Xd.dtype)
+            ids = jnp.arange(n, dtype=jnp.int32)
+            dists, inds = umap_knn_graph(
+                Xd, ones, ids, Xd, k=k + 1, metric=metric, p=pw
+            )
+            knn_d = dists[:, 1:]
+            knn_i = inds[:, 1:]
 
         # 2. fuzzy simplicial set
         lc = max(1, int(float(p["local_connectivity"])))

@@ -196,6 +196,7 @@ def build_cagra_graph(
     rounds: int = 8,
     block: int = 256,
     sample: int | None = None,
+    x2: jax.Array | None = None,  # optional precomputed (n,) sq norms
 ):
     """NN-descent kNN graph build.  Returns (n, deg) int32 neighbor ids
     (approximate k-nearest, self excluded).  Host-driven round loop: one
@@ -212,7 +213,8 @@ def build_cagra_graph(
     graph = jax.random.randint(
         jax.random.fold_in(key, 0), (n, deg), 0, n, jnp.int32
     )
-    x2 = (X * X).sum(axis=1)
+    if x2 is None:
+        x2 = (X * X).sum(axis=1)
     nb = -(-n // block)
     for r in range(rounds):
         graph = _nn_descent_round(
@@ -285,3 +287,51 @@ def search_cagra(
     beam_ids, d2b = jax.lax.fori_loop(0, iters, step, (beam_ids, d2b))
     negd, idx = jax.lax.top_k(-d2b, k)
     return -negd, jnp.take_along_axis(beam_ids, idx, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "block"))
+def _graph_knn_select(
+    X: jax.Array, x2: jax.Array, graph: jax.Array, k: int, block: int = 2048
+):
+    """Exact distances to each node's graph neighbors, best-k selected.
+    Row-blocked so peak memory is block x deg x d at any n."""
+    n = X.shape[0]
+    nb = -(-n // block)
+
+    def pb(b):
+        rows = jnp.minimum(b * block + jnp.arange(block, dtype=jnp.int32), n - 1)
+        g = graph[rows]
+        d2 = sqdist_gathered(X[rows], X[g], x2[rows], x2[g])
+        negd, idx = jax.lax.top_k(-d2, k)
+        return -negd, jnp.take_along_axis(g, idx, axis=1)
+
+    ds, ids = jax.lax.map(pb, jnp.arange(nb, dtype=jnp.int32))
+    return (
+        ds.reshape(nb * block, k)[:n],
+        ids.reshape(nb * block, k)[:n],
+    )
+
+
+def knn_graph_nn_descent(
+    X: jax.Array,
+    k: int,
+    deg: int | None = None,
+    rounds: int = 8,
+    sample: int | None = None,
+    seed: int = 0,
+):
+    """Approximate kNN graph via NN-descent (self excluded): the TPU
+    analog of cuML UMAP's `build_algo='nn_descent'` (RAFT nn_descent;
+    reference umap.py:362-370).  Returns (sq_distances (n,k), ids (n,k)),
+    best first.  `deg` is the working graph degree (>= k; wider = better
+    recall, default 2k capped into [16, 64])."""
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    if deg is None:
+        deg = min(max(2 * k, 16), 64)
+    deg = max(1, min(max(deg, k), n - 1))
+    x2 = (X * X).sum(axis=1)
+    graph = build_cagra_graph(
+        X, seed, deg=deg, rounds=rounds, sample=sample, x2=x2
+    )
+    return _graph_knn_select(X, x2, graph, k)

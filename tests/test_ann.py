@@ -287,19 +287,21 @@ def test_distance_precision_config_retraces():
     from spark_rapids_ml_tpu.ops.distance import sqdist
 
     f = jax.jit(sqdist)
+    a = np.ones((4, 3), np.float32)
+    b = np.ones((5, 3), np.float32)
     try:
         set_config(distance_precision="highest")
-        assert "HIGHEST" in str(jax.make_jaxpr(sqdist)(
-            np.ones((4, 3), np.float32), np.ones((5, 3), np.float32)
-        ))
-        f(np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+        assert "HIGHEST" in str(jax.make_jaxpr(sqdist)(a, b))
+        f(a, b)
+        assert f._cache_size() == 1
         set_config(distance_precision="default")
-        # fresh trace picks up the new precision (cache was dropped)
-        assert "HIGHEST" not in str(jax.make_jaxpr(sqdist)(
-            np.ones((4, 3), np.float32), np.ones((5, 3), np.float32)
-        ))
-        out = f(np.ones((4, 3), np.float32), np.ones((5, 3), np.float32))
+        # the compiled HIGHEST executable must be GONE — a same-shape call
+        # would otherwise silently keep the old precision
+        assert f._cache_size() == 0
+        assert "HIGHEST" not in str(jax.make_jaxpr(sqdist)(a, b))
+        out = f(a, b)
         assert out.shape == (4, 5)
+        assert f._cache_size() == 1
     finally:
         reset_config()
 

@@ -258,3 +258,36 @@ def test_umap_minkowski_kwds(rng):
 def test_umap_rejects_unknown_metric():
     with pytest.raises(ValueError):
         UMAP(metric="mahalanobis").fit(np.zeros((30, 3), np.float32))
+
+
+def test_build_algo_nn_descent_matches_brute(blobs):
+    """build_algo='nn_descent' (reference umap.py:362-370) must produce an
+    embedding of the same quality class as the brute-force graph."""
+    from sklearn.manifold import trustworthiness
+
+    X, _ = blobs
+    m_nnd = UMAP(
+        n_neighbors=10, random_state=0, n_epochs=100,
+        build_algo="nn_descent",
+        build_kwds={"nnd_graph_degree": 24, "nnd_max_iterations": 6},
+    ).fit(X)
+    t = trustworthiness(X, m_nnd.embedding_, n_neighbors=10)
+    assert t > 0.95
+
+
+def test_build_algo_validation(blobs):
+    import pytest as _pt
+
+    with _pt.raises(ValueError):
+        UMAP(build_algo="hnsw").fit(blobs[0])
+
+
+def test_build_algo_nn_descent_elementwise_metric_falls_back(blobs):
+    # manhattan cannot ride the euclidean NN-descent scorer; the fit must
+    # warn and fall back to brute force, not fail
+    X, _ = blobs
+    m = UMAP(
+        n_neighbors=8, random_state=0, n_epochs=50,
+        metric="manhattan", build_algo="nn_descent",
+    ).fit(X)
+    assert m.embedding_.shape == (len(X), 2)
