@@ -309,12 +309,9 @@ class _RandomForestEstimator(
             max_active=int(p.get("max_active_nodes", 256)),
             mesh=mesh,
         )
-        from ..parallel.mesh import fetch_replicated
-
-        # the tree axis is sharded over the mesh (trees_per_worker blocks);
-        # fetch_replicated also handles the multi-process case where the
-        # sharded array is not fully addressable from one process
-        host = type(trees)(*(fetch_replicated(t, mesh) for t in trees))
+        # forest_fit dispatches tree chunks from the host and returns
+        # host-side TreeArrays (fetching per chunk is the tunnel-safe sync)
+        host = trees
         return {
             "feature": np.asarray(host.feature)[:n_trees],
             "threshold": np.asarray(host.threshold)[:n_trees],

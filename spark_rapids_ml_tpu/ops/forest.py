@@ -311,36 +311,14 @@ def _grow_one_tree(
 
 @partial(
     jax.jit,
-    static_argnames=(
-        "trees_per_worker", "max_depth", "n_bins", "criterion", "n_classes",
-        "max_features", "bootstrap", "subsample", "max_active", "mesh",
-    ),
+    static_argnames=("n_bins", "criterion", "n_classes", "mesh"),
 )
-def forest_fit(
-    X: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
-    y: jax.Array,  # (N_pad,) labels, sharded
-    valid: jax.Array,  # (N_pad,) validity * sample weight, sharded
-    seed,
-    trees_per_worker: int,
-    max_depth: int,
-    n_bins: int,
-    criterion: int,
-    n_classes: int,  # 0 for regression
-    max_features: int,
-    min_instances: float,
-    min_info_gain: float,
-    bootstrap: bool,
-    subsample: float,
-    max_active: int = 256,
-    mesh=None,
-):
-    """Fit the whole forest: each device grows `trees_per_worker` trees on
-    its local rows (reference `_estimators_per_worker` tree.py:330-341).
-    Returns TreeArrays with a leading (trees_per_worker * n_devices) axis."""
+def _forest_prep(X, y, valid, n_bins: int, criterion: int, n_classes: int,
+                 mesh=None):
+    """One pass shared by every tree chunk: per-device bin edges (sorted
+    local quantiles), digitized rows, and histogram statistic channels."""
 
     def kernel(Xl, yl, validl):
-        # histogram statistic channels, built on device (no host staging):
-        # classification -> one-hot class counts; regression -> moments
         if criterion == VARIANCE:
             yf = yl.astype(Xl.dtype)
             statsl = jnp.stack([jnp.ones_like(yf), yf, yf * yf], axis=1)
@@ -350,13 +328,54 @@ def forest_fit(
             ).astype(Xl.dtype)
         edges = compute_bin_edges(Xl, n_bins, valid=validl)
         Xb = digitize(Xl, edges)
+        return Xb, edges, statsl
+
+    shard = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+    )
+    return shard(X, y, valid)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "count", "trees_per_worker", "max_depth", "n_bins", "criterion",
+        "max_features", "bootstrap", "subsample", "max_active", "mesh",
+    ),
+)
+def _forest_fit_chunk(
+    Xb, edges, stats, valid, seed, lo,
+    count: int,
+    trees_per_worker: int,
+    max_depth: int,
+    n_bins: int,
+    criterion: int,
+    max_features: int,
+    min_instances: float,
+    min_info_gain: float,
+    bootstrap: bool,
+    subsample: float,
+    max_active: int,
+    mesh=None,
+):
+    """Grow trees [lo, lo+count) of each device's `trees_per_worker`
+    allocation.  `lo` is traced, so every full chunk shares one
+    compilation; per-tree PRNG keys come from one split of the full
+    allocation, so the forest is identical for any chunking."""
+
+    def kernel(Xbl, edgesl, statsl, validl, lo_):
         widx = jax.lax.axis_index(DATA_AXIS)
         base = jax.random.fold_in(jax.random.PRNGKey(seed), widx)
-        keys = jax.random.split(base, trees_per_worker)
+        keys = jax.lax.dynamic_slice_in_dim(
+            jax.random.split(base, trees_per_worker), lo_, count, axis=0
+        )
         grow = partial(
             _grow_one_tree,
-            Xb=Xb,
-            edges=edges,
+            Xb=Xbl,
+            edges=edgesl,
             stats=statsl,
             valid=validl,
             max_depth=max_depth,
@@ -374,10 +393,118 @@ def forest_fit(
     shard = jax.shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                  P()),
         out_specs=TreeArrays(*([P(DATA_AXIS)] * 6)),
     )
-    return shard(X, y, valid)
+    return shard(Xb, edges, stats, valid, jnp.asarray(lo, jnp.int32))
+
+
+def forest_fit(
+    X: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
+    y: jax.Array,  # (N_pad,) labels, sharded
+    valid: jax.Array,  # (N_pad,) validity * sample weight, sharded
+    seed,
+    trees_per_worker: int,
+    max_depth: int,
+    n_bins: int,
+    criterion: int,
+    n_classes: int,  # 0 for regression
+    max_features: int,
+    min_instances: float,
+    min_info_gain: float,
+    bootstrap: bool,
+    subsample: float,
+    max_active: int = 256,
+    mesh=None,
+    chunk_trees: int | None = None,  # test hook: fixed chunk size
+):
+    """Fit the whole forest: each device grows `trees_per_worker` trees on
+    its local rows (reference `_estimators_per_worker` tree.py:330-341).
+    Returns HOST TreeArrays with a leading (trees_per_worker * n_devices)
+    axis.
+
+    Trees are dispatched from the host in adaptively-sized chunks: a
+    100-tree depth-16 build on 1M rows is minutes of device time, and any
+    single program whose runtime approaches the axon tunnel's ~60 s
+    transfer deadline poisons the client (TPU_STATUS_r03.md).  Trees are
+    embarrassingly parallel, so chunking changes nothing but dispatch
+    count; per-chunk host fetches double as the true sync points."""
+    import time as _time
+
+    import numpy as np
+
+    from ..parallel.mesh import fetch_replicated
+
+    prep = _forest_prep(
+        X, y, valid, n_bins=n_bins, criterion=criterion,
+        n_classes=n_classes, mesh=mesh,
+    )
+
+    def run(lo: int, count: int):
+        t0 = _time.perf_counter()
+        chunk = _forest_fit_chunk(
+            *prep, valid, seed, lo,
+            count=count,
+            trees_per_worker=trees_per_worker,
+            max_depth=max_depth,
+            n_bins=n_bins,
+            criterion=criterion,
+            max_features=max_features,
+            min_instances=min_instances,
+            min_info_gain=min_info_gain,
+            bootstrap=bootstrap,
+            subsample=subsample,
+            max_active=max_active,
+            mesh=mesh,
+        )
+        host = TreeArrays(
+            *(np.asarray(fetch_replicated(t, mesh)) for t in chunk)
+        )  # fetch = sync (block_until_ready lies on the tunnel)
+        return host, _time.perf_counter() - t0
+
+    # estimated histogram work per device: levels x rows x features
+    # scatter-adds per tree.  Small builds run as ONE dispatch (far from
+    # the deadline; probing would just add compiles), big builds probe a
+    # single tree and size chunks from its warm time.
+    m_local = int(X.shape[0]) // max(int(mesh.devices.size), 1)
+    est_ops = trees_per_worker * max_depth * m_local * int(X.shape[1])
+    chunks = []
+    done = 0
+    if chunk_trees is not None:
+        size = max(1, min(chunk_trees, trees_per_worker))
+    elif trees_per_worker > 2 and est_ops > 2e9:
+        c0, _ = run(0, 1)  # cold: includes compile
+        c1, warm = run(1, 1)  # warm: honest per-tree device time
+        chunks += [c0, c1]
+        done = 2
+        # ~20 s of device work per dispatch, floor 1
+        size = int(min(max(20.0 / max(warm, 1e-3), 1), trees_per_worker - done))
+    else:
+        size = trees_per_worker
+    while trees_per_worker - done >= size and size > 0:
+        chunks.append(run(done, size)[0])
+        done += size
+    if trees_per_worker - done:
+        chunks.append(run(done, trees_per_worker - done)[0])
+
+    # reassemble DEVICE-MAJOR: each chunk is (ndev*count, ...) device-major
+    # over its own count; naive chunk concat would interleave devices and
+    # make the caller's [:n_trees] padding trim timing-dependent (chunk
+    # sizes come from a wall-clock probe)
+    ndev = int(mesh.devices.size)
+
+    def reassemble(field):
+        parts = [
+            getattr(c, field).reshape(
+                (ndev, -1) + getattr(c, field).shape[1:]
+            )
+            for c in chunks
+        ]
+        cat = np.concatenate(parts, axis=1)  # (ndev, trees_per_worker, ...)
+        return cat.reshape((ndev * trees_per_worker,) + cat.shape[2:])
+
+    return TreeArrays(*(reassemble(f) for f in TreeArrays._fields))
 
 
 @partial(jax.jit, static_argnames=("max_depth",))

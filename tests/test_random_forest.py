@@ -285,3 +285,46 @@ def test_evaluate_on_dataset(clf_data):
     assert s.accuracy > 0.85
     assert 0.0 < s.weightedFMeasure() <= 1.0
     assert "rawPrediction" in s.predictions.columns
+
+
+def test_chunked_build_matches_single_dispatch(num_workers):
+    """forest_fit dispatches tree chunks from the host on big builds
+    (tunnel-deadline safety, TPU_STATUS_r03.md); the forest must be
+    IDENTICAL for any chunking — including device-major tree order, which
+    the caller's [:n_trees] padding trim depends on."""
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.classification import RandomForestClassifier
+    from spark_rapids_ml_tpu.ops import forest as forest_ops
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((512, 8)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    df = pd.DataFrame({"features": list(X), "label": y})
+
+    # 10 trees on num_workers devices: trees_per_worker pads unevenly
+    def fit(chunk):
+        orig = forest_ops.forest_fit
+
+        def patched(*a, **kw):
+            kw["chunk_trees"] = chunk
+            return orig(*a, **kw)
+
+        # models/tree.py re-imports forest_fit from ops.forest inside
+        # _fit_array, so the module attribute is the effective target
+        forest_ops.forest_fit = patched
+        try:
+            est = RandomForestClassifier(
+                numTrees=10, maxDepth=5, seed=11, num_workers=num_workers
+            )
+            return est.fit(df)
+        finally:
+            forest_ops.forest_fit = orig
+
+    m_single = fit(None)
+    m_chunk2 = fit(2)
+    for attr in ("feature", "threshold", "left_child", "leaf_stats"):
+        np.testing.assert_array_equal(
+            getattr(m_single, attr), getattr(m_chunk2, attr),
+            err_msg=f"{attr} differs between chunked and single dispatch",
+        )
