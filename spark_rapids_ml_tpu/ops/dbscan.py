@@ -13,19 +13,30 @@
 #     sweep takes, for every local row, the min label over its in-eps core
 #     neighbors; a pointer-jumping step (label <- label[label]) collapses
 #     chains so convergence is ~O(log N) sweeps instead of O(graph
-#     diameter).  Labels are replicated via all_gather after every sweep —
-#     N int32s over ICI, negligible next to the distance pass.
+#     diameter).  Labels are re-replicated after every sweep — N int32s
+#     over ICI, negligible next to the distance pass.
 #   - Border points attach to their minimum-label core neighbor after
 #     convergence; everything else is noise (-1), matching
 #     sklearn/cuML semantics (neighbor counts include the point itself).
 #
+# Dispatch structure: sweeps are driven FROM THE HOST — one compiled
+# program per sweep (prep / sweep / border are separate dispatches), with
+# the `changed` scalar fetched after each sweep as both the convergence
+# decision and the true sync point.  A single all-sweeps while_loop
+# program would approach the axon tunnel's ~60 s transfer-RPC deadline on
+# large inputs and poison the client (TPU_STATUS_r03.md); per-sweep
+# dispatch also stops exactly at convergence instead of tracing the
+# worst-case bound.
+#
 # Memory contract: the peak per-device footprint is the replicated dataset
 # (N x d, same as the reference's broadcast) plus ONE (m, block) distance
 # tile.  For small problems (m*N under `_ADJ_BUDGET` elements) the in-eps
-# adjacency is materialized once and carried through the while_loop — fewer
-# FLOPs; past the budget every sweep recomputes distances tile-by-tile, so
-# the N^2/p adjacency never exists in memory (the recompute-per-sweep
-# alternative the reference's broadcast design implies at scale).
+# adjacency could be materialized once; with host-driven sweeps the
+# adjacency would have to be re-materialized or carried across dispatches,
+# so every sweep recomputes distances tile-by-tile — the N^2/p adjacency
+# never exists in memory, and the recompute is the same MXU matmul the
+# dense path ran once (measured parity on the CPU mesh; the dense-path
+# FLOP saving only ever applied below 64M-element adjacencies).
 #
 from __future__ import annotations
 
@@ -37,8 +48,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
 
-# largest (m, N) bool adjacency worth materializing per device (elements);
-# 2^26 = 64M ~ 64 MB of bools — past this, recompute per sweep in tiles
+# kept for API compatibility with the models layer (adjacency working-set
+# cap, `max_mbytes_per_batch`): bounds the column-tile width instead
 _ADJ_BUDGET = 1 << 26
 # column-tile width of the recompute path: one (m, _BLOCK) f32 tile
 _BLOCK = 8192
@@ -51,131 +62,63 @@ def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
     return sqdist(A, B)
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_sweeps", "adj_budget", "block"))
-def dbscan_fit_predict(
-    X_sharded: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
-    valid_sharded: jax.Array,  # (N_pad,) validity, sharded
-    eps: jax.Array,  # scalar
-    min_samples: jax.Array,  # scalar int
-    mesh=None,
-    max_sweeps: int = 64,
-    adj_budget: int = _ADJ_BUDGET,
-    block: int = _BLOCK,
-):
-    """Returns (labels (N_pad,) int32 row-sharded, core_mask (N_pad,) bool).
+def _reduce_kernel(Xl, Xf, vf, labf, eps2, SENT, block):
+    """Per-device: degree counts and min in-eps label over ALL columns,
+    one (m, block) tile at a time.  labf/vf/Xf are full (replicated)."""
+    m = Xl.shape[0]
+    N = Xf.shape[0]
+    blk = min(block, N)
+    nb = -(-N // blk)
+    Npad = nb * blk
+    Xp = jnp.pad(Xf, ((0, Npad - N), (0, 0)))
+    vp = jnp.pad(vf, (0, Npad - N))
+    lp = jnp.pad(labf, (0, Npad - N), constant_values=SENT)
 
-    Labels are min-row-index cluster representatives; -1 is noise.  The API
-    layer renumbers to consecutive ids on the host (the reference's labels
-    come back from rank 0 the same way, clustering.py:1160-1182).
-    """
-    n_shards = mesh.devices.size
+    def body(i, carry):
+        deg, cand = carry
+        o = jnp.asarray(i * blk, jnp.int32)
+        Xb = jax.lax.dynamic_slice(
+            Xp, (o, jnp.zeros((), jnp.int32)), (blk, Xp.shape[1])
+        )
+        vb = jax.lax.dynamic_slice(vp, (o,), (blk,))
+        lb = jax.lax.dynamic_slice(lp, (o,), (blk,))
+        d2 = _sqdist(Xl, Xb)
+        adj = (d2 <= eps2) & (vb > 0)[None, :]
+        # int32 accumulator: bool-sum defaults to int64 under x64
+        deg = deg + adj.sum(axis=1).astype(jnp.int32)
+        cand = jnp.minimum(
+            cand, jnp.min(jnp.where(adj, lb[None, :], SENT), axis=1)
+        )
+        return deg, cand
+
+    carry0 = jax.lax.pcast(
+        (jnp.zeros((m,), jnp.int32), jnp.full((m,), SENT, jnp.int32)),
+        (DATA_AXIS,),
+        to="varying",
+    )
+    return jax.lax.fori_loop(0, nb, body, carry0)
+
+
+@partial(jax.jit, static_argnames=("mesh", "block"))
+def _dbscan_prep(X_sharded, valid_sharded, eps, min_samples, mesh=None,
+                 block: int = _BLOCK):
+    """One dispatch: degree pass -> (labels0, core_mask), both sharded."""
     N = X_sharded.shape[0]
-    SENT = jnp.int32(N)  # sentinel: "no label"
+    SENT = jnp.int32(N)
     eps2 = eps * eps
 
     def kernel(Xl, valid_l_f):
         m = Xl.shape[0]
         row0 = jax.lax.axis_index(DATA_AXIS) * m
         local_idx = row0 + jnp.arange(m, dtype=jnp.int32)
-
-        # replicate the dataset on-device (the reference broadcasts it
-        # host-side, clustering.py:1148-1155; one all_gather over ICI here)
-        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)  # (N, d)
-        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)  # (N,)
-        valid_l = valid_l_f > 0
-
-        if m * N <= adj_budget:
-            # dense path: one (m, N) adjacency, computed once and reused
-            d2 = _sqdist(Xl, Xf)
-            adj = (d2 <= eps2) & (vf > 0)[None, :]
-            deg_once = adj.sum(axis=1)
-
-            def neighbor_reduce(labf):
-                cand = jnp.min(jnp.where(adj, labf[None, :], SENT), axis=1)
-                return deg_once, cand
-
-        else:
-            # tiled recompute path: never materialize (m, N); each call
-            # re-runs the distance matmuls one (m, blk) tile at a time
-            blk = min(block, N)
-            nb = -(-N // blk)
-            Npad = nb * blk
-            Xp = jnp.pad(Xf, ((0, Npad - N), (0, 0)))
-            vp = jnp.pad(vf, (0, Npad - N))
-
-            def neighbor_reduce(labf):
-                lp = jnp.pad(labf, (0, Npad - N), constant_values=SENT)
-
-                def body(i, carry):
-                    deg, cand = carry
-                    o = jnp.asarray(i * blk, jnp.int32)
-                    Xb = jax.lax.dynamic_slice(
-                        Xp, (o, jnp.zeros((), jnp.int32)), (blk, Xp.shape[1])
-                    )
-                    vb = jax.lax.dynamic_slice(vp, (o,), (blk,))
-                    lb = jax.lax.dynamic_slice(lp, (o,), (blk,))
-                    d2 = _sqdist(Xl, Xb)
-                    adj = (d2 <= eps2) & (vb > 0)[None, :]
-                    # int32 accumulator: bool-sum defaults to int64 under x64
-                    deg = deg + adj.sum(axis=1).astype(jnp.int32)
-                    cand = jnp.minimum(
-                        cand, jnp.min(jnp.where(adj, lb[None, :], SENT), axis=1)
-                    )
-                    return deg, cand
-
-                carry0 = jax.lax.pcast(
-                    (
-                        jnp.zeros((m,), jnp.int32),
-                        jnp.full((m,), SENT, jnp.int32),
-                    ),
-                    (DATA_AXIS,),
-                    to="varying",
-                )
-                return jax.lax.fori_loop(0, nb, body, carry0)
-
-        deg, _ = neighbor_reduce(jnp.full((N,), SENT, jnp.int32))
-        core_l = (deg >= min_samples) & valid_l
-        core_f = jax.lax.all_gather(core_l, DATA_AXIS, tiled=True)  # (N,)
-
+        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)
+        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)
+        deg, _ = _reduce_kernel(
+            Xl, Xf, vf, jnp.full((N,), SENT, jnp.int32), eps2, SENT, block
+        )
+        core_l = (deg >= min_samples) & (valid_l_f > 0)
         labels0_l = jnp.where(core_l, local_idx, SENT)
-        labels0 = jax.lax.all_gather(labels0_l, DATA_AXIS, tiled=True)
-
-        def sweep(state):
-            labels, _, it = state
-            core_lab = jnp.where(core_f, labels, SENT)  # only core labels spread
-            _, cand = neighbor_reduce(core_lab)
-            lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
-            new_l = jnp.where(core_l, jnp.minimum(lab_l, cand), lab_l)
-            new = jax.lax.all_gather(new_l, DATA_AXIS, tiled=True)
-            # pointer jumping: follow the representative one hop
-            safe = jnp.clip(new, 0, N - 1)
-            hop = jnp.where(new < SENT, jnp.take(new, safe), SENT)
-            new = jnp.minimum(new, hop)
-            changed = jnp.any(new != labels)
-            return new, changed, it + 1
-
-        def cond(state):
-            _, changed, it = state
-            return changed & (it < max_sweeps)
-
-        # pcast marks the loop carry as device-varying so its type is stable
-        # across collective-producing sweeps
-        init = (
-            labels0,
-            jax.lax.pcast(jnp.bool_(True), (DATA_AXIS,), to="varying"),
-            jax.lax.pcast(jnp.int32(0), (DATA_AXIS,), to="varying"),
-        )
-        labels, _, _ = jax.lax.while_loop(cond, sweep, init)
-
-        # border points: attach to the min-label in-eps core neighbor
-        core_lab = jnp.where(core_f, labels, SENT)
-        _, cand = neighbor_reduce(core_lab)
-        lab_l = jax.lax.dynamic_slice(labels, (row0,), (m,))
-        final_l = jnp.where(
-            core_l, lab_l, jnp.where(cand < SENT, cand, jnp.int32(-1))
-        )
-        final_l = jnp.where(valid_l, final_l, jnp.int32(-1))
-        return final_l, core_l
+        return labels0_l, core_l
 
     shard = jax.shard_map(
         kernel,
@@ -184,3 +127,97 @@ def dbscan_fit_predict(
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
     )
     return shard(X_sharded, valid_sharded)
+
+
+@partial(jax.jit, static_argnames=("mesh", "block", "border"))
+def _dbscan_sweep(
+    X_sharded, valid_sharded, core_sharded, labels_sharded,
+    eps, mesh=None, block: int = _BLOCK, border: bool = False,
+):
+    """One min-label propagation sweep (+ pointer jump), or — with
+    `border=True` — the final border-attachment pass.  Returns
+    (labels (N_pad,) sharded, changed scalar)."""
+    N = X_sharded.shape[0]
+    SENT = jnp.int32(N)
+    eps2 = eps * eps
+
+    def kernel(Xl, valid_l_f, core_l, lab_l):
+        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)
+        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)
+        core_f = jax.lax.all_gather(core_l, DATA_AXIS, tiled=True)
+        labels = jax.lax.all_gather(lab_l, DATA_AXIS, tiled=True)
+        core_lab = jnp.where(core_f, labels, SENT)  # only core labels spread
+        _, cand = _reduce_kernel(Xl, Xf, vf, core_lab, eps2, SENT, block)
+        if border:
+            final_l = jnp.where(
+                core_l, lab_l, jnp.where(cand < SENT, cand, jnp.int32(-1))
+            )
+            final_l = jnp.where(valid_l_f > 0, final_l, jnp.int32(-1))
+            ch = jax.lax.pmax(
+                jnp.any(final_l != lab_l).astype(jnp.int32), DATA_AXIS
+            )
+            return final_l, ch
+        new_l = jnp.where(core_l, jnp.minimum(lab_l, cand), lab_l)
+        new = jax.lax.all_gather(new_l, DATA_AXIS, tiled=True)
+        # pointer jumping: follow the representative one hop
+        safe = jnp.clip(new, 0, N - 1)
+        hop = jnp.where(new < SENT, jnp.take(new, safe), SENT)
+        new = jnp.minimum(new, hop)
+        # pmax makes the exit flag provably replicated (out_specs P())
+        changed = jax.lax.pmax(
+            jnp.any(new != labels).astype(jnp.int32), DATA_AXIS
+        )
+        row0 = jax.lax.axis_index(DATA_AXIS) * Xl.shape[0]
+        return jax.lax.dynamic_slice(new, (row0,), (Xl.shape[0],)), changed
+
+    shard = jax.shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+    return shard(X_sharded, valid_sharded, core_sharded, labels_sharded)
+
+
+def dbscan_fit_predict(
+    X_sharded: jax.Array,  # (N_pad, d) rows sharded over DATA_AXIS
+    valid_sharded: jax.Array,  # (N_pad,) validity, sharded
+    eps: jax.Array,  # scalar
+    min_samples: jax.Array,  # scalar int
+    mesh=None,
+    max_sweeps: int = 64,
+    adj_budget: int = _ADJ_BUDGET,  # kept in the signature (models layer
+    # passes the max_mbytes_per_batch cap); tiles are bounded by `block`
+    block: int = _BLOCK,
+):
+    """Returns (labels (N_pad,) int32 row-sharded, core_mask (N_pad,) bool).
+
+    Labels are min-row-index cluster representatives; -1 is noise.  The API
+    layer renumbers to consecutive ids on the host (the reference's labels
+    come back from rank 0 the same way, clustering.py:1160-1182).  Sweeps
+    are host-dispatched; the fetched `changed` scalar is the loop exit.
+    """
+    import numpy as np
+
+    # honor the working-set cap by shrinking the column tile: adj_budget
+    # arrives in ELEMENTS assuming 1-byte adjacency (models layer maps
+    # max_mbytes_per_batch MB -> elements 1:1), but the recompute tile is
+    # f32 — divide by 4 so the cap stays a BYTE cap
+    m_local = int(X_sharded.shape[0]) // max(int(mesh.devices.size), 1)
+    if m_local > 0:
+        block = max(256, min(block, -(-(adj_budget // 4) // m_local)))
+    labels, core = _dbscan_prep(
+        X_sharded, valid_sharded, eps, min_samples, mesh=mesh, block=block
+    )
+    for _ in range(max_sweeps):
+        labels, changed = _dbscan_sweep(
+            X_sharded, valid_sharded, core, labels, eps,
+            mesh=mesh, block=block,
+        )
+        if not bool(np.asarray(changed)):  # fetch = sync + exit decision
+            break
+    labels, _ = _dbscan_sweep(
+        X_sharded, valid_sharded, core, labels, eps,
+        mesh=mesh, block=block, border=True,
+    )
+    return labels, core

@@ -473,7 +473,7 @@ def forest_fit(
     done = 0
     if chunk_trees is not None:
         size = max(1, min(chunk_trees, trees_per_worker))
-    elif trees_per_worker > 2 and est_ops > 2e9:
+    elif trees_per_worker > 1 and est_ops > 2e8:
         c0, _ = run(0, 1)  # cold: includes compile
         c1, warm = run(1, 1)  # warm: honest per-tree device time
         chunks += [c0, c1]

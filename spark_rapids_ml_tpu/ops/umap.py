@@ -222,16 +222,18 @@ def optimize_embedding(
         np.asarray(emb[0, 0])  # true sync (fetch, not block_until_ready)
         return _time.perf_counter() - t0
 
-    probe = min(8, n_epochs)
-    elapsed = run(0, probe)  # cold: includes the chunk program compile
-    done = probe
-    if done + probe <= n_epochs:
-        elapsed = run(done, probe)  # warm: honest per-epoch device time
-        done += probe
+    # probe with the minimal unit (1 epoch): even a single epoch can be
+    # tens of seconds at multi-million-row scale, so no blind multi-epoch
+    # dispatch may happen before a timing exists
+    elapsed = run(0, 1)  # cold: includes the chunk program compile
+    done = 1
     if done < n_epochs:
-        per_epoch = max(elapsed / probe, 1e-4)
-        # ~20 s of device work per dispatch, floor 8 (dispatch overhead)
-        chunk = int(min(max(20.0 / per_epoch, 8), n_epochs - done))
+        elapsed = run(done, 1)  # warm: honest per-epoch device time
+        done += 1
+    if done < n_epochs:
+        per_epoch = max(elapsed, 1e-4)
+        # ~20 s of device work per dispatch, floor 1
+        chunk = int(min(max(20.0 / per_epoch, 1), n_epochs - done))
         while n_epochs - done >= chunk:
             run(done, chunk)
             done += chunk

@@ -323,7 +323,9 @@ def test_chunked_build_matches_single_dispatch(num_workers):
 
     m_single = fit(None)
     m_chunk2 = fit(2)
-    for attr in ("feature", "threshold", "left_child", "leaf_stats"):
+    from spark_rapids_ml_tpu.ops.forest import TreeArrays
+
+    for attr in TreeArrays._fields:
         np.testing.assert_array_equal(
             getattr(m_single, attr), getattr(m_chunk2, attr),
             err_msg=f"{attr} differs between chunked and single dispatch",
