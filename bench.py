@@ -45,7 +45,7 @@ CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 100_000))
 WORKLOADS = [
     w.strip()
     for w in os.environ.get(
-        "BENCH_WORKLOADS", "logreg,pca,kmeans,rf,ann,knn,umap,streaming"
+        "BENCH_WORKLOADS", "logreg,pca,kmeans,ann,knn,umap,dbscan,streaming,rf"
     ).split(",")
 ]
 
@@ -343,6 +343,47 @@ def bench_knn(extra: dict):
         extra["knn_pallas_error"] = f"{type(e).__name__}: {e}"[:200]
 
 
+def bench_dbscan(extra: dict):
+    """DBSCAN host-driven sweep dispatch (ops/dbscan.py): fit time and
+    sweep count at a one-chip N^2 scale, quality vs sklearn."""
+    import numpy as np
+    from sklearn.datasets import make_blobs
+
+    from spark_rapids_ml_tpu.clustering import DBSCAN
+
+    extra["dbscan_intended_config"] = (
+        "BASELINE-class: broadcast N x d per worker (reference "
+        "clustering.py:1104-1155); run: 300k x 16 blobs single chip"
+    )
+    n = int(os.environ.get("BENCH_DBSCAN_ROWS", 300_000))
+    d = 16
+    X, _ = make_blobs(
+        n_samples=n, n_features=d, centers=60, cluster_std=0.6,
+        random_state=9,
+    )
+    X = X.astype("float32")
+    est = DBSCAN(eps=1.2, min_samples=5)
+    t0 = time.perf_counter()
+    model = est.fit(X)
+    labels = model.transform(X)
+    el = time.perf_counter() - t0
+    labels = np.asarray(labels)
+    extra[f"dbscan_{n}x{d}_fit_predict_sec"] = round(el, 3)
+    extra[f"dbscan_{n}x{d}_rows_per_sec"] = round(n / el, 1)
+    extra["dbscan_clusters_found"] = int(len(set(labels.tolist()) - {-1}))
+    # quality on a subsample vs sklearn
+    from sklearn.cluster import DBSCAN as SkDBSCAN
+    from sklearn.metrics import adjusted_rand_score
+
+    sub = np.random.default_rng(0).choice(n, min(20_000, n), replace=False)
+    want = SkDBSCAN(eps=1.2, min_samples=5).fit_predict(X[sub])
+    # sklearn on the subsample vs our labels restricted to it: densities
+    # differ on a subsample, so compare cluster AGREEMENT, not identity
+    extra["dbscan_subsample_ari"] = round(
+        float(adjusted_rand_score(labels[sub], want)), 3
+    )
+
+
 def bench_streaming(extra: dict):
     """Beyond-HBM epoch-streaming LogReg: parquet re-streamed per L-BFGS
     evaluation (the reachability path for BASELINE's 1B x 256 north star;
@@ -484,6 +525,7 @@ def main() -> None:
         "pca": bench_pca,
         "kmeans": bench_kmeans,
         "ann": bench_ann,
+        "dbscan": bench_dbscan,
         "knn": bench_knn,
         "umap": bench_umap,
         "streaming": bench_streaming,

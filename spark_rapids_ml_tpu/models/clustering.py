@@ -501,11 +501,9 @@ class DBSCANModel(DBSCANClass, _TpuModel, _DBSCANTpuParams):
         mb = self._tpu_params.get("max_mbytes_per_batch")
         if mb:
             # cuML's max_mbytes_per_batch (reference clustering.py:603-632):
-            # bound the per-device adjacency working set; past it the kernel
-            # recomputes distance tiles per sweep.  The kernel budget counts
-            # 1-byte bool adjacency elements (see ops/dbscan.py
-            # _ADJ_BUDGET), so MB maps 1:1 to elements regardless of the
-            # feature dtype.
+            # a BYTE cap on the per-device distance working set — the
+            # kernel bounds its per-sweep (m_local, block) f32 distance
+            # tile to fit it (ops/dbscan.py dbscan_fit_predict).
             kernel_kwargs["adj_budget"] = max(int(float(mb) * 1024 * 1024), 1)
         labels, _core = dbscan_fit_predict(
             Xs, valid,

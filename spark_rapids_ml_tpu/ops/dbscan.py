@@ -48,8 +48,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
 
-# kept for API compatibility with the models layer (adjacency working-set
-# cap, `max_mbytes_per_batch`): bounds the column-tile width instead
+# default per-device distance working-set BYTE budget (the models layer
+# overrides it from `max_mbytes_per_batch`); bounds the column-tile width
 _ADJ_BUDGET = 1 << 26
 # column-tile width of the recompute path: one (m, _BLOCK) f32 tile
 _BLOCK = 8192
@@ -99,22 +99,30 @@ def _reduce_kernel(Xl, Xf, vf, labf, eps2, SENT, block):
     return jax.lax.fori_loop(0, nb, body, carry0)
 
 
+@partial(jax.jit, static_argnames=("mesh",))
+def _replicate(x, mesh=None):
+    """One-shot replication of a sharded array (XLA inserts the
+    all_gather): the dataset is gathered ONCE per fit, not per sweep."""
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
 @partial(jax.jit, static_argnames=("mesh", "block"))
-def _dbscan_prep(X_sharded, valid_sharded, eps, min_samples, mesh=None,
-                 block: int = _BLOCK):
-    """One dispatch: degree pass -> (labels0, core_mask), both sharded."""
+def _dbscan_prep(X_sharded, Xf, vf, valid_sharded, min_samples, eps,
+                 mesh=None, block: int = _BLOCK):
+    """One dispatch: degree pass -> (labels0, core_mask), both sharded.
+    Xf/vf are the pre-replicated dataset/validity."""
     N = X_sharded.shape[0]
     SENT = jnp.int32(N)
     eps2 = eps * eps
 
-    def kernel(Xl, valid_l_f):
+    def kernel(Xl, Xf_, vf_, valid_l_f):
         m = Xl.shape[0]
         row0 = jax.lax.axis_index(DATA_AXIS) * m
         local_idx = row0 + jnp.arange(m, dtype=jnp.int32)
-        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)
-        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)
         deg, _ = _reduce_kernel(
-            Xl, Xf, vf, jnp.full((N,), SENT, jnp.int32), eps2, SENT, block
+            Xl, Xf_, vf_, jnp.full((N,), SENT, jnp.int32), eps2, SENT, block
         )
         core_l = (deg >= min_samples) & (valid_l_f > 0)
         labels0_l = jnp.where(core_l, local_idx, SENT)
@@ -123,28 +131,28 @@ def _dbscan_prep(X_sharded, valid_sharded, eps, min_samples, mesh=None,
     shard = jax.shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
         out_specs=(P(DATA_AXIS), P(DATA_AXIS)),
     )
-    return shard(X_sharded, valid_sharded)
+    return shard(X_sharded, Xf, vf, valid_sharded)
 
 
 @partial(jax.jit, static_argnames=("mesh", "block", "border"))
 def _dbscan_sweep(
-    X_sharded, valid_sharded, core_sharded, labels_sharded,
+    X_sharded, Xf, vf, core_f, valid_sharded, core_sharded, labels_sharded,
     eps, mesh=None, block: int = _BLOCK, border: bool = False,
 ):
     """One min-label propagation sweep (+ pointer jump), or — with
-    `border=True` — the final border-attachment pass.  Returns
-    (labels (N_pad,) sharded, changed scalar)."""
+    `border=True` — the final border-attachment pass.  Xf/vf/core_f are
+    pre-replicated; only the N int32 labels re-gather per sweep (the
+    "negligible next to the distance pass" traffic of the header).
+    Returns (labels (N_pad,) sharded, changed scalar)."""
     N = X_sharded.shape[0]
     SENT = jnp.int32(N)
     eps2 = eps * eps
 
-    def kernel(Xl, valid_l_f, core_l, lab_l):
-        Xf = jax.lax.all_gather(Xl, DATA_AXIS, tiled=True)
-        vf = jax.lax.all_gather(valid_l_f, DATA_AXIS, tiled=True)
-        core_f = jax.lax.all_gather(core_l, DATA_AXIS, tiled=True)
+    def kernel(Xl, Xf_, vf_, core_f_, valid_l_f, core_l, lab_l):
+        Xf, vf, core_f = Xf_, vf_, core_f_
         labels = jax.lax.all_gather(lab_l, DATA_AXIS, tiled=True)
         core_lab = jnp.where(core_f, labels, SENT)  # only core labels spread
         _, cand = _reduce_kernel(Xl, Xf, vf, core_lab, eps2, SENT, block)
@@ -173,10 +181,12 @@ def _dbscan_sweep(
     shard = jax.shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(DATA_AXIS), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS)),
         out_specs=(P(DATA_AXIS), P()),
     )
-    return shard(X_sharded, valid_sharded, core_sharded, labels_sharded)
+    return shard(X_sharded, Xf, vf, core_f, valid_sharded, core_sharded,
+                 labels_sharded)
 
 
 def dbscan_fit_predict(
@@ -200,24 +210,29 @@ def dbscan_fit_predict(
     import numpy as np
 
     # honor the working-set cap by shrinking the column tile: adj_budget
-    # arrives in ELEMENTS assuming 1-byte adjacency (models layer maps
-    # max_mbytes_per_batch MB -> elements 1:1), but the recompute tile is
-    # f32 — divide by 4 so the cap stays a BYTE cap
+    # is a BYTE budget (models layer maps max_mbytes_per_batch to bytes)
+    # and the recompute tile is f32, so the tile width is budget/4/m rows
+    # (floor-divided — never exceed the cap; floor 8 keeps degenerate caps
+    # runnable and an explicitly smaller caller `block` is respected)
     m_local = int(X_sharded.shape[0]) // max(int(mesh.devices.size), 1)
     if m_local > 0:
-        block = max(256, min(block, -(-(adj_budget // 4) // m_local)))
+        block = min(block, max(8, (adj_budget // 4) // m_local))
+    Xf = _replicate(X_sharded, mesh=mesh)
+    vf = _replicate(valid_sharded, mesh=mesh)
     labels, core = _dbscan_prep(
-        X_sharded, valid_sharded, eps, min_samples, mesh=mesh, block=block
+        X_sharded, Xf, vf, valid_sharded, min_samples, eps,
+        mesh=mesh, block=block,
     )
+    core_f = _replicate(core, mesh=mesh)
     for _ in range(max_sweeps):
         labels, changed = _dbscan_sweep(
-            X_sharded, valid_sharded, core, labels, eps,
+            X_sharded, Xf, vf, core_f, valid_sharded, core, labels, eps,
             mesh=mesh, block=block,
         )
         if not bool(np.asarray(changed)):  # fetch = sync + exit decision
             break
     labels, _ = _dbscan_sweep(
-        X_sharded, valid_sharded, core, labels, eps,
+        X_sharded, Xf, vf, core_f, valid_sharded, core, labels, eps,
         mesh=mesh, block=block, border=True,
     )
     return labels, core

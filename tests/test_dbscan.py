@@ -98,9 +98,9 @@ def test_prediction_col_rename(rng):
     assert "cluster" in model.transform(df).columns
 
 
-def test_tiled_recompute_path_matches_dense(rng):
-    # force the memory-lean tiled path (adj_budget=1) with an uneven tile
-    # size, and check it agrees with the default dense-adjacency path
+def test_tile_width_invariance(rng):
+    # labels must not depend on the column-tile width: full-width tiles vs
+    # an uneven 37-wide tiling (exercises the fori_loop boundary padding)
     import jax.numpy as jnp
 
     from spark_rapids_ml_tpu.ops.dbscan import dbscan_fit_predict
@@ -115,14 +115,18 @@ def test_tiled_recompute_path_matches_dense(rng):
     valid = st.mask(np.float32)
     eps = jnp.asarray(1.2, jnp.float32)
     ms = jnp.asarray(5, jnp.int32)
-    dense, _ = dbscan_fit_predict(Xs, valid, eps, ms, mesh=mesh)
-    tiled, _ = dbscan_fit_predict(
-        Xs, valid, eps, ms, mesh=mesh, adj_budget=1, block=37
-    )
-    assert np.array_equal(st.fetch(dense), st.fetch(tiled))
+    full, _ = dbscan_fit_predict(Xs, valid, eps, ms, mesh=mesh)
+    tiled, _ = dbscan_fit_predict(Xs, valid, eps, ms, mesh=mesh, block=37)
+    assert np.array_equal(st.fetch(full), st.fetch(tiled))
     want = SkDBSCAN(eps=1.2, min_samples=5).fit_predict(X)
     got = st.fetch(tiled)
     assert adjusted_rand_score(got, want) == 1.0
+    # the byte cap must never RAISE an explicitly smaller block: a tiny
+    # cap yields a tiny tile, identical labels
+    capped, _ = dbscan_fit_predict(
+        Xs, valid, eps, ms, mesh=mesh, adj_budget=1
+    )
+    assert np.array_equal(st.fetch(full), st.fetch(capped))
 
 
 def test_max_mbytes_per_batch_forces_tiled_path(rng, monkeypatch):
