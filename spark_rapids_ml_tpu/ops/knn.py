@@ -104,7 +104,9 @@ def knn_topk_local(items, item_valid, item_ids, queries, k: int):
     d2 = _block_sqdist(queries, items)
     d2 = jnp.where(item_valid[None, :] > 0, d2, jnp.inf)
     neg_d, pos = jax.lax.top_k(-d2, k)
-    return -neg_d, jnp.take(item_ids, pos)
+    # invalid items surface as id -1 (the documented k > n_valid contract)
+    masked_ids = jnp.where(item_valid > 0, item_ids, -1)
+    return -neg_d, jnp.take(masked_ids, pos)
 
 
 def knn_topk_single(items, item_valid, item_ids, queries, k: int):
@@ -144,6 +146,8 @@ def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
     qpad = nb * block
     Qp = jnp.pad(queries, ((0, qpad - q), (0, 0)))
 
+    masked_ids = jnp.where(item_valid > 0, item_ids, -1)
+
     def one(b):
         # uniform int32 indices (a literal 0 traces int64 once x64 is on)
         Qb = jax.lax.dynamic_slice(
@@ -152,7 +156,55 @@ def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
         d2 = _block_sqdist(Qb, items)
         d2 = jnp.where(item_valid[None, :] > 0, d2, jnp.inf)
         neg_d, pos = jax.lax.top_k(-d2, k)
-        return -neg_d, jnp.take(item_ids, pos)
+        return -neg_d, jnp.take(masked_ids, pos)
+
+    ds, ids = jax.lax.map(one, jnp.arange(nb, dtype=jnp.int32))
+    return ds.reshape(qpad, k)[:q], ids.reshape(qpad, k)[:q]
+
+@partial(jax.jit, static_argnames=("k", "block", "cblock"))
+def knn_topk_coltiled(items, item_valid, item_ids, queries, k: int,
+                      block: int = 1024, cblock: int = 8192):
+    """Brute force with BOTH axes tiled: each (block, cblock) distance
+    tile folds into a running (block, k) top-k via `_merge_topk`, so the
+    widest sort is over cblock+k columns instead of n.  XLA's full-width
+    top_k was measured as the dominant cost of `knn_topk_blocked` on the
+    v5e (the Pallas experiment's conclusion, ops/pallas_knn.py); this is
+    the sort-narrowing alternative at the XLA level — candidate default
+    pending an on-chip comparison (bench.py knn workload records both).
+    Exact-equivalent to `knn_topk_blocked`."""
+    q, d = queries.shape
+    n = items.shape[0]
+    block = min(block, q)
+    cb = min(cblock, n)
+    ncb = -(-n // cb)
+    npad = ncb * cb
+    Xp = jnp.pad(items, ((0, npad - n), (0, 0)))
+    vp = jnp.pad(item_valid, (0, npad - n))
+    ip = jnp.pad(item_ids, (0, npad - n), constant_values=-1)
+    nb = -(-q // block)
+    qpad = nb * block
+    Qp = jnp.pad(queries, ((0, qpad - q), (0, 0)))
+
+    def one(b):
+        Qb = jax.lax.dynamic_slice(
+            Qp, (b * block, jnp.zeros((), jnp.int32)), (block, d)
+        )
+
+        def fold(j, carry):
+            run_d, run_i = carry
+            o = jnp.asarray(j * cb, jnp.int32)
+            Xb = jax.lax.dynamic_slice(
+                Xp, (o, jnp.zeros((), jnp.int32)), (cb, d)
+            )
+            vb = jax.lax.dynamic_slice(vp, (o,), (cb,))
+            ib = jax.lax.dynamic_slice(ip, (o,), (cb,))
+            d2 = _block_sqdist(Qb, Xb)
+            d2 = jnp.where(vb[None, :] > 0, d2, jnp.inf)
+            return _merge_topk(run_d, run_i, d2, ib[None, :], k)
+
+        run_d = jnp.full((block, k), jnp.inf, queries.dtype)
+        run_i = jnp.full((block, k), -1, item_ids.dtype)
+        return jax.lax.fori_loop(0, ncb, fold, (run_d, run_i))
 
     ds, ids = jax.lax.map(one, jnp.arange(nb, dtype=jnp.int32))
     return ds.reshape(qpad, k)[:q], ids.reshape(qpad, k)[:q]

@@ -106,3 +106,32 @@ def test_save_load(tmp_path, rng):
     assert np.array_equal(
         np.stack(a["indices"].to_numpy()), np.stack(b["indices"].to_numpy())
     )
+
+
+def test_coltiled_kernel_matches_blocked():
+    """knn_topk_coltiled (sort-narrowing column-tiled merge) must be
+    exact-equivalent to knn_topk_blocked, including invalid-item masking
+    and uneven tail tiles."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_blocked, knn_topk_coltiled
+
+    rng = np.random.default_rng(7)
+    X = jnp.asarray(rng.standard_normal((3001, 12), dtype=np.float32))
+    Q = jnp.asarray(rng.standard_normal((257, 12), dtype=np.float32))
+    v = jnp.ones((3001,), jnp.float32).at[50:80].set(0.0)
+    ids = jnp.arange(3001, dtype=jnp.int32)
+    d1, i1 = knn_topk_blocked(X, v, ids, Q, k=7)
+    d2, i2 = knn_topk_coltiled(X, v, ids, Q, k=7, block=100, cblock=777)
+    np.testing.assert_allclose(
+        np.asarray(d1), np.asarray(d2), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    # k > n_valid edge: unfillable tail slots are id -1 in BOTH kernels
+    # (the documented contract; blocked used to leak invalid-item ids)
+    Xs = X[:5]
+    vs = jnp.asarray([1.0, 1.0, 0.0, 0.0, 0.0], jnp.float32)
+    ib, pb = knn_topk_blocked(Xs, vs, ids[:5], Q[:3], k=4)
+    ic, pc = knn_topk_coltiled(Xs, vs, ids[:5], Q[:3], k=4, cblock=3)
+    np.testing.assert_array_equal(np.asarray(pb)[:, 2:], -1)
+    np.testing.assert_array_equal(np.asarray(pb), np.asarray(pc))
