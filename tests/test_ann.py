@@ -316,3 +316,31 @@ def test_distance_precision_invalid_value():
             distance_precision()
     finally:
         reset_config()
+
+
+def test_dedup_pair_sort_branch_matches_packed():
+    """The huge-n dedup branch (stable two-operand sort) must produce the
+    same mask as the packed single-sort branch (n only gates the branch,
+    so the same inputs can run through both)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.cagra import _dedup_sorted
+
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(0, 50, (6, 40)).astype(np.int32))
+    d2 = jnp.asarray(rng.uniform(0, 10, (6, 40)).astype(np.float32))
+    d_packed, i_packed = _dedup_sorted(ids, d2, n=50)
+    d_pair, i_pair = _dedup_sorted(ids, d2, n=1 << 30)
+    # per row: the surviving (id, d2) multiset must be identical
+    for r in range(6):
+        a = sorted(
+            (int(i), float(d)) for i, d in
+            zip(np.asarray(i_packed)[r], np.asarray(d_packed)[r])
+            if np.isfinite(d)
+        )
+        b = sorted(
+            (int(i), float(d)) for i, d in
+            zip(np.asarray(i_pair)[r], np.asarray(d_pair)[r])
+            if np.isfinite(d)
+        )
+        assert a == b
