@@ -21,13 +21,14 @@
 #     TPU_STATUS_r03.md) — per-round dispatch keeps each execution far
 #     below it at any n.
 #
-#   - Search (`search_cagra`): beam search.  Every iteration expands the
+#   - Search (`search_cagra`): beam search.  Every step expands the
 #     beam's graph neighbors, scores them (gather + einsum), deduplicates,
-#     and keeps the best `beam` candidates; `iters` fixed iterations replace
-#     the data-dependent termination of the GPU kernel (XLA-friendly, and an
-#     upper bound the GPU search also enforces via max_iterations).  Queries
-#     shard over the mesh: the graph and items are replicated, every step is
-#     row-wise per query, so XLA runs it SPMD with zero collectives.
+#     and keeps the best `beam` candidates.  Steps are host-dispatched
+#     with convergence-based early termination (`iters` is the
+#     max_iterations bound, matching the GPU search's semantics); the
+#     per-step `changed` fetch is a cross-device reduce + host sync.
+#     Queries shard over the mesh: the graph and items are replicated and
+#     each step is row-wise per query.
 #
 # Candidate deduplication must see the full candidate width: in a
 # converged neighborhood every good id appears ~2·deg times across the
@@ -196,7 +197,61 @@ def build_cagra_graph(
     return graph
 
 
-@partial(jax.jit, static_argnames=("k", "beam", "iters"))
+@partial(jax.jit, static_argnames=("beam",))
+def _search_entry(
+    Q: jax.Array, X: jax.Array, q2: jax.Array, x2: jax.Array, beam: int
+):
+    """Multi-entry start: per-query best of a 4x random entry sample
+    (graph ANN on weakly-structured data needs good starts more than long
+    walks)."""
+    nq = Q.shape[0]
+    n = X.shape[0]
+    key = jax.random.PRNGKey(0)
+    entry = jax.random.randint(key, (nq, 4 * beam), 0, n, jnp.int32)
+    de = sqdist_gathered(Q, X[entry], q2, x2[entry])
+    d2s, sid = _dedup_sorted(entry, de, n)
+    negd, idx = jax.lax.top_k(-d2s, beam)
+    return jnp.take_along_axis(sid, idx, axis=1), -negd
+
+
+@partial(jax.jit, static_argnames=("beam",))
+def _search_step(
+    beam_ids: jax.Array,  # (nq, beam)
+    d2b: jax.Array,  # (nq, beam)
+    t,  # traced step index (varies the exploration draws)
+    Q: jax.Array,
+    X: jax.Array,
+    q2: jax.Array,
+    x2: jax.Array,
+    graph: jax.Array,
+    beam: int,
+):
+    """One beam-expansion step; returns (beam_ids, d2b, changed)."""
+    nq = Q.shape[0]
+    n = X.shape[0]
+    deg = graph.shape[1]
+    key = jax.random.PRNGKey(0)
+    nbrs = graph[beam_ids].reshape(nq, beam * deg)
+    # a pinch of random exploration per step escapes local minima on
+    # uniform data (the equivalent of CAGRA's pruned long-range edges)
+    rnd = jax.random.randint(
+        jax.random.fold_in(key, t), (nq, deg), 0, n, jnp.int32
+    )
+    ext = jnp.concatenate([nbrs, rnd], axis=1)
+    cand = jnp.concatenate([beam_ids, ext], axis=1)
+    de = sqdist_gathered(Q, X[ext], q2, x2[ext])
+    d2c = jnp.concatenate([d2b, de], axis=1)
+    d2s, sid = _dedup_sorted(cand, d2c, n)
+    negd, idx = jax.lax.top_k(-d2s, beam)
+    new_ids = jnp.take_along_axis(sid, idx, axis=1)
+    # new_ids is in top_k order, not id order — compare as SETS via
+    # per-row sort (beam is small)
+    changed = jnp.any(
+        jnp.sort(new_ids, axis=1) != jnp.sort(beam_ids, axis=1)
+    )
+    return new_ids, -negd, changed
+
+
 def search_cagra(
     Q: jax.Array,  # (q, d) queries — row-sharded over the mesh
     X: jax.Array,  # (n, d) items (replicated)
@@ -206,43 +261,29 @@ def search_cagra(
     iters: int = 12,
 ):
     """Beam search over the kNN graph.  Returns (d2 (q,k), pos (q,k)) —
-    squared distances and item row positions, best first."""
-    nq, d = Q.shape
+    squared distances and item row positions, best first.
+
+    Steps are host-dispatched with convergence-based early termination
+    (the analog of cuVS search stopping when its shortlist stabilizes,
+    with `iters` as the max_iterations bound): when NO query's beam set
+    changed in a step, further steps only re-draw random probes —
+    negligible at that point — so the search stops.  Each step stays far
+    under the tunnel dispatch deadline and the per-step `changed` fetch
+    is the sync point.
+    """
+    Q = jnp.asarray(Q)
+    X = jnp.asarray(X)
     n = X.shape[0]
-    deg = graph.shape[1]
     beam = min(beam, n)
-    x2 = (X * X).sum(axis=1)
     q2 = (Q * Q).sum(axis=1)
-
-    def dists(ids):  # (nq, C) -> (nq, C)
-        return sqdist_gathered(Q, X[ids], q2, x2[ids])
-
-    # multi-entry start: per-query best of a 4x random entry sample (graph
-    # ANN on weakly-structured data needs good starts more than long walks)
-    key = jax.random.PRNGKey(0)
-    entry = jax.random.randint(key, (nq, 4 * beam), 0, n, jnp.int32)
-
-    def dedup_select(cand, d2c, m):
-        d2s, sid = _dedup_sorted(cand, d2c, n)
-        negd, idx = jax.lax.top_k(-d2s, m)
-        return jnp.take_along_axis(sid, idx, axis=1), -negd
-
-    beam_ids, d2b = dedup_select(entry, dists(entry), beam)
-
-    def step(t, carry):
-        beam_ids, d2b = carry
-        nbrs = graph[beam_ids].reshape(nq, beam * deg)
-        # a pinch of random exploration per step escapes local minima on
-        # uniform data (the equivalent of CAGRA's pruned long-range edges)
-        rnd = jax.random.randint(
-            jax.random.fold_in(key, t), (nq, deg), 0, n, jnp.int32
+    x2 = (X * X).sum(axis=1)
+    beam_ids, d2b = _search_entry(Q, X, q2, x2, beam)
+    for t in range(iters):  # iters=0 -> entry-sample results only
+        beam_ids, d2b, changed = _search_step(
+            beam_ids, d2b, jnp.int32(t), Q, X, q2, x2, graph, beam
         )
-        ext = jnp.concatenate([nbrs, rnd], axis=1)
-        cand = jnp.concatenate([beam_ids, ext], axis=1)
-        d2c = jnp.concatenate([d2b, dists(ext)], axis=1)
-        return dedup_select(cand, d2c, beam)
-
-    beam_ids, d2b = jax.lax.fori_loop(0, iters, step, (beam_ids, d2b))
+        if not bool(changed):  # concrete scalar: blocks + converts
+            break
     negd, idx = jax.lax.top_k(-d2b, k)
     return -negd, jnp.take_along_axis(beam_ids, idx, axis=1)
 
