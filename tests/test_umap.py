@@ -291,3 +291,22 @@ def test_build_algo_nn_descent_elementwise_metric_falls_back(blobs):
         metric="manhattan", build_algo="nn_descent",
     ).fit(X)
     assert m.embedding_.shape == (len(X), 2)
+
+
+def test_estimator_save_load_roundtrips_build_params(tmp_path, blobs):
+    """build_algo/build_kwds survive estimator persistence (JSON param
+    metadata, reference _CumlEstimatorWriter core.py:268-307)."""
+    est = UMAP(
+        n_neighbors=6, build_algo="nn_descent",
+        build_kwds={"nnd_graph_degree": 12, "nnd_max_iterations": 4},
+    )
+    path = str(tmp_path / "umap_est")
+    est.save(path)
+    loaded = UMAP.load(path)
+    assert loaded._tpu_params["build_algo"] == "nn_descent"
+    assert loaded._tpu_params["build_kwds"] == {
+        "nnd_graph_degree": 12, "nnd_max_iterations": 4,
+    }
+    X, _ = blobs
+    m = loaded.fit(X)
+    assert m.embedding_.shape == (len(X), 2)
