@@ -14,13 +14,22 @@
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from .base import Report, with_benchmark
-from . import gen_data
+if __package__:
+    from . import gen_data
+    from .base import Report, with_benchmark
+else:  # direct-script invocation (README: python benchmark/benchmark_runner.py)
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from benchmark import gen_data
+    from benchmark.base import Report, with_benchmark
 
 
 def _tpu_ds(X, y=None, num_workers=None, label_dtype=None):
@@ -358,6 +367,9 @@ BENCHMARKS: Dict[str, Callable[[Any, Report], None]] = {
 
 
 def main(argv: Optional[list] = None) -> None:
+    from spark_rapids_ml_tpu._jax_env import apply_jax_platforms_env
+
+    apply_jax_platforms_env()
     p = argparse.ArgumentParser(
         description="spark_rapids_ml_tpu benchmark runner "
         "(reference benchmark_runner.py registry)"

@@ -1,0 +1,16 @@
+#
+# One shared handler for the JAX_PLATFORMS override: a sitecustomize may
+# import jax before a process's env is honored, so the env var alone is
+# ignored — the live config update works because backends initialize
+# lazily.
+#
+from __future__ import annotations
+
+import os
+
+
+def apply_jax_platforms_env() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -186,12 +186,9 @@ def main(infile: IO = sys.stdin, outfile: IO = sys.stdout) -> None:
     works — a single line then EOF)."""
     import os
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # a sitecustomize may pre-import jax and ignore the env var; the
-        # live config update works because backends initialize lazily
-        import jax
+    from ._jax_env import apply_jax_platforms_env
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    apply_jax_platforms_env()
     for line in infile:
         line = line.strip()
         if not line:
