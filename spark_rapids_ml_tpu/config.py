@@ -132,16 +132,21 @@ def _coerce(key: str, raw: str) -> Any:
     return raw
 
 
+def _effective_locked(key: str, default: Optional[Any] = None) -> Any:
+    """Effective (env-aware) value; caller must hold _lock (non-reentrant)."""
+    if key in _config:
+        return _config[key]
+    env = os.environ.get(_ENV_PREFIX + key.upper())
+    if env is not None and key in _DEFAULTS:
+        return _coerce(key, env)
+    return _DEFAULTS.get(key, default)
+
+
 def get_config(key: str, default: Optional[Any] = None) -> Any:
     if key not in _DEFAULTS and default is None:
         raise KeyError(f"Unknown config key: {key}")
     with _lock:
-        if key in _config:
-            return _config[key]
-    env = os.environ.get(_ENV_PREFIX + key.upper())
-    if env is not None and key in _DEFAULTS:
-        return _coerce(key, env)
-    return _config.get(key, _DEFAULTS.get(key, default))
+        return _effective_locked(key, default)
 
 
 def _invalidate_traced(old: Any, new: Any) -> None:
@@ -161,20 +166,23 @@ def _invalidate_traced(old: Any, new: Any) -> None:
 
 
 def set_config(**kwargs: Any) -> None:
-    # effective (env-aware) value before/after: the env layer also feeds
-    # get_config, so invalidation must see through it (get_config takes
-    # the lock itself, hence computed outside the critical section)
-    prev = get_config("distance_precision")
+    # read-check-update under ONE lock acquisition so two concurrent
+    # precision changes cannot both observe old==new and skip cache
+    # invalidation; the invalidation itself runs after release (it may
+    # import jax, which must not happen under the config lock)
     with _lock:
+        prev = _effective_locked("distance_precision")
         for k, v in kwargs.items():
             if k not in _DEFAULTS:
                 raise KeyError(f"Unknown config key: {k}")
         _config.update(kwargs)
-    _invalidate_traced(prev, get_config("distance_precision"))
+        new = _effective_locked("distance_precision")
+    _invalidate_traced(prev, new)
 
 
 def reset_config() -> None:
-    prev = get_config("distance_precision")
     with _lock:
+        prev = _effective_locked("distance_precision")
         _config.clear()
-    _invalidate_traced(prev, get_config("distance_precision"))
+        new = _effective_locked("distance_precision")
+    _invalidate_traced(prev, new)

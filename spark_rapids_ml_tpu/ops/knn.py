@@ -161,6 +161,7 @@ def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
     ds, ids = jax.lax.map(one, jnp.arange(nb, dtype=jnp.int32))
     return ds.reshape(qpad, k)[:q], ids.reshape(qpad, k)[:q]
 
+
 @partial(jax.jit, static_argnames=("k", "block", "cblock"))
 def knn_topk_coltiled(items, item_valid, item_ids, queries, k: int,
                       block: int = 1024, cblock: int = 8192):

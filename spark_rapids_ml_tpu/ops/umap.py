@@ -209,6 +209,12 @@ def optimize_embedding(
 
     import numpy as np
 
+    if n_epochs <= 0:
+        # op-level contract: no epochs means the initial embedding verbatim
+        # (the old fori_loop ran zero iterations; the probe dispatch below
+        # would run one epoch and divide by zero in the alpha schedule)
+        return jnp.asarray(emb0)
+
     emb = jnp.asarray(emb0)
     key = jax.random.PRNGKey(seed)
 
