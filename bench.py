@@ -422,10 +422,29 @@ def bench_streaming(extra: dict):
         # counted by the solver itself
         epochs = int(model._model_attributes.get("streaming_epochs", 0)) or 1
         extra["streaming_logreg_2Mx64_fit_sec"] = round(el, 2)
-        extra["streaming_logreg_rows_per_sec_per_epoch"] = round(
-            n * epochs / el, 1
-        )
+        rps = n * epochs / el
+        extra["streaming_logreg_rows_per_sec_per_epoch"] = round(rps, 1)
         extra["streaming_logreg_epochs"] = epochs
+        # north-star arithmetic at the measured per-epoch ingest rate
+        extra["streaming_1Bx256_epoch_projection_hours"] = round(
+            1e9 / (rps * (d / 256.0)) / 3600.0, 2
+        )
+        # host-ingest microbench: the parquet->numpy decode alone (no
+        # device work), the rate that caps every epoch-streaming fit
+        from spark_rapids_ml_tpu.streaming import iter_chunks
+
+        t0 = time.perf_counter()
+        tot = 0
+        for cX, cy, cw, n_c in iter_chunks(
+            path, "features", (), "label", None, 262_144,
+            np.dtype(np.float32),
+        ):
+            tot += n_c
+        ing = time.perf_counter() - t0
+        extra["ingest_rows_per_sec"] = round(tot / ing, 1)
+        extra["ingest_mbytes_per_sec"] = round(
+            tot * d * 4 / ing / 1e6, 1
+        )
     finally:
         reset_config()
         import shutil

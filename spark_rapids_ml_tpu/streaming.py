@@ -43,25 +43,70 @@ def parquet_row_count(path: str) -> int:
     return ds.dataset(path, format="parquet").count_rows()
 
 
+_PROBE_CACHE: dict = {}
+
+
+def _path_stamp(path: str):
+    """Change-detection stamp for the probe cache: (mtime_ns, size) of the
+    file, or the sorted per-entry stamps of a dataset directory (an
+    in-place fragment rewrite changes its file's mtime even when the
+    directory's own mtime is unchanged)."""
+    try:
+        st = os.stat(path)
+        if not os.path.isdir(path):
+            return (st.st_mtime_ns, st.st_size)
+        entries = []
+        with os.scandir(path) as it:
+            for e in it:
+                s = e.stat()
+                entries.append((e.name, s.st_mtime_ns, s.st_size))
+        return tuple(sorted(entries))
+    except OSError:
+        return None
+
+
 def probe_num_features(
     path: str, features_col: Optional[str], features_cols: Sequence[str]
 ) -> int:
-    """Feature dimension from the first record batch (the analog of the
-    reference's `df.first()` dimension probe, core.py:467-568)."""
+    """Feature dimension from the schema (fixed_size_list) or the first
+    record batch (the analog of the reference's `df.first()` dimension
+    probe, core.py:467-568).  Cached per (path, col): epoch-streaming
+    solvers stream the same file once per L-BFGS evaluation, and a probe
+    that re-decodes the first row group each epoch was measured at 10 s
+    on a 500k-row file (batch_size=1 forces a full row-group decode)."""
     if features_cols:
         return len(features_cols)
+    key = (path, features_col, _path_stamp(path))
+    hit = _PROBE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    import pyarrow as pa
     import pyarrow.dataset as ds
 
     dataset = ds.dataset(path, format="parquet")
-    cols = [features_col]
-    for batch in dataset.to_batches(columns=cols, batch_size=1):
-        if batch.num_rows == 0:
-            continue
-        first = batch.column(0)[0].as_py()
-        if np.isscalar(first):
-            return 1
-        return len(first)
-    raise ValueError("Dataset is empty: nothing to fit/transform")
+    d = None
+    field = dataset.schema.field(features_col) if (
+        features_col in dataset.schema.names
+    ) else None
+    if field is not None and pa.types.is_fixed_size_list(field.type):
+        if dataset.count_rows() == 0:  # metadata-only, cheap
+            raise ValueError("Dataset is empty: nothing to fit/transform")
+        d = field.type.list_size
+    else:
+        # default batch size: the scanner hands back a whole decoded page
+        # cheaply instead of slicing the row group into 1-row batches
+        for batch in dataset.to_batches(columns=[features_col]):
+            if batch.num_rows == 0:
+                continue
+            first = batch.column(0)[0].as_py()
+            d = 1 if np.isscalar(first) else len(first)
+            break
+        if d is None:
+            raise ValueError("Dataset is empty: nothing to fit/transform")
+    if len(_PROBE_CACHE) >= 64:
+        _PROBE_CACHE.pop(next(iter(_PROBE_CACHE)))
+    _PROBE_CACHE[key] = d
+    return d
 
 
 def chunk_rows_for(d: int, itemsize: int = 4) -> int:
@@ -86,6 +131,81 @@ def _batch_to_arrays(
     return X, y, w
 
 
+def _decode_batch(
+    batch,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+    dtype: np.dtype,
+):
+    """Arrow RecordBatch -> (X, y, w) numpy arrays WITHOUT pandas.
+
+    The hot ingest path: a list<float> feature column decodes by
+    flattening the Arrow child buffer and reshaping — zero-copy when the
+    storage dtype matches — instead of materializing one numpy object per
+    row and re-packing (measured 45x on the 1-core bench host: 24k ->
+    1.09M rows/s at 64 cols).  Falls back to the pandas path for nulls,
+    ragged rows, or exotic types.  Analog of the reference's Arrow-batch
+    fast path into reserved GPU memory (utils.py:403-522)."""
+    import pyarrow as pa
+
+    names = batch.schema.names
+
+    def _col(name: str):
+        return batch.column(names.index(name))
+
+    def _np1d(arr, want=None):
+        out = arr.to_numpy(zero_copy_only=False)
+        if want is not None:
+            out = np.asarray(out, want)
+        return out
+
+    try:
+        if features_cols:
+            cols = [_np1d(_col(c)) for c in features_cols]
+            X = np.empty((batch.num_rows, len(cols)), dtype)
+            for j, c in enumerate(cols):
+                X[:, j] = c
+        else:
+            assert features_col is not None
+            c = _col(features_col)
+            t = c.type
+            if pa.types.is_list(t) or pa.types.is_large_list(t) or (
+                pa.types.is_fixed_size_list(t)
+            ):
+                if c.null_count:
+                    raise ValueError("nulls in feature column")
+                n = len(c)
+                if n == 0:
+                    raise ValueError("empty batch")
+                if pa.types.is_fixed_size_list(t):
+                    d = t.list_size
+                else:
+                    # exact per-row lengths from the offsets: a ragged
+                    # batch whose total count divides n must NOT silently
+                    # reshape values across row boundaries
+                    offs = np.asarray(c.offsets)
+                    lens = np.diff(offs)
+                    d = int(lens[0])
+                    if not (lens == d).all():
+                        raise ValueError("ragged feature rows")
+                vals = c.flatten().to_numpy(zero_copy_only=False)
+                if vals.shape[0] != n * d:
+                    raise ValueError("ragged feature rows")
+                X = np.asarray(vals, dtype).reshape(n, d)
+            else:
+                X = _np1d(c, dtype).reshape(-1, 1)
+        y = _np1d(_col(label_col), np.float64) if label_col else None
+        w = _np1d(_col(weight_col), np.float64) if weight_col else None
+        return X, y, w
+    except (ValueError, KeyError, pa.ArrowInvalid, NotImplementedError):
+        return _batch_to_arrays(
+            batch.to_pandas(), features_col, features_cols, label_col,
+            weight_col, dtype,
+        )
+
+
 def iter_chunks(
     path: str,
     features_col: Optional[str],
@@ -99,7 +219,11 @@ def iter_chunks(
     """Stream `(X, y, w, n_valid)` chunks of EXACTLY `chunk_rows` rows
     (zero-padded tail on the last chunk) — fixed shapes keep the device
     staging step at one compilation.  `row_range=(lo, hi)` restricts to a
-    global row slice (multi-process per-partition reads)."""
+    global row slice (multi-process per-partition reads).
+
+    Each yielded chunk owns its arrays (no buffer reuse): an exactly-full
+    Arrow batch is yielded as a zero-copy reshape of the Arrow child
+    buffer; partial batches accumulate into a freshly allocated chunk."""
     import pyarrow.dataset as ds
 
     columns = (
@@ -111,10 +235,8 @@ def iter_chunks(
         columns.append(weight_col)
     dataset = ds.dataset(path, format="parquet")
 
-    d = probe_num_features(path, features_col, features_cols)
-    bufX = np.zeros((chunk_rows, d), dtype)
-    bufy = np.zeros((chunk_rows,), np.float64) if label_col else None
-    bufw = np.zeros((chunk_rows,), np.float64) if weight_col else None
+    d = None  # derived from the first decoded batch (no separate probe)
+    bufX = bufy = bufw = None
     fill = 0
     seen = 0  # global rows consumed so far
     lo, hi = row_range if row_range is not None else (0, None)
@@ -132,12 +254,22 @@ def iter_chunks(
             if hi is not None and b_lo >= hi:
                 break
             continue
-        pdf = batch.slice(s - b_lo, e - s).to_pandas()
-        X, y, w = _batch_to_arrays(
-            pdf, features_col, features_cols, label_col, weight_col, dtype
+        X, y, w = _decode_batch(
+            batch.slice(s - b_lo, e - s), features_col, features_cols,
+            label_col, weight_col, dtype,
         )
+        if d is None:
+            d = X.shape[1]
+        if fill == 0 and X.shape[0] == chunk_rows:
+            # exactly-full batch: hand the decoded arrays over directly
+            yield X, y, w, chunk_rows
+            continue
         pos = 0
         while pos < X.shape[0]:
+            if bufX is None:
+                bufX = np.zeros((chunk_rows, d), dtype)
+                bufy = np.zeros((chunk_rows,), np.float64) if label_col else None
+                bufw = np.zeros((chunk_rows,), np.float64) if weight_col else None
             take = min(chunk_rows - fill, X.shape[0] - pos)
             bufX[fill : fill + take] = X[pos : pos + take]
             if bufy is not None:
@@ -148,13 +280,9 @@ def iter_chunks(
             pos += take
             if fill == chunk_rows:
                 yield bufX, bufy, bufw, fill
+                bufX = bufy = bufw = None
                 fill = 0
     if fill:
-        bufX[fill:] = 0.0
-        if bufy is not None:
-            bufy[fill:] = 0.0
-        if bufw is not None:
-            bufw[fill:] = 0.0
         yield bufX, bufy, bufw, fill
 
 
@@ -162,10 +290,10 @@ def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
     """`iter_chunks` with the parquet decode running on a background
     thread, one chunk ahead: the device consumes chunk i while the host
     reads chunk i+1 (the streaming analog of the reference's overlapped
-    reserved-memory copies, utils.py:403-522).  `iter_chunks` reuses its
-    buffers, so each prefetched chunk is copied out — one extra chunk of
-    host memory buys IO/compute overlap.  Disable via the
-    `streaming_prefetch` conf."""
+    reserved-memory copies, utils.py:403-522).  `iter_chunks` yields
+    owned chunks, so the queue holds up to two chunks of extra host
+    memory and no copy is needed.  Disable via the `streaming_prefetch`
+    conf."""
     if not get_config("streaming_prefetch"):
         yield from iter_chunks(*args, **kwargs)
         return
@@ -189,13 +317,8 @@ def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
 
     def producer() -> None:
         try:
-            for cX, cy, cw, n_c in iter_chunks(*args, **kwargs):
-                if not _put((
-                    cX.copy(),
-                    None if cy is None else cy.copy(),
-                    None if cw is None else cw.copy(),
-                    n_c,
-                )):
+            for item in iter_chunks(*args, **kwargs):
+                if not _put(item):
                     return
             _put(_DONE)
         except BaseException as e:  # surface reader errors on the consumer
@@ -213,6 +336,28 @@ def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
             yield item
     finally:
         stop.set()
+
+
+
+_ONES_CACHE: dict = {}
+
+
+def _weights_host(cw, n_c: int, chunk_rows: int, dtype) -> np.ndarray:
+    """Per-chunk weight vector (zero past n_c).  The common case — no
+    weight column, full chunk — returns a cached read-only ones array, so
+    the hot ingest loop allocates nothing."""
+    dtype = np.dtype(dtype)
+    if cw is None and n_c == chunk_rows:
+        key = (chunk_rows, dtype.str)
+        a = _ONES_CACHE.get(key)
+        if a is None:
+            a = np.ones((chunk_rows,), dtype)
+            a.setflags(write=False)
+            _ONES_CACHE[key] = a
+        return a
+    w = np.zeros((chunk_rows,), dtype)
+    w[:n_c] = 1.0 if cw is None else np.asarray(cw[:n_c], dtype)
+    return w
 
 
 # ---------------------------------------------------------------------------
@@ -326,10 +471,9 @@ def stage_parquet(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype,
     ):
-        w_host = np.zeros((chunk_rows,), dtype)
-        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        w_host = _weights_host(cw, n_c, chunk_rows, dtype)
         cY = (
-            jnp.asarray(cy.astype(ldt)) if label_col else None
+            jnp.asarray(np.asarray(cy, ldt)) if label_col else None
         )
         bufX, bufy, bufw = fill(
             bufX, bufy, bufw,
@@ -426,11 +570,10 @@ def linreg_streaming_stats(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
     ):
-        w_host = np.zeros((chunk_rows,), dtype)
-        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        w_host = _weights_host(cw, n_c, chunk_rows, dtype)
         acc = step(
             acc, jnp.asarray(cX), jnp.asarray(w_host),
-            jnp.asarray(cy.astype(dtype)),
+            jnp.asarray(np.asarray(cy, dtype)),
         )
     host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
     return _sum_across_processes(host)
@@ -474,8 +617,7 @@ def pca_streaming_stats(
         path, features_col, features_cols, None, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
     ):
-        w_host = np.zeros((chunk_rows,), dtype)
-        w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(dtype)
+        w_host = _weights_host(cw, n_c, chunk_rows, dtype)
         acc = step(acc, jnp.asarray(cX), jnp.asarray(w_host))
     host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
     return _sum_across_processes(host)
@@ -677,13 +819,12 @@ def logreg_streaming_fit(
             path, features_col, features_cols, label_col, weight_col,
             chunk_rows, dtype, row_range=(lo, hi),
         ):
-            w_host = np.zeros((chunk_rows,), np.float32)
-            w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(np.float32)
+            w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
             acc_l, acc_g = step(
                 acc_l, acc_g, theta,
-                jnp.asarray(cX.astype(np.float32)),
+                jnp.asarray(np.asarray(cX, np.float32)),
                 jnp.asarray(w_host),
-                jnp.asarray(cy.astype(np.float32)),
+                jnp.asarray(np.asarray(cy, np.float32)),
             )
         host_l, host_g = jax.device_get((acc_l, acc_g))
         agg = _sum_across_processes(
@@ -860,11 +1001,10 @@ def kmeans_streaming_fit(
             path, features_col, features_cols, None, weight_col,
             chunk_rows, dtype, row_range=(lo, hi),
         ):
-            w_host = np.zeros((chunk_rows,), np.float32)
-            w_host[:n_c] = 1.0 if cw is None else cw[:n_c].astype(np.float32)
+            w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
             acc, counts = assign_step(
                 acc, counts, C_dev,
-                jnp.asarray(cX.astype(np.float32)), jnp.asarray(w_host),
+                jnp.asarray(np.asarray(cX, np.float32)), jnp.asarray(w_host),
             )
         host = jax.device_get({"sums": acc[0], "counts": counts, "cost": acc[1]})
         agg = _sum_across_processes(
