@@ -468,6 +468,19 @@ def bench_umap(extra: dict):
     extra["umap_100kx32_fit_sec"] = round(el, 3)
     extra["umap_100kx32_rows_per_sec"] = round(n / el, 1)
 
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # 1M-row fit (chip only: the NN-descent graph build alone is
+        # minutes of work the CPU fallback can't carry in the budget)
+        n = 1_000_000
+        X = _rng(7).standard_normal((n, d)).astype("float32")
+        t0 = time.perf_counter()
+        UMAP(n_neighbors=15, n_epochs=50, random_state=0).fit(X)
+        el = time.perf_counter() - t0
+        extra["umap_1Mx32_fit_sec"] = round(el, 3)
+        extra["umap_1Mx32_rows_per_sec"] = round(n / el, 1)
+
 
 _state = {"rows_per_sec": 0.0, "vs_baseline": 0.0, "extra": {}, "printed": False}
 

@@ -97,6 +97,13 @@ _DEFAULTS: Dict[str, Any] = {
     # measured CAGRA recall 0.996 -> 0.58), "high" = 3-pass bf16,
     # "default" = fastest.  Read at trace time.
     "distance_precision": "highest",
+    # UMAP SGD epoch kernel: "auto" picks the scatter-free structured
+    # kernel on TPU backends (unsorted scatter-adds serialize on TPU; the
+    # structured form replaces them with dense sums + one sorted
+    # segment_sum) and the generic scatter kernel elsewhere (CPU scatters
+    # are cheap and the structured form's larger intermediates lose
+    # ~1.7x there); "structured"/"generic" force a kernel.
+    "umap_kernel": "auto",
     # Exact-kNN item sets up to this many bytes replicate on every host
     # (simple model contract); above it, multi-process fits keep feature
     # rows process-local and only the global id vector replicates (the

@@ -56,10 +56,17 @@ def _path_stamp(path: str):
         if not os.path.isdir(path):
             return (st.st_mtime_ns, st.st_size)
         entries = []
-        with os.scandir(path) as it:
-            for e in it:
-                s = e.stat()
-                entries.append((e.name, s.st_mtime_ns, s.st_size))
+        # recurse (hive-partitioned layouts nest fragments) with a cap so
+        # a pathological directory can't make every probe an O(fs) walk
+        for root, _dirs, files in os.walk(path):
+            for f in files:
+                s = os.stat(os.path.join(root, f))
+                entries.append(
+                    (os.path.relpath(os.path.join(root, f), path),
+                     s.st_mtime_ns, s.st_size)
+                )
+                if len(entries) >= 4096:
+                    return tuple(sorted(entries))
         return tuple(sorted(entries))
     except OSError:
         return None

@@ -310,3 +310,47 @@ def test_estimator_save_load_roundtrips_build_params(tmp_path, blobs):
     X, _ = blobs
     m = loaded.fit(X)
     assert m.embedding_.shape == (len(X), 2)
+
+
+def test_structured_kernel_matches_generic_first_epoch(rng):
+    # the scatter-free TPU kernel and the generic scatter kernel are the
+    # same algorithm: bitwise-equal after one epoch (later epochs diverge
+    # only by f32 reduction order, which the SGD dynamics amplify)
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import umap as uops
+
+    n, k = 500, 8
+    knn = np.stack(
+        [rng.choice(n, size=k, replace=False) for _ in range(n)]
+    ).astype(np.int32)
+    heads = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    tails = jnp.asarray(knn.reshape(-1))
+    w = jnp.asarray(rng.uniform(0.1, 1.0, n * k).astype(np.float32))
+    emb0 = jnp.asarray(rng.normal(size=(n, 2)).astype(np.float32))
+    key = jax.random.PRNGKey(3)
+    perm = jnp.argsort(tails)
+    out_s, _ = uops._optimize_epoch_chunk_structured(
+        emb0, key, tails.reshape(n, k), w.reshape(n, k), perm,
+        tails[perm], 0, 1, 50, 1.58, 0.9, 1.0, k, 5, 1.0,
+    )
+    out_g, _ = uops._optimize_epoch_chunk(
+        emb0, key, heads, tails, w, 0, 1, 50, 1.58, 0.9, 1.0, 5, 1.0,
+    )
+    np.testing.assert_array_equal(np.asarray(out_s), np.asarray(out_g))
+
+
+def test_structured_kernel_full_fit_quality(blobs):
+    # force the structured kernel through the whole public fit on CPU and
+    # require the same embedding quality bar as the generic kernel
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    X, _ = blobs
+    set_config(umap_kernel="structured")
+    try:
+        model = UMAP(n_neighbors=12, random_state=0, n_epochs=150).fit(X)
+    finally:
+        reset_config()
+    t = trustworthiness(X, model.embedding_, n_neighbors=12)
+    assert t > 0.85, f"trustworthiness {t}"
