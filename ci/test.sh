@@ -3,11 +3,20 @@
 # CI gate — the analog of the reference's ci/test.sh (lint + unit tests +
 # benchmark smoke; pre-merge vs nightly split via --runslow).
 #
-#   ./ci/test.sh            # pre-merge: lint + fast suite + bench smoke
+#   ./ci/test.sh            # pre-merge: lint + full suite + bench smoke
 #   ./ci/test.sh --runslow  # nightly: adds slow-marked scale tests
+#   ./ci/test.sh --fast     # iteration tier: lint + framework-contract
+#                           # subset (~4 min); NOT a merge gate
 #
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+FAST=0
+ARGS=()
+for a in "$@"; do
+    if [[ "$a" == "--fast" ]]; then FAST=1; else ARGS+=("$a"); fi
+done
+set -- "${ARGS[@]+"${ARGS[@]}"}"
 
 echo "== lint: byte-compile all sources =="
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
@@ -46,6 +55,18 @@ print(f"{len(mods)} modules import cleanly")
 EOF
 
 echo "== unit tests =="
+if [[ $FAST == 1 ]]; then
+    # framework-contract subset: the dummy-estimator contract, param
+    # system, metrics, tuning/pipeline meta layer, streaming ingest, and
+    # one end-to-end algo (PCA) — catches plumbing regressions in ~4 min
+    # so the 20+ min full suite doesn't rot unrun between milestones
+    python -m pytest -q -x \
+        tests/test_common_estimator.py tests/test_metrics.py \
+        tests/test_tuning_pipeline.py tests/test_streaming.py \
+        tests/test_native.py tests/test_pca.py
+    echo "FAST TIER PASSED (not a merge gate)"
+    exit 0
+fi
 python -m pytest tests/ -q "$@"
 
 echo "== benchmark smoke =="

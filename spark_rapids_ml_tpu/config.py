@@ -97,6 +97,14 @@ _DEFAULTS: Dict[str, Any] = {
     # measured CAGRA recall 0.996 -> 0.58), "high" = 3-pass bf16,
     # "default" = fastest.  Read at trace time.
     "distance_precision": "highest",
+    # Per-dispatched-program FLOP budget for solvers that can split their
+    # work across host-dispatched programs (KMeans Lloyd).  The axon
+    # tunnel fails any host transfer issued while >~60 s of device work
+    # is queued (TPU_STATUS_r03.md), so one program must stay well under
+    # that; 2e12 FLOPs is ~40 s at v5e f32 matmul throughput.  Solvers
+    # whose total fitted work exceeds this switch from the fused
+    # single-program fit to stepwise host dispatch.
+    "dispatch_flops_limit": 2e12,
     # UMAP SGD epoch kernel: "auto" picks the scatter-free structured
     # kernel on TPU backends (unsorted scatter-adds serialize on TPU; the
     # structured form replaces them with dense sums + one sorted

@@ -217,23 +217,39 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         }
 
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
-        from ..ops.kmeans import kmeans_fit
+        from ..config import get_config
+        from ..ops.kmeans import kmeans_fit, kmeans_fit_stepwise
 
         p = fit_input.params
         k = int(p["n_clusters"])
         seed = p.get("random_state")
         seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
-        centers, cost, n_iter = kmeans_fit(
-            fit_input.X,
-            fit_input.w,
+        max_iter = int(p["max_iter"])
+        # fused single-program Lloyd until the whole solve could exceed
+        # the per-program device-time budget (45 s dispatch rule); then
+        # host-dispatched per-block iterations
+        n, d = fit_input.X.shape
+        budget = float(get_config("dispatch_flops_limit"))
+        fused_flops = 2.0 * n * d * k * max(max_iter, 1)
+        kwargs = dict(
             k=k,
             seed=seed,
-            max_iter=int(p["max_iter"]),
+            max_iter=max_iter,
             tol=float(p["tol"]),
             init=str(p["init"]),
             init_steps=int(p.get("init_steps") or 2),
             oversample=float(p.get("oversampling_factor") or 2.0),
         )
+        if fused_flops <= budget:
+            fit_fn = kmeans_fit
+        else:
+            fit_fn = kmeans_fit_stepwise
+            kwargs["flops_budget"] = budget
+            self.logger.info(
+                f"KMeans: stepwise host-dispatched Lloyd "
+                f"({fused_flops:.2e} fused FLOPs > budget {budget:.0e})"
+            )
+        centers, cost, n_iter = fit_fn(fit_input.X, fit_input.w, **kwargs)
         return {
             "cluster_centers_": np.asarray(centers),
             "inertia_": float(cost),

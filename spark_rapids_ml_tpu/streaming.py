@@ -51,23 +51,29 @@ def _path_stamp(path: str):
     file, or the sorted per-entry stamps of a dataset directory (an
     in-place fragment rewrite changes its file's mtime even when the
     directory's own mtime is unchanged)."""
+    import zlib
+
     try:
         st = os.stat(path)
         if not os.path.isdir(path):
             return (st.st_mtime_ns, st.st_size)
-        entries = []
-        # recurse (hive-partitioned layouts nest fragments) with a cap so
-        # a pathological directory can't make every probe an O(fs) walk
+        # recurse (hive-partitioned layouts nest fragments), folding every
+        # fragment's (relpath, mtime, size) into one running crc so memory
+        # stays O(1) no matter how many files the dataset holds
+        h = 0
+        count = 0
+        total = 0
         for root, _dirs, files in os.walk(path):
             for f in files:
-                s = os.stat(os.path.join(root, f))
-                entries.append(
-                    (os.path.relpath(os.path.join(root, f), path),
-                     s.st_mtime_ns, s.st_size)
+                full = os.path.join(root, f)
+                s = os.stat(full)
+                h = zlib.crc32(
+                    f"{os.path.relpath(full, path)}|{s.st_mtime_ns}|"
+                    f"{s.st_size}".encode(), h,
                 )
-                if len(entries) >= 4096:
-                    return tuple(sorted(entries))
-        return tuple(sorted(entries))
+                count += 1
+                total += s.st_size
+        return (h, count, total)
     except OSError:
         return None
 
@@ -437,7 +443,11 @@ def stage_parquet(
     mesh = get_mesh(num_workers)
     n_dev = mesh.devices.size
     # chunk-aligned AND device-aligned buffer size, so every
-    # dynamic-update-slice lands fully inside the buffer
+    # dynamic-update-slice lands fully inside the buffer; the chunk never
+    # exceeds the (device-aligned) dataset, or a small dataset would stage
+    # into a full-chunk buffer of mostly padding (30k rows in a 512 MB
+    # chunk = a 2.1M-row device buffer, 70x wasted compute per fit)
+    chunk_rows = min(chunk_rows, max(n_total, 1))
     chunk_rows = -(-chunk_rows // n_dev) * n_dev
     n_padded = -(-n_total // chunk_rows) * chunk_rows
     ldt = np.dtype(label_dtype) if label_dtype is not None else dtype

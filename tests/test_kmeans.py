@@ -145,3 +145,50 @@ def test_single_sample_predict(rng):
         assert m.predict(X[i]) == int(batch[i])
     with pytest.raises(ValueError, match="expects"):
         m.predict(np.zeros(7))
+
+
+def test_stepwise_lloyd_matches_fused(rng):
+    # kmeans_fit_stepwise (host-dispatched blocks, the 45s-dispatch-rule
+    # path for huge n*d*k) must reproduce the fused while_loop fit
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit, kmeans_fit_stepwise
+
+    X = jnp.asarray(rng.normal(size=(3000, 8)).astype(np.float32))
+    w = jnp.ones((3000,), jnp.float32)
+    # random init costs no D2 passes, so the tiny budget below forces
+    # multiple Lloyd blocks per pass WITHOUT subsampling the seeding —
+    # both fits start from identical centers and only blocking differs
+    c_f, cost_f, it_f = kmeans_fit(
+        X, w, k=5, seed=0, max_iter=50, tol=1e-4, init="random"
+    )
+    c_s, cost_s, it_s = kmeans_fit_stepwise(
+        X, w, k=5, seed=0, max_iter=50, tol=1e-4, init="random",
+        flops_budget=2e5,
+    )
+    assert it_s == int(it_f)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(c_s), axis=0), np.sort(np.asarray(c_f), axis=0),
+        rtol=1e-4, atol=1e-4,
+    )
+    np.testing.assert_allclose(float(cost_s), float(cost_f), rtol=1e-4)
+
+
+def test_stepwise_dispatch_through_estimator(rng):
+    # force the estimator's stepwise path via a tiny dispatch budget and
+    # check it agrees with the fused path end to end
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.models.clustering import KMeans
+
+    X = rng.normal(size=(2000, 6)).astype(np.float32)
+    m_fused = KMeans(k=4, seed=1, maxIter=40, initMode="random").fit(X)
+    set_config(dispatch_flops_limit=1e5)
+    try:
+        m_step = KMeans(k=4, seed=1, maxIter=40, initMode="random").fit(X)
+    finally:
+        reset_config()
+    np.testing.assert_allclose(
+        np.sort(m_step.cluster_centers_, axis=0),
+        np.sort(m_fused.cluster_centers_, axis=0),
+        rtol=1e-3, atol=1e-3,
+    )
