@@ -251,6 +251,50 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
         override; default densifies."""
         return False
 
+    def _fit_streaming_csr(self, batch: _ArrayBatch) -> Optional[Dict[str, Any]]:
+        """Fit from blocked-densify sufficient statistics over a host CSR
+        batch (bounded host + device memory).  Estimators with streamed
+        statistics (PCA, LinearRegression) override; default None means
+        the generic whole-densify staging runs instead."""
+        return None
+
+    def _sparse_over_budget(self, batch: _ArrayBatch) -> bool:
+        """Whether a sparse batch's DENSE form exceeds the device budget
+        (or force_streaming_stats is set) — the sparse analog of the
+        parquet streamed-stats decision in `_stage_or_stream`."""
+        from .config import get_config
+        from .data import _is_sparse
+
+        if not _is_sparse(batch.X):
+            return False
+        import jax
+
+        n, d = batch.X.shape
+        itemsize = 4 if self._float32_inputs else 8
+        need = n * d * itemsize  # staged dense bytes
+        budget = (
+            float(get_config("hbm_bytes"))
+            * float(get_config("mem_ratio_for_data"))
+            * len(jax.devices())
+        )
+        return need > budget or bool(get_config("force_streaming_stats"))
+
+    def _maybe_fit_sparse_stats(
+        self, batch: _ArrayBatch
+    ) -> Optional[Dict[str, Any]]:
+        """Route a sparse over-budget batch to the blocked-CSR statistics
+        fit (reference keeps such data CSR end-to-end,
+        classification.py:960-966)."""
+        if not self._sparse_over_budget(batch):
+            return None
+        attrs = self._fit_streaming_csr(batch)
+        if attrs is not None:
+            self.logger.info(
+                "Sparse dataset beyond the device budget: fit from "
+                "blocked-CSR streamed statistics."
+            )
+        return attrs
+
     def _stage_fit_input(
         self,
         batch: _ArrayBatch,
@@ -576,6 +620,8 @@ class _TpuEstimator(Estimator, _TpuCaller):
                         with trace("extract", self.logger):
                             batch = self._extract(dataset)
                             self._validate_input(batch)
+                        attrs = self._maybe_fit_sparse_stats(batch)
+                    if attrs is None:
                         with trace("stage", self.logger):
                             fit_input = self._stage_fit_input(batch)
                         with trace("fit_kernel", self.logger):
@@ -602,7 +648,19 @@ class _TpuEstimator(Estimator, _TpuCaller):
         `_FitMultipleIterator` core.py:1022-1064)."""
         estimator = self.copy()
 
-        if estimator._enable_fit_multiple_in_single_pass():
+        single_pass = estimator._enable_fit_multiple_in_single_pass()
+        if single_pass and not isinstance(dataset, DeviceDataset):
+            probe = estimator._extract(dataset)
+            if estimator._sparse_over_budget(probe) and (
+                type(estimator)._fit_streaming_csr
+                is not _TpuCaller._fit_streaming_csr
+            ):
+                # a sparse over-budget dataset cannot be whole-densified
+                # and staged once; per-model fits route each map through
+                # the blocked-CSR statistics path instead
+                single_pass = False
+
+        if single_pass:
             if isinstance(dataset, DeviceDataset):
                 fit_input = estimator._stage_from_device(dataset)
             else:
@@ -713,10 +771,19 @@ class _TpuModel(Model, _TpuCaller):
             return None
         import jax
 
+        from .data import _is_sparse
         from .parallel.mesh import RowStager, get_mesh
         from .streaming import chunk_rows_for
 
-        X = _ensure_dense(X)
+        sparse_in = _is_sparse(X)
+        if sparse_in:
+            # keep CSR; each chunk densifies separately below, so peak
+            # host memory is one dense chunk (not the whole matrix)
+            X = X.tocsr()
+            x_dtype = np.float32 if self._float32_inputs else np.float64
+        else:
+            X = _ensure_dense(X)
+            x_dtype = X.dtype
         n = int(X.shape[0])
         d = int(X.shape[1]) if X.ndim == 2 else 1
         mesh = get_mesh(
@@ -728,13 +795,16 @@ class _TpuModel(Model, _TpuCaller):
         # floor the chunk to the bucket grid: full chunks then carry ZERO
         # bucket padding and still share one compilation; only the tail
         # chunk buckets up (moot when bucketing is off)
-        chunk = max(int(chunk_rows_for(d, X.dtype.itemsize)), mesh.devices.size)
+        chunk = max(
+            int(chunk_rows_for(d, np.dtype(x_dtype).itemsize)),
+            mesh.devices.size,
+        )
         if get_config("shape_bucketing"):
             chunk = max(bucket_rows_floor(chunk), mesh.devices.size)
         if n == 0:
             # transform one dummy row, trim everything (static-shape kernels
             # can't run on 0 rows)
-            dummy = self._transform_mesh(np.zeros((1, d), X.dtype))
+            dummy = self._transform_mesh(np.zeros((1, d), x_dtype))
             return {c: v[:0] for c, v in dummy.items()}
         from .tracing import trace
 
@@ -746,9 +816,15 @@ class _TpuModel(Model, _TpuCaller):
                 with trace(
                     f"transform_chunk[{lo}:{min(lo + chunk, n)}]", self.logger
                 ):
-                    Xc = np.ascontiguousarray(X[lo : lo + chunk])
+                    hi = min(lo + chunk, n)
+                    if sparse_in:
+                        from .native import densify_csr
+
+                        Xc = densify_csr(X[lo:hi], hi - lo, x_dtype)
+                    else:
+                        Xc = np.ascontiguousarray(X[lo:hi])
                     st = RowStager.for_replicated(Xc.shape[0], mesh)
-                    dev = self._transform_device(st.stage(Xc, X.dtype))
+                    dev = self._transform_device(st.stage(Xc, x_dtype))
                     # fetch the whole chunk before publishing: a failure on a
                     # later column must not leave earlier columns appended
                     # (the retry would duplicate their rows)
@@ -813,9 +889,18 @@ class _TpuModel(Model, _TpuCaller):
             dtype=None,
             supervised=False,
         )
-        X = _ensure_dense(batch.X)
-        dtype = self._out_dtype(X)
-        outputs = self._transform_array(np.asarray(X, dtype=dtype))
+        from .data import _is_sparse
+
+        if _is_sparse(batch.X) and (
+            type(self)._transform_device is not _TpuModel._transform_device
+        ):
+            # keep CSR: _transform_mesh densifies chunk-by-chunk, so peak
+            # host memory is one dense chunk instead of the whole matrix
+            outputs = self._transform_array(batch.X)
+        else:
+            X = _ensure_dense(batch.X)
+            dtype = self._out_dtype(X)
+            outputs = self._transform_array(np.asarray(X, dtype=dtype))
         if isinstance(dataset, pd.DataFrame):
             out_df = dataset.copy()
             for col, values in outputs.items():
@@ -907,10 +992,18 @@ class _CombinedModel:
             dtype=None,
             supervised=False,
         )
-        X = _ensure_dense(batch.X)
+        from .data import _is_sparse
+
+        keep_sparse = _is_sparse(batch.X) and all(
+            type(m)._transform_device is not _TpuModel._transform_device
+            for m in self.models
+        )
+        X = batch.X if keep_sparse else _ensure_dense(batch.X)
         results = []
         for m in self.models:
-            outputs = m._transform_array(np.asarray(X, dtype=m._out_dtype(X)))
+            outputs = m._transform_array(
+                X if keep_sparse else np.asarray(X, dtype=m._out_dtype(X))
+            )
             cols: Dict[str, Any] = {}
             for col, values in outputs.items():
                 vals: Any = values

@@ -127,6 +127,22 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
 
         fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
         st = pca_streaming_stats(path, fcol, fcols, weight_col, dtype=dtype)
+        return self._attrs_from_moments(st, dtype)
+
+    def _fit_streaming_csr(self, batch) -> Dict[str, Any]:
+        """Sparse fit from blocked-densify second moments
+        (streaming.py `pca_stats_from_csr`): exact, with one dense row
+        block of host memory — the TPU analog of the reference's CSR PCA
+        staging (core.py:220-265)."""
+        from ..streaming import pca_stats_from_csr
+
+        dtype = np.float32 if self._float32_inputs else np.float64
+        st = pca_stats_from_csr(
+            batch.X.tocsr(), batch.weight, dtype=dtype
+        )
+        return self._attrs_from_moments(st, dtype)
+
+    def _attrs_from_moments(self, st: Dict[str, Any], dtype) -> Dict[str, Any]:
         S, s1, sw = np.asarray(st["S"]), np.asarray(st["s1"]), float(st["sw"])
         d = S.shape[0]
         k = int(self._tpu_params.get("n_components") or d)

@@ -255,7 +255,6 @@ class LinearRegression(
         """Beyond-HBM fit from multi-pass streamed sufficient statistics
         (streaming.py `linreg_streaming_stats`); the host solve is the same
         `solve_linear_host` the in-memory path uses."""
-        from ..ops.linear import solve_linear_host
         from ..streaming import linreg_streaming_stats
 
         fcol, fcols, label_col, weight_col, dtype = self._streaming_io_params()
@@ -264,6 +263,24 @@ class LinearRegression(
         st = linreg_streaming_stats(
             path, fcol, fcols, label_col, weight_col, dtype=dtype
         )
+        return self._attrs_from_stats(st, dtype)
+
+    def _fit_streaming_csr(self, batch) -> Dict[str, Any]:
+        """Sparse fit from blocked-densify sufficient statistics
+        (streaming.py `linreg_stats_from_csr`): exact, with one dense row
+        block of host memory — the analog of the reference's CSR path
+        (classification.py:960-966 applied to the normal equations)."""
+        from ..streaming import linreg_stats_from_csr
+
+        dtype = np.float32 if self._float32_inputs else np.float64
+        st = linreg_stats_from_csr(
+            batch.X.tocsr(), np.asarray(batch.y), batch.weight, dtype=dtype
+        )
+        return self._attrs_from_stats(st, dtype)
+
+    def _attrs_from_stats(self, st: Dict[str, Any], dtype) -> Dict[str, Any]:
+        from ..ops.linear import solve_linear_host
+
         p = self._tpu_params
         coef, intercept, diag = solve_linear_host(
             np.asarray(st["gram"]),

@@ -539,28 +539,12 @@ def _sum_across_processes(host_stats: dict) -> dict:
     return out
 
 
-def linreg_streaming_stats(
-    path: str,
-    features_col: Optional[str],
-    features_cols: Sequence[str],
-    label_col: str,
-    weight_col: Optional[str],
-    dtype=np.float32,
-    chunk_rows: Optional[int] = None,
-) -> dict:
-    """Weighted Gram/moment/cross statistics (ops/linear.py
-    `linreg_sufficient_stats`) accumulated chunk-by-chunk: the dataset is
-    bounded by neither host RAM nor HBM.  Returns host-side float64 stats
-    summed across processes."""
+def _linreg_acc(d: int, dtype):
+    """(initial accumulator, donated jitted step) for the weighted
+    Gram/moment/cross statistics (ops/linear.py `linreg_sufficient_stats`)
+    — shared by the parquet-streaming and blocked-CSR fits."""
     import jax
     import jax.numpy as jnp
-
-    dtype = np.dtype(dtype)
-    d = probe_num_features(path, features_col, features_cols)
-    if chunk_rows is None:
-        chunk_rows = chunk_rows_for(d, dtype.itemsize)
-    n_total = parquet_row_count(path)
-    lo, hi = _process_row_range(n_total)
 
     def _step(acc, X, w, y):
         Xw = X * w[:, None]
@@ -573,8 +557,6 @@ def linreg_streaming_stats(
             "syy": acc["syy"] + (y * y * w).sum(),
         }
 
-    step = jax.jit(_step, donate_argnums=0)
-    # accumulate in f32 on device (MXU matmuls); final sums come back f64
     acc = {
         "gram": jnp.zeros((d, d), dtype),
         "sxy": jnp.zeros((d,), dtype),
@@ -583,6 +565,83 @@ def linreg_streaming_stats(
         "sy": jnp.zeros((), dtype),
         "syy": jnp.zeros((), dtype),
     }
+    return acc, jax.jit(_step, donate_argnums=0)
+
+
+def _pca_acc(d: int, dtype):
+    """(initial accumulator, donated jitted step) for the PCA second
+    moments (S = sum w x x^T, s1, sw)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _step(acc, X, w):
+        Xw = X * w[:, None]
+        return {
+            "S": acc["S"] + Xw.T @ X,
+            "s1": acc["s1"] + Xw.sum(axis=0),
+            "sw": acc["sw"] + w.sum(),
+        }
+
+    acc = {
+        "S": jnp.zeros((d, d), dtype),
+        "s1": jnp.zeros((d,), dtype),
+        "sw": jnp.zeros((), dtype),
+    }
+    return acc, jax.jit(_step, donate_argnums=0)
+
+
+def iter_csr_chunks(
+    csr,
+    y: Optional[np.ndarray],
+    w: Optional[np.ndarray],
+    chunk_rows: int,
+    dtype: np.dtype,
+) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray], int]]:
+    """Blocked densify of a host CSR matrix: yields dense `(X, y, w,
+    n_valid)` row blocks of at most `chunk_rows` rows (native
+    `densify_csr` per block), so peak host memory is one dense block —
+    the TPU answer to the reference's CSR staging for datasets whose
+    dense form doesn't fit (reference core.py:220-265,
+    classification.py:960-966)."""
+    from .native import densify_csr
+
+    n = csr.shape[0]
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        rows = hi - lo
+        Xb = densify_csr(csr[lo:hi], rows, dtype)
+        wb = (
+            np.ones((rows,), dtype)
+            if w is None
+            else np.asarray(w[lo:hi], dtype)
+        )
+        yield Xb, None if y is None else y[lo:hi], wb, rows
+
+
+def linreg_streaming_stats(
+    path: str,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: str,
+    weight_col: Optional[str],
+    dtype=np.float32,
+    chunk_rows: Optional[int] = None,
+) -> dict:
+    """Weighted Gram/moment/cross statistics accumulated chunk-by-chunk:
+    the dataset is bounded by neither host RAM nor HBM.  Returns host-side
+    float64 stats summed across processes."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    d = probe_num_features(path, features_col, features_cols)
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+    n_total = parquet_row_count(path)
+    lo, hi = _process_row_range(n_total)
+
+    # accumulate in f32 on device (MXU matmuls); final sums come back f64
+    acc, step = _linreg_acc(d, dtype)
     for cX, cy, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
@@ -594,6 +653,43 @@ def linreg_streaming_stats(
         )
     host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
     return _sum_across_processes(host)
+
+
+def _acc_to_host_f64(acc) -> dict:
+    """Device accumulator -> float64 host dict, summed across processes
+    (multi-process batches hold only local rows, like the parquet path)."""
+    import jax
+
+    host = {
+        k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()
+    }
+    return _sum_across_processes(host)
+
+
+def linreg_stats_from_csr(
+    csr,
+    y: np.ndarray,
+    weight: Optional[np.ndarray],
+    dtype=np.float32,
+    chunk_rows: Optional[int] = None,
+) -> dict:
+    """`linreg_streaming_stats` over a host CSR matrix via blocked
+    densify: exact sparse sufficient statistics with one dense block of
+    host memory and a (d,d) device accumulator."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    d = int(csr.shape[1])
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+    acc, step = _linreg_acc(d, dtype)
+    for Xb, yb, wb, _rows in iter_csr_chunks(csr, y, weight, chunk_rows, dtype):
+        acc = step(
+            acc, jnp.asarray(Xb), jnp.asarray(wb),
+            jnp.asarray(np.asarray(yb, dtype)),
+        )
+    return _acc_to_host_f64(acc)
 
 
 def pca_streaming_stats(
@@ -616,20 +712,7 @@ def pca_streaming_stats(
     n_total = parquet_row_count(path)
     lo, hi = _process_row_range(n_total)
 
-    def _step(acc, X, w):
-        Xw = X * w[:, None]
-        return {
-            "S": acc["S"] + Xw.T @ X,
-            "s1": acc["s1"] + Xw.sum(axis=0),
-            "sw": acc["sw"] + w.sum(),
-        }
-
-    step = jax.jit(_step, donate_argnums=0)
-    acc = {
-        "S": jnp.zeros((d, d), dtype),
-        "s1": jnp.zeros((d,), dtype),
-        "sw": jnp.zeros((), dtype),
-    }
+    acc, step = _pca_acc(d, dtype)
     for cX, _, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, None, weight_col,
         chunk_rows, dtype, row_range=(lo, hi),
@@ -638,6 +721,26 @@ def pca_streaming_stats(
         acc = step(acc, jnp.asarray(cX), jnp.asarray(w_host))
     host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
     return _sum_across_processes(host)
+
+
+def pca_stats_from_csr(
+    csr,
+    weight: Optional[np.ndarray],
+    dtype=np.float32,
+    chunk_rows: Optional[int] = None,
+) -> dict:
+    """`pca_streaming_stats` over a host CSR matrix via blocked densify."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    d = int(csr.shape[1])
+    if chunk_rows is None:
+        chunk_rows = chunk_rows_for(d, dtype.itemsize)
+    acc, step = _pca_acc(d, dtype)
+    for Xb, _, wb, _rows in iter_csr_chunks(csr, None, weight, chunk_rows, dtype):
+        acc = step(acc, jnp.asarray(Xb), jnp.asarray(wb))
+    return _acc_to_host_f64(acc)
 
 
 # ---------------------------------------------------------------------------

@@ -140,3 +140,145 @@ def test_ell_conversion(rng):
         for k in range(3):
             rec[i, cols[i, k]] += vals[i, k]
     np.testing.assert_array_equal(rec, dense)
+
+
+# ---------------------------------------------------------------------------
+# Sparse breadth beyond LogReg (VERDICT r3 item 6): blocked-densify
+# sufficient statistics for PCA / LinearRegression, chunked sparse
+# transform, sparse kNN, and the int64-index CSR story.
+# ---------------------------------------------------------------------------
+
+
+def _sparse_blobs(rng, n=3000, d=40, density=0.08):
+    import scipy.sparse as sp
+
+    X = sp.random(
+        n, d, density=density, format="csr", dtype=np.float64,
+        random_state=np.random.RandomState(7),
+    )
+    return X
+
+
+def test_sparse_pca_blocked_stats_match_dense(rng):
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.feature import PCA
+
+    Xs = _sparse_blobs(rng)
+    dense = np.asarray(Xs.todense())
+    m_dense = PCA(k=4).fit(dense)
+    # force the blocked-CSR streamed-statistics path with a tiny chunk
+    set_config(force_streaming_stats=True, host_batch_bytes=64 * 1024)
+    try:
+        m_sparse = PCA(k=4).fit(Xs)
+    finally:
+        reset_config()
+    np.testing.assert_allclose(
+        np.abs(m_sparse.components_), np.abs(m_dense.components_),
+        rtol=2e-3, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        m_sparse.explained_variance_, m_dense.explained_variance_,
+        rtol=2e-3, atol=1e-6,
+    )
+
+
+def test_sparse_linreg_blocked_stats_match_dense(rng):
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    Xs = _sparse_blobs(rng)
+    beta = rng.normal(size=(40,))
+    y = np.asarray(Xs @ beta) + 0.01 * rng.normal(size=(3000,))
+    dense = np.asarray(Xs.todense())
+    m_dense = LinearRegression(regParam=1e-3).fit((dense, y))
+    set_config(force_streaming_stats=True, host_batch_bytes=64 * 1024)
+    try:
+        m_sparse = LinearRegression(regParam=1e-3).fit((Xs, y))
+    finally:
+        reset_config()
+    np.testing.assert_allclose(
+        m_sparse.coefficients, m_dense.coefficients, rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        m_sparse.intercept, m_dense.intercept, rtol=1e-3, atol=1e-4
+    )
+
+
+def test_sparse_chunked_transform_matches_dense(rng):
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.feature import PCA
+
+    Xs = _sparse_blobs(rng)
+    dense = np.asarray(Xs.todense())
+    model = PCA(k=3).fit(dense)
+    out_dense = np.asarray(model.transform(dense))
+    # tiny chunks force several densify-stage-transform rounds
+    set_config(host_batch_bytes=64 * 1024)
+    try:
+        out_sparse = np.asarray(model.transform(Xs))
+    finally:
+        reset_config()
+    np.testing.assert_allclose(out_sparse, out_dense, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_knn_matches_dense(rng):
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+
+    Xs = _sparse_blobs(rng, n=800, d=24, density=0.15)
+    dense = np.asarray(Xs.todense())
+    _, _, knn_s = NearestNeighbors(k=5).fit(Xs).kneighbors(Xs[:100])
+    _, _, knn_d = NearestNeighbors(k=5).fit(dense).kneighbors(dense[:100])
+    np.testing.assert_array_equal(
+        np.asarray(list(knn_s["indices"])), np.asarray(list(knn_d["indices"]))
+    )
+
+
+def test_int64_index_csr_fit(rng):
+    # the analog of the reference's >1e9-nnz int64 switch
+    # (classification.py:960-966): a CSR whose indices/indptr are int64
+    # must stage and fit identically to the int32 form
+    import scipy.sparse as sp
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    Xs = _sparse_blobs(rng, n=2000, d=30, density=0.1).astype(np.float32)
+    y = (np.asarray(Xs.sum(axis=1)).ravel() > Xs.sum() / 2000).astype(np.float64)
+    X64 = Xs.copy()
+    # scipy's ctor downcasts small indices; assign the arrays directly so
+    # the int64 layout (what a >2^31-nnz matrix is forced into) survives
+    X64.indices = X64.indices.astype(np.int64)
+    X64.indptr = X64.indptr.astype(np.int64)
+    assert X64.indices.dtype == np.int64
+    m32 = LogisticRegression(regParam=1e-3, maxIter=30).fit((Xs, y))
+    m64 = LogisticRegression(regParam=1e-3, maxIter=30).fit((X64, y))
+    np.testing.assert_allclose(
+        np.asarray(m32.coefficients), np.asarray(m64.coefficients),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_sparse_transform_never_whole_densifies(rng, monkeypatch):
+    # the chunked path must be REACHABLE through the public transform():
+    # every densify call is bounded by the chunk size, never the full n
+    from spark_rapids_ml_tpu import native
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.feature import PCA
+
+    Xs = _sparse_blobs(rng, n=4000, d=32)
+    model = PCA(k=3).fit(np.asarray(Xs.todense()))
+
+    seen = []
+    real = native.densify_csr
+
+    def spy(csr, n_pad, dtype):
+        seen.append(csr.shape[0])
+        return real(csr, n_pad, dtype)
+
+    monkeypatch.setattr(native, "densify_csr", spy)
+    set_config(host_batch_bytes=64 * 1024)  # ~512-row chunks at d=32
+    try:
+        model.transform(Xs)
+    finally:
+        reset_config()
+    assert seen, "sparse transform never reached the blocked densify"
+    assert max(seen) < 4000, f"whole-matrix densify happened: {seen}"
