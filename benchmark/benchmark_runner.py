@@ -33,11 +33,38 @@ else:  # direct-script invocation (README: python benchmark/benchmark_runner.py)
 
 
 def _tpu_ds(X, y=None, num_workers=None, label_dtype=None):
+    import jax
+
     from spark_rapids_ml_tpu import DeviceDataset
 
+    if jax.process_count() > 1:
+        # pod runs (benchmark/pod/launch.py) generate the same global
+        # dataset in every process; each stages ONLY its row slice — the
+        # per-partition loading contract of RowStager multi-process mode
+        if y is not None:
+            X, y = _proc_slice(X, y)
+        else:
+            X = _proc_slice(X)
     return DeviceDataset.from_host(
         X, y=y, num_workers=num_workers, label_dtype=label_dtype
     )
+
+
+def _proc_slice(X, y=None):
+    """This process's contiguous row slice in a pod run (identity when
+    single-process) — for workloads that fit host arrays directly."""
+    import jax
+
+    if jax.process_count() == 1:
+        return (X, y) if y is not None else X
+    n = X.shape[0]
+    pid, n_proc = jax.process_index(), jax.process_count()
+    base, rem = divmod(n, n_proc)
+    lo = pid * base + min(pid, rem)
+    hi = lo + base + (1 if pid < rem else 0)
+    if y is not None:
+        return X[lo:hi], y[lo:hi]
+    return X[lo:hi]
 
 
 def bench_pca(args, report: Report) -> None:
@@ -263,7 +290,9 @@ def bench_nearest_neighbors(args, report: Report) -> None:
 
         model, fit_s = with_benchmark(
             "tpu fit",
-            lambda: NearestNeighbors(k=k, num_workers=args.num_workers).fit(X),
+            lambda: NearestNeighbors(
+                k=k, num_workers=args.num_workers
+            ).fit(_proc_slice(X)),
         )
         model._search(X[:n_q], k)  # warmup compile
         _, search_s = with_benchmark(
@@ -305,7 +334,7 @@ def bench_approximate_nearest_neighbors(args, report: Report) -> None:
         lambda: ApproximateNearestNeighbors(
             k=k, algorithm=args.algorithm, algoParams=algo_params,
             num_workers=args.num_workers,
-        ).fit(X),
+        ).fit(_proc_slice(X)),
     )
     model._search(X[:n_q], k)  # warmup compile
     (dist, pos), search_s = with_benchmark(
@@ -338,7 +367,9 @@ def bench_umap(args, report: Report) -> None:
 
     model, fit_s = with_benchmark(
         "tpu fit",
-        lambda: UMAP(n_neighbors=15, n_epochs=200, random_state=args.seed).fit(X),
+        lambda: UMAP(
+            n_neighbors=15, n_epochs=200, random_state=args.seed
+        ).fit(_proc_slice(X)),
     )
     _, tr_s = with_benchmark(
         "tpu transform", lambda: model._transform_array(X[:10_000])

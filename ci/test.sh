@@ -54,6 +54,16 @@ for m in mods:
 print(f"{len(mods)} modules import cleanly")
 EOF
 
+echo "== docs: generate API reference =="
+JAX_PLATFORMS=cpu python docs/gen_api_docs.py
+# fail on drift: the committed pages must match the generated ones
+# (porcelain also catches untracked pages, which `git diff` cannot see)
+if [ -n "$(git status --porcelain -- docs/api)" ]; then
+    echo "docs/api is stale — commit the regenerated pages:"
+    git status --porcelain -- docs/api
+    exit 1
+fi
+
 echo "== unit tests =="
 if [[ $FAST == 1 ]]; then
     # framework-contract subset: the dummy-estimator contract, param
@@ -72,6 +82,10 @@ python -m pytest tests/ -q "$@"
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \
     JAX_PLATFORMS=cpu python bench.py
+
+echo "== pod benchmark smoke (2-process jax.distributed) =="
+python benchmark/pod/launch.py --num_processes 2 --devices_per_process 2 \
+    -- kmeans --num_rows 20000 --num_cols 16 --mode tpu --max_iter 10
 
 echo "== notebooks: execute on the CPU mesh =="
 for nb in notebooks/*.ipynb; do

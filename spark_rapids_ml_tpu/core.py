@@ -258,26 +258,34 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
         the generic whole-densify staging runs instead."""
         return None
 
-    def _sparse_over_budget(self, batch: _ArrayBatch) -> bool:
-        """Whether a sparse batch's DENSE form exceeds the device budget
-        (or force_streaming_stats is set) — the sparse analog of the
-        parquet streamed-stats decision in `_stage_or_stream`."""
-        from .config import get_config
-        from .data import _is_sparse
-
-        if not _is_sparse(batch.X):
-            return False
+    def _over_device_budget(self, need_bytes: float) -> bool:
+        """Whether a staged dataset estimate exceeds the device-memory
+        budget (or force_streaming_stats is set) — ONE formula for the
+        parquet and sparse streamed-stats decisions."""
         import jax
 
-        n, d = batch.X.shape
-        itemsize = 4 if self._float32_inputs else 8
-        need = n * d * itemsize  # staged dense bytes
+        from .config import get_config
+
         budget = (
             float(get_config("hbm_bytes"))
             * float(get_config("mem_ratio_for_data"))
             * len(jax.devices())
         )
-        return need > budget or bool(get_config("force_streaming_stats"))
+        return need_bytes > budget or bool(
+            get_config("force_streaming_stats")
+        )
+
+    def _sparse_over_budget(self, batch: _ArrayBatch) -> bool:
+        """Whether a sparse batch's DENSE form exceeds the device budget
+        — the sparse analog of the parquet streamed-stats decision."""
+        from .data import _is_sparse
+
+        if not _is_sparse(batch.X):
+            return False
+        n, d = batch.X.shape
+        return self._over_device_budget(
+            n * d * np.dtype(self._out_dtype(batch.X)).itemsize
+        )
 
     def _maybe_fit_sparse_stats(
         self, batch: _ArrayBatch
@@ -515,26 +523,14 @@ class _TpuEstimator(Estimator, _TpuCaller):
             return None  # CSR staging needs the host matrix
         fcol, fcols, label_col, weight_col, dtype = self._streaming_io_params()
         if self._supports_streaming_stats():
-            import jax
-
             n = parquet_row_count(path)
             d = probe_num_features(path, fcol, fcols)
             need = n * d * np.dtype(dtype).itemsize
-            budget = (
-                float(get_config("hbm_bytes"))
-                * float(get_config("mem_ratio_for_data"))
-                * len(jax.devices())
-            )
-            if need > budget or get_config("force_streaming_stats"):
-                why = (
-                    f"~{need/2**30:.1f} GiB exceeds the device budget "
-                    f"({budget/2**30:.1f} GiB)"
-                    if need > budget
-                    else "force_streaming_stats is set"
-                )
+            if self._over_device_budget(need):
                 self.logger.info(
-                    f"Dataset {why}; fitting from multi-pass streamed "
-                    "statistics."
+                    f"Dataset (~{need/2**30:.1f} GiB) beyond the device "
+                    "budget or force_streaming_stats set; fitting from "
+                    "multi-pass streamed statistics."
                 )
                 return self._fit_streaming(path)
         ds_dev = fit_input = None
@@ -649,22 +645,27 @@ class _TpuEstimator(Estimator, _TpuCaller):
         estimator = self.copy()
 
         single_pass = estimator._enable_fit_multiple_in_single_pass()
-        if single_pass and not isinstance(dataset, DeviceDataset):
-            probe = estimator._extract(dataset)
-            if estimator._sparse_over_budget(probe) and (
-                type(estimator)._fit_streaming_csr
-                is not _TpuCaller._fit_streaming_csr
-            ):
-                # a sparse over-budget dataset cannot be whole-densified
-                # and staged once; per-model fits route each map through
-                # the blocked-CSR statistics path instead
+        batch = None
+        if (
+            single_pass
+            and not isinstance(dataset, DeviceDataset)
+            and type(estimator)._fit_streaming_csr
+            is not _TpuCaller._fit_streaming_csr
+        ):
+            # extract ONCE: the same batch either proves the dataset is a
+            # sparse over-budget one (per-model fits route each map
+            # through the blocked-CSR statistics path; whole-densify
+            # staging is impossible) or is reused for staging below
+            batch = estimator._extract(dataset)
+            if estimator._sparse_over_budget(batch):
                 single_pass = False
 
         if single_pass:
             if isinstance(dataset, DeviceDataset):
                 fit_input = estimator._stage_from_device(dataset)
             else:
-                batch = estimator._extract(dataset)
+                if batch is None:
+                    batch = estimator._extract(dataset)
                 estimator._validate_input(batch)
                 fit_input = estimator._stage_fit_input(batch)
 
@@ -780,7 +781,7 @@ class _TpuModel(Model, _TpuCaller):
             # keep CSR; each chunk densifies separately below, so peak
             # host memory is one dense chunk (not the whole matrix)
             X = X.tocsr()
-            x_dtype = np.float32 if self._float32_inputs else np.float64
+            x_dtype = self._out_dtype(X)
         else:
             X = _ensure_dense(X)
             x_dtype = X.dtype

@@ -136,7 +136,7 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
         staging (core.py:220-265)."""
         from ..streaming import pca_stats_from_csr
 
-        dtype = np.float32 if self._float32_inputs else np.float64
+        dtype = self._out_dtype(batch.X)
         st = pca_stats_from_csr(
             batch.X.tocsr(), batch.weight, dtype=dtype
         )

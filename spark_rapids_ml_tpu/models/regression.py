@@ -272,7 +272,7 @@ class LinearRegression(
         (classification.py:960-966 applied to the normal equations)."""
         from ..streaming import linreg_stats_from_csr
 
-        dtype = np.float32 if self._float32_inputs else np.float64
+        dtype = self._out_dtype(batch.X)
         st = linreg_stats_from_csr(
             batch.X.tocsr(), np.asarray(batch.y), batch.weight, dtype=dtype
         )

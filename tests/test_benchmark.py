@@ -8,7 +8,8 @@ import sys
 import numpy as np
 import pytest
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 from benchmark import gen_data
 from benchmark.benchmark_runner import BENCHMARKS, main
@@ -102,3 +103,26 @@ def test_gen_data_distributed_kinds(tmp_path):
         )
         t = pq.read_table(out)
         assert t.num_rows == 300, kind
+
+
+def test_pod_launcher_two_process(tmp_path):
+    # the pod benchmark launcher (benchmark/pod/launch.py) must run a
+    # registered workload across 2 jax.distributed processes and write
+    # rank 0's CSV report
+    import subprocess
+    import sys
+
+    report = tmp_path / "pod.csv"
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "benchmark", "pod", "launch.py"),
+            "--num_processes", "2", "--devices_per_process", "2",
+            "--", "kmeans", "--num_rows", "8000", "--num_cols", "8",
+            "--mode", "tpu", "--max_iter", "5", "--report", str(report),
+        ],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert report.exists()
+    content = report.read_text()
+    assert "kmeans" in content and "inertia" in content
