@@ -86,12 +86,19 @@ def _run_shard(
     if process_id != 0:
         # only rank 0 writes the CSV report; other ranks participate in
         # the collectives and discard their local copy
-        for i, a in enumerate(runner_args):
-            if a == "--report" and i + 1 < len(runner_args):
-                runner_args = (
-                    runner_args[:i] + runner_args[i + 2 :]
-                )
-                break
+        kept = []
+        skip = False
+        for a in runner_args:
+            if skip:
+                skip = False
+                continue
+            if a == "--report":
+                skip = True  # drop the following path token too
+                continue
+            if a.startswith("--report="):
+                continue
+            kept.append(a)
+        runner_args = kept
     from benchmark import benchmark_runner
 
     return benchmark_runner.main(runner_args)
@@ -109,8 +116,9 @@ def main(argv=None) -> int:
     ap.add_argument("--coordinator", default=None,
                     help="host:port of process 0 (pod mode)")
     ap.add_argument("--process_id", type=int, default=0)
-    ap.add_argument("--platform", default="cpu", choices=("cpu", "tpu"),
-                    help="cpu = virtual-device emulation; tpu = real chips")
+    ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                    help="default: tpu in --pod mode (real chips), cpu for "
+                    "local emulation")
     ap.add_argument("runner_args", nargs=argparse.REMAINDER,
                     help="-- then benchmark_runner.py args verbatim")
     args = ap.parse_args(argv)
@@ -123,9 +131,10 @@ def main(argv=None) -> int:
     if args.pod:
         if args.num_processes > 1 and not args.coordinator:
             ap.error("--pod with >1 process requires --coordinator")
+        # a real pod invocation means real chips unless told otherwise
         return _run_shard(
             args.coordinator or "", args.process_id, args.num_processes,
-            runner_args, args.platform, args.devices_per_process,
+            runner_args, args.platform or "tpu", args.devices_per_process,
         )
 
     # local emulation: spawn one subprocess per "host"
@@ -138,7 +147,7 @@ def main(argv=None) -> int:
             "--process_id", str(pid),
             "--num_processes", str(args.num_processes),
             "--devices_per_process", str(args.devices_per_process),
-            "--platform", args.platform,
+            "--platform", args.platform or "cpu",
             "--", *runner_args,
         ]
         procs.append(

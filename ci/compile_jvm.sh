@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+#
+# JVM plugin compile gate — the analog of the reference's sbt build of
+# jvm/ (its Plugin + wrappers + SparkRapidsMLSuite).  Behavior:
+#
+#   * scalac or sbt present  -> real compilation (sbt package when the
+#     Spark provided-deps are resolvable; scalac -Ystop-after:parser as
+#     the minimum syntax proof otherwise), hard gate.
+#   * neither present (this air-gapped image ships NO JVM — documented
+#     in jvm/README.md) -> the structural gate
+#     (ci/jvm_structural_check.py) runs instead: brace balancing,
+#     ServiceLoader registration resolution, Plugin target resolution,
+#     operator dispatchability, ModelBuilder field inventory.  The
+#     runtime half (field-by-field worker golden tests) runs in the
+#     pytest suite (tests/test_jvm_protocol.py).
+#
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if command -v sbt >/dev/null 2>&1; then
+    echo "== jvm: sbt compile =="
+    (cd jvm && sbt -batch compile) | tee /tmp/jvm_compile.log
+elif command -v scalac >/dev/null 2>&1; then
+    echo "== jvm: scalac syntax gate =="
+    # full typecheck needs the Spark provided jars; the parser stage
+    # proves the sources are syntactically valid Scala
+    scalac -Ystop-after:parser -d /tmp/jvm_classes \
+        $(find jvm/src/main/scala -name '*.scala') | tee /tmp/jvm_compile.log
+else
+    echo "== jvm: no JVM toolchain in this image — structural gate =="
+    JAX_PLATFORMS=cpu python ci/jvm_structural_check.py
+fi
+echo "JVM GATE PASSED"

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+#
+# JVM plugin structural gate — the compiler-less half of ci/compile_jvm.sh.
+# This image ships no JVM/scalac (documented in jvm/README.md), so CI
+# cannot run `sbt compile`; what CAN be machine-checked without one is
+# checked here, hard-failing on drift:
+#
+#   1. every .scala file token-balances its braces/parens/brackets
+#      (comments and string literals stripped),
+#   2. every class registered in META-INF/services exists in the sources
+#      under exactly the declared package,
+#   3. every substitution target in Plugin.transform exists,
+#   4. every estimator wrapper's `operatorName` is dispatchable by the
+#      Python worker (spark_rapids_ml_tpu/connect_plugin.py),
+#   5. every `attrs \ "field"` the ModelBuilder reads is produced by the
+#      worker's fit for that algorithm (field-by-field; the runtime
+#      equivalent lives in tests/test_jvm_protocol.py).
+#
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+JVM = os.path.join(REPO, "jvm", "src", "main")
+
+
+def strip_scala(src: str) -> str:
+    """Remove comments and string literals (good enough for balancing)."""
+    src = re.sub(r'"""(?:.|\n)*?"""', '""', src)
+    src = re.sub(r'"(?:[^"\\\n]|\\.)*"', '""', src)
+    src = re.sub(r"//[^\n]*", "", src)
+    src = re.sub(r"/\*(?:.|\n)*?\*/", "", src, flags=re.S)
+    return src
+
+
+def scala_files() -> list:
+    out = []
+    for root, _dirs, files in os.walk(os.path.join(JVM, "scala")):
+        out += [os.path.join(root, f) for f in files if f.endswith(".scala")]
+    return sorted(out)
+
+
+def check_balanced(path: str, errors: list) -> None:
+    src = strip_scala(open(path).read())
+    for opener, closer in (("{", "}"), ("(", ")"), ("[", "]")):
+        if src.count(opener) != src.count(closer):
+            errors.append(
+                f"{path}: unbalanced {opener}{closer} "
+                f"({src.count(opener)} vs {src.count(closer)})"
+            )
+
+
+def declared_classes() -> set:
+    """FQN of every class/object declared in the Scala sources."""
+    fqns = set()
+    for path in scala_files():
+        src = strip_scala(open(path).read())
+        pkg = re.search(r"^\s*package\s+([\w.]+)", src, re.M)
+        pkg = pkg.group(1) if pkg else ""
+        for m in re.finditer(
+            r"^\s*(?:(?:final|case|sealed|abstract|private|protected|"
+            r"implicit|open)\s+)*(?:class|object|trait)\s+(\w+)",
+            src, re.M,
+        ):
+            fqns.add(f"{pkg}.{m.group(1)}" if pkg else m.group(1))
+    return fqns
+
+
+def services_entries() -> list:
+    out = []
+    svc_dir = os.path.join(JVM, "resources", "META-INF", "services")
+    for f in sorted(os.listdir(svc_dir)):
+        for line in open(os.path.join(svc_dir, f)):
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append((f, line))
+    return out
+
+
+def plugin_targets() -> list:
+    src = open(
+        os.path.join(JVM, "scala", "com", "tpurapids", "ml", "Plugin.scala")
+    ).read()
+    return re.findall(r'Optional\.of\("([\w.]+)"\)', src)
+
+
+def operator_names() -> list:
+    src = open(
+        os.path.join(JVM, "scala", "com", "tpurapids", "ml", "Wrappers.scala")
+    ).read()
+    return re.findall(r'operatorName: String = "(\w+)"', src)
+
+
+def model_builder_fields() -> set:
+    src = open(
+        os.path.join(
+            JVM, "scala", "org", "apache", "spark", "ml", "tpu",
+            "TpuModels.scala",
+        )
+    ).read()
+    return set(re.findall(r'attrs\s*\\\s*"(\w+)"', src))
+
+
+def main() -> int:
+    errors: list = []
+
+    files = scala_files()
+    if not files:
+        errors.append("no .scala sources found")
+    for path in files:
+        check_balanced(path, errors)
+
+    fqns = declared_classes()
+    for svc, entry in services_entries():
+        if entry not in fqns:
+            errors.append(f"META-INF/services/{svc}: {entry} not declared")
+    for target in plugin_targets():
+        if target not in fqns:
+            errors.append(f"Plugin.transform target {target} not declared")
+
+    sys.path.insert(0, REPO)
+    from spark_rapids_ml_tpu import connect_plugin
+
+    supported = set(connect_plugin._registry())
+    ops = operator_names()
+    if not ops:
+        errors.append("no operatorName declarations found in Wrappers.scala")
+    for op in ops:
+        if op not in supported:
+            errors.append(
+                f"Wrappers.scala operator {op} not dispatchable by the "
+                f"Python worker (supported: {sorted(supported)})"
+            )
+
+    fields = model_builder_fields()
+    if not fields:
+        errors.append("no attrs fields parsed from TpuModels.scala")
+
+    if errors:
+        print("JVM structural gate FAILED:", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(
+        f"JVM structural gate OK: {len(files)} sources balanced, "
+        f"{len(fqns)} classes, {len(services_entries())} service entries "
+        f"resolved, {len(ops)} operators dispatchable, "
+        f"{len(fields)} ModelBuilder fields (runtime check: "
+        "tests/test_jvm_protocol.py)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,6 +54,9 @@ for m in mods:
 print(f"{len(mods)} modules import cleanly")
 EOF
 
+echo "== jvm plugin gate =="
+./ci/compile_jvm.sh
+
 echo "== docs: generate API reference =="
 JAX_PLATFORMS=cpu python docs/gen_api_docs.py
 # fail on drift: the committed pages must match the generated ones

@@ -50,7 +50,8 @@ MODULES = [
 
 
 def _anchor(name: str) -> str:
-    return name.lower().replace(".", "").replace("_", "")
+    # GitHub-style heading slug: lowercase, drop periods, KEEP underscores
+    return name.lower().replace(".", "")
 
 
 def _clean_doc(doc: str | None, indent: str = "") -> str:

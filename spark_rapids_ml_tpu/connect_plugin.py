@@ -63,23 +63,38 @@ def _registry() -> Dict[str, Any]:
     }
 
 
-def _scalar_attributes(model) -> Dict[str, Any]:
-    """JSON-safe scalar/metadata attributes (the array payload persists in
-    the model directory; the reference returns arrays inline because py4j
-    carries them — a path does the same job here)."""
-    import numpy as np
-
+def _sanitize_nonfinite(v):
+    """Recursively stringify non-finite floats (strict-JSON wire format
+    for the JVM side, which maps the strings back in ModelBuilder)."""
     import math
+
+    if isinstance(v, float) and not math.isfinite(v):
+        return "NaN" if math.isnan(v) else (
+            "Infinity" if v > 0 else "-Infinity"
+        )
+    if isinstance(v, list):
+        return [_sanitize_nonfinite(x) for x in v]
+    return v
+
+
+def _scalar_attributes(
+    model, max_inline_elems: float = 0
+) -> Dict[str, Any]:
+    """JSON-safe model attributes.  Numeric arrays up to
+    `max_inline_elems` elements are INLINE (nested lists) — the Scala
+    ModelBuilder reconstructs native Spark models from them
+    (TpuModels.scala `attrs \\ "coef_"` etc.), matching the reference's
+    py4j inline-attribute transport.  Larger arrays (RF node tables,
+    UMAP embeddings) stay path-resident in the model directory and only
+    their `_shape` ships; those models transform via the Python-backed
+    round trip instead."""
+    import numpy as np
 
     out: Dict[str, Any] = {}
     for k, v in model._get_model_attributes().items():
         if isinstance(v, (np.integer, np.floating, np.bool_)):
             v = v.item()
-        if isinstance(v, float) and not math.isfinite(v):
-            # strings keep strict-JSON parsers (the JVM side) working
-            v = "NaN" if math.isnan(v) else (
-                "Infinity" if v > 0 else "-Infinity"
-            )
+        v = _sanitize_nonfinite(v)
         if isinstance(v, (str, int, float, bool)) or v is None:
             out[k] = v
         elif isinstance(v, list) and all(
@@ -88,6 +103,11 @@ def _scalar_attributes(model) -> Dict[str, Any]:
             out[k] = v  # e.g. classes_
         elif isinstance(v, np.ndarray):
             out[k + "_shape"] = list(v.shape)
+            if v.size <= max_inline_elems and (
+                np.issubdtype(v.dtype, np.number)
+                or v.dtype == np.bool_
+            ):
+                out[k] = _sanitize_nonfinite(v.tolist())
     return out
 
 
@@ -122,30 +142,16 @@ def handle_request(req: Dict[str, Any]) -> Dict[str, Any]:
         model_path = req.get("model_path")
         if model_path:
             model.save(model_path)
-        attributes = _scalar_attributes(model)
-        if req.get("inline_arrays"):
-            # a JVM caller building a real Spark model (jvm/ ModelBuilder)
-            # needs the array payload inline, not just the npz path.
-            # Non-finite values travel as strings: Jackson on the JVM side
-            # rejects bare Infinity/NaN tokens (ModelBuilder.doubleOf
-            # parses the strings back).
-            import math
-
-            import numpy as np
-
-            def _clean(x):
-                if isinstance(x, list):
-                    return [_clean(v) for v in x]
-                if isinstance(x, float) and not math.isfinite(x):
-                    return (
-                        "NaN" if math.isnan(x)
-                        else ("Infinity" if x > 0 else "-Infinity")
-                    )
-                return x
-
-            for k, v in model._get_model_attributes().items():
-                if isinstance(v, np.ndarray):
-                    attributes[k] = _clean(v.tolist())
+        # a JVM caller building a real Spark model (jvm/ ModelBuilder)
+        # sends inline_arrays=true: the full array payload ships inline
+        # (reference py4j semantics); other callers get scalars + shapes
+        # and read arrays from model_path
+        attributes = _scalar_attributes(
+            model,
+            max_inline_elems=(
+                float("inf") if req.get("inline_arrays") else 0
+            ),
+        )
         return {
             "status": "ok",
             "operator": base + "Model",

@@ -126,3 +126,186 @@ def test_rf_model_operator_resolution(tmp_path):
     })
     assert resp["status"] == "ok", resp.get("error")
     assert resp["num_rows"] == 200
+
+
+# ---------------------------------------------------------------------------
+# Field-by-field golden tests (VERDICT r3 item: cover every wrapper in
+# Wrappers.scala / TpuModels.scala): for each algorithm the Scala
+# ModelBuilder reconstructs, run the REAL worker fit and assert every
+# `attrs \ "field"` it reads is present and shaped as the builder expects.
+# ---------------------------------------------------------------------------
+
+_TPU_MODELS = os.path.join(
+    os.path.dirname(_SCALA), "..", "..", "..", "org", "apache", "spark",
+    "ml", "tpu", "TpuModels.scala",
+)
+_WRAPPERS = os.path.join(os.path.dirname(_SCALA), "Wrappers.scala")
+
+
+def _builder_fields(fn_name):
+    """`attrs \\ "field"` reads inside one ModelBuilder function."""
+    src = open(_TPU_MODELS).read()
+    m = re.search(
+        rf"def {fn_name}\(uid: String, attrs: JValue\).*?(?=\n  def |\n\}})",
+        src, re.S,
+    )
+    assert m, f"ModelBuilder.{fn_name} not found"
+    return set(re.findall(r'attrs\s*\\\s*"(\w+)"', m.group(0)))
+
+
+def _fit(tmp_path, rng, operator, params, supervised, classify=False):
+    from spark_rapids_ml_tpu.connect_plugin import handle_request
+
+    X = rng.normal(size=(150, 4)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    if supervised:
+        raw = X @ np.arange(1, 5)
+        df["label"] = (
+            (raw > np.median(raw)).astype(np.float64) if classify
+            else raw.astype(np.float64)
+        )
+    path = str(tmp_path / "d.parquet")
+    df.to_parquet(path)
+    resp = handle_request({
+        # byte-identical request shape to PythonWorkerRunner.scala
+        # (including inline_arrays, which the JVM always sends)
+        "op": "fit", "operator": operator, "params": params,
+        "data": path, "model_path": str(tmp_path / "m"),
+        "inline_arrays": True,
+    })
+    assert resp["status"] == "ok", resp
+    return resp["attributes"]
+
+
+def _is_matrix(v):
+    return (
+        isinstance(v, list) and v
+        and all(isinstance(r, list) and len(r) == len(v[0]) for r in v)
+    )
+
+
+def test_modelbuilder_logistic_regression_fields(tmp_path, rng):
+    attrs = _fit(tmp_path, rng, "LogisticRegression", {"regParam": 0.01},
+                 True, classify=True)
+    fields = _builder_fields("logisticRegression")
+    assert fields == {"coef_", "intercept_", "classes_"}
+    assert _is_matrix(attrs["coef_"])  # arr2
+    assert isinstance(attrs["intercept_"], list)  # arr1
+    assert isinstance(attrs["classes_"], list) and len(attrs["classes_"]) == 2
+
+
+def test_modelbuilder_linear_regression_fields(tmp_path, rng):
+    attrs = _fit(tmp_path, rng, "LinearRegression", {}, True)
+    fields = _builder_fields("linearRegression")
+    assert fields == {"coef_", "intercept_"}
+    coef = attrs["coef_"]
+    # the Scala side reads arr1 — a flat (d,) list, not a matrix
+    assert isinstance(coef, list) and len(coef) == 4
+    assert all(isinstance(c, (int, float)) for c in coef)
+    assert isinstance(attrs["intercept_"], (int, float))  # doubleOf
+
+
+def test_modelbuilder_kmeans_fields(tmp_path, rng):
+    attrs = _fit(tmp_path, rng, "KMeans", {"k": 3, "seed": 1}, False)
+    fields = _builder_fields("kmeans")
+    assert fields == {"cluster_centers_"}
+    centers = attrs["cluster_centers_"]
+    assert _is_matrix(centers) and len(centers) == 3 and len(centers[0]) == 4
+
+
+def test_modelbuilder_pca_fields(tmp_path, rng):
+    attrs = _fit(
+        tmp_path, rng, "PCA",
+        {"k": 2, "inputCol": "features", "outputCol": "o"}, False,
+    )
+    fields = _builder_fields("pca")
+    assert fields == {"components_", "explained_variance_ratio_"}
+    comp = attrs["components_"]
+    assert _is_matrix(comp) and len(comp) == 2 and len(comp[0]) == 4
+    evr = attrs["explained_variance_ratio_"]
+    assert isinstance(evr, list) and len(evr) == 2
+
+
+def test_wrapper_rf_classifier_num_classes_field(tmp_path, rng):
+    # TpuRandomForestClassifier reads `attrs \ "num_classes"` directly
+    # (Wrappers.scala) — the worker must emit it as an integer
+    src = open(_WRAPPERS).read()
+    assert '"num_classes"' in src
+    attrs = _fit(
+        tmp_path, rng, "RandomForestClassifier",
+        {"numTrees": 4, "maxDepth": 3, "seed": 0}, True, classify=True,
+    )
+    assert attrs["num_classes"] == 2
+    assert isinstance(attrs["num_classes"], int)
+
+
+def test_every_operator_in_wrappers_round_trips(tmp_path, rng):
+    # one fit+transform per wrapper operator, driven exactly as the
+    # Scala TpuEstimator.trainOnPython would
+    from spark_rapids_ml_tpu.connect_plugin import handle_request
+
+    src = open(_WRAPPERS).read()
+    ops = re.findall(r'operatorName: String = "(\w+)"', src)
+    assert sorted(ops) == [
+        "KMeans", "LinearRegression", "LogisticRegression", "PCA",
+        "RandomForestClassifier", "RandomForestRegressor",
+    ]
+    params = {
+        "KMeans": {"k": 2, "seed": 0},
+        "LinearRegression": {},
+        "LogisticRegression": {"regParam": 0.01},
+        "PCA": {"k": 2, "inputCol": "features", "outputCol": "o"},
+        "RandomForestClassifier": {"numTrees": 3, "maxDepth": 3, "seed": 0},
+        "RandomForestRegressor": {"numTrees": 3, "maxDepth": 3, "seed": 0},
+    }
+    model_suffix = {
+        "RandomForestClassifier": "RandomForestClassificationModel",
+        "RandomForestRegressor": "RandomForestRegressionModel",
+    }
+    for op in ops:
+        sup = op not in ("KMeans", "PCA")
+        X = rng.normal(size=(100, 4)).astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        if sup:
+            raw = X @ np.arange(1, 5)
+            df["label"] = (
+                (raw > np.median(raw)).astype(np.float64)
+                if op == "LogisticRegression" or "Classifier" in op
+                else raw.astype(np.float64)
+            )
+        path = str(tmp_path / f"{op}.parquet")
+        df.to_parquet(path)
+        mp = str(tmp_path / f"{op}_m")
+        r = handle_request({"op": "fit", "operator": op,
+                            "params": params[op], "data": path,
+                            "model_path": mp, "inline_arrays": True})
+        assert r["status"] == "ok", (op, r)
+        model_op = model_suffix.get(op, op + "Model")
+        out = str(tmp_path / f"{op}_o.parquet")
+        r = handle_request({"op": "transform", "operator": model_op,
+                            "params": {}, "data": path, "model_path": mp,
+                            "output_path": out})
+        assert r["status"] == "ok", (op, r)
+        assert r["num_rows"] == 100
+
+
+def test_arrays_ship_only_when_inline_requested(tmp_path, rng):
+    # without inline_arrays (non-JVM callers) arrays stay path-resident:
+    # shapes ship, payloads do not; with it, payloads ship regardless of
+    # size (the cap-lift branch PythonWorkerRunner always exercises)
+    from spark_rapids_ml_tpu.connect_plugin import handle_request
+
+    X = rng.normal(size=(80, 3)).astype(np.float32)
+    path = str(tmp_path / "d.parquet")
+    pd.DataFrame({"features": list(X)}).to_parquet(path)
+    base = {"op": "fit", "operator": "KMeans", "params": {"k": 2, "seed": 0},
+            "data": path, "model_path": str(tmp_path / "m")}
+    plain = handle_request(dict(base))
+    assert plain["status"] == "ok"
+    assert "cluster_centers__shape" in plain["attributes"]
+    assert "cluster_centers_" not in plain["attributes"]
+    inline = handle_request(dict(base, model_path=str(tmp_path / "m2"),
+                                 inline_arrays=True))
+    assert inline["status"] == "ok"
+    assert inline["attributes"]["cluster_centers__shape"] == [2, 3]
+    assert len(inline["attributes"]["cluster_centers_"]) == 2
