@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+#
+# 1B-row rehearsal at the largest disk-feasible scale (VERDICT r3 item 5;
+# BASELINE.md north star = LogisticRegression L-BFGS at 1B x 256).
+#
+# Generates a ~25 GB parquet dataset (default 100M x 64) in row slabs,
+# runs the epoch-streaming LogisticRegression fit end to end with
+# per-iteration checkpointing, KILLS the fit mid-run once (exercising
+# checkpoint/resume exactly as a preemption would), resumes to
+# completion, and prints one JSON line with the rows/s/epoch scaling
+# curve and the straight-faced 1B x 256 projection.
+#
+# Analog of the reference's scale tests (tests_large/
+# test_large_logistic_regression.py) + its S3-parquet benchmark ingest.
+#
+#   python benchmark/rehearsal_100m.py                   # full 100M run
+#   REHEARSAL_ROWS=4000000 python benchmark/rehearsal_100m.py   # smoke
+#
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+N_ROWS = int(os.environ.get("REHEARSAL_ROWS", 100_000_000))
+N_COLS = int(os.environ.get("REHEARSAL_COLS", 64))
+MAX_ITER = int(os.environ.get("REHEARSAL_MAX_ITER", 8))
+DATA_DIR = os.environ.get("REHEARSAL_DIR", "/tmp/rehearsal_100m")
+SLAB = 1_000_000
+
+
+def gen_dataset(path: str) -> None:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    if os.path.exists(path):
+        import pyarrow.dataset as ds
+
+        have = ds.dataset(path, format="parquet").count_rows()
+        if have == N_ROWS:
+            print(f"dataset exists: {path} ({have} rows)", file=sys.stderr)
+            return
+        os.remove(path)
+    rng = np.random.default_rng(42)
+    true_w = rng.standard_normal(N_COLS).astype(np.float32)
+    writer = None
+    t0 = time.time()
+    for at in range(0, N_ROWS, SLAB):
+        m = min(SLAB, N_ROWS - at)
+        X = rng.standard_normal((m, N_COLS), dtype=np.float32)
+        y = (
+            X @ true_w + 0.25 * rng.standard_normal(m).astype(np.float32)
+            > 0
+        ).astype(np.float64)
+        t = pa.table(
+            {
+                "features": pa.FixedSizeListArray.from_arrays(
+                    pa.array(X.reshape(-1)), N_COLS
+                ),
+                "label": pa.array(y),
+            }
+        )
+        if writer is None:
+            writer = pq.ParquetWriter(path, t.schema)
+        writer.write_table(t)
+        if (at // SLAB) % 10 == 0:
+            done = at + m
+            rate = done / max(time.time() - t0, 1e-9)
+            eta = (N_ROWS - done) / max(rate, 1)
+            print(
+                f"gen {done/1e6:.0f}M/{N_ROWS/1e6:.0f}M rows "
+                f"({rate/1e6:.2f}M rows/s, eta {eta/60:.1f} min)",
+                file=sys.stderr, flush=True,
+            )
+    writer.close()
+    print(f"generated {path} in {time.time()-t0:.0f}s", file=sys.stderr)
+
+
+def run_fit(path: str, ckpt: str, max_iter: int, die_after_s: float = 0.0):
+    """One fit attempt; with die_after_s > 0, run in a subprocess that is
+    SIGKILLed after that many seconds (preemption rehearsal)."""
+    if die_after_s > 0:
+        import subprocess
+
+        env = dict(
+            os.environ,
+            REHEARSAL_ROWS=str(N_ROWS),
+            REHEARSAL_COLS=str(N_COLS),
+            REHEARSAL_MAX_ITER=str(max_iter),
+            REHEARSAL_DIR=DATA_DIR,
+            _REHEARSAL_CHILD="1",
+        )
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.DEVNULL,
+        )
+        time.sleep(die_after_s)
+        p.kill()
+        p.wait()
+        return None
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.config import set_config
+
+    set_config(
+        force_streaming_stats=True,
+        streaming_checkpoint_dir=os.path.dirname(ckpt) or ".",
+    )
+    t0 = time.perf_counter()
+    model = LogisticRegression(regParam=1e-4, maxIter=max_iter, tol=0.0).fit(
+        path
+    )
+    el = time.perf_counter() - t0
+    epochs = int(model._model_attributes.get("streaming_epochs", 0)) or 1
+    return model, el, epochs
+
+
+def main() -> None:
+    os.makedirs(DATA_DIR, exist_ok=True)
+    path = os.path.join(DATA_DIR, f"data_{N_ROWS}x{N_COLS}.parquet")
+    ckpt_dir = os.path.join(DATA_DIR, "ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt = os.path.join(ckpt_dir, "x")
+    gen_dataset(path)
+
+    if os.environ.get("_REHEARSAL_CHILD"):
+        run_fit(path, ckpt, MAX_ITER)
+        return
+
+    out: dict = {
+        "metric": f"rehearsal_logreg_{N_ROWS}x{N_COLS}",
+        "unit": "rows/sec/epoch",
+    }
+
+    # scaling curve: rows/s/epoch at increasing row counts (same engine)
+    import numpy as np  # noqa: F401
+
+    curve = {}
+    for frac_rows in [N_ROWS // 100, N_ROWS // 10, N_ROWS]:
+        sub = os.path.join(DATA_DIR, f"sub_{frac_rows}.parquet")
+        if frac_rows < N_ROWS:
+            # row-slice the big file once (arrow scan, fast)
+            import pyarrow.dataset as ds
+            import pyarrow.parquet as pq
+
+            if not os.path.exists(sub):
+                dset = ds.dataset(path, format="parquet")
+                w = None
+                got = 0
+                for b in dset.to_batches():
+                    take = min(b.num_rows, frac_rows - got)
+                    if take <= 0:
+                        break
+                    import pyarrow as pa
+
+                    t = pa.Table.from_batches([b.slice(0, take)])
+                    if w is None:
+                        w = pq.ParquetWriter(sub, t.schema)
+                    w.write_table(t)
+                    got += take
+                w.close()
+            target = sub
+        else:
+            target = path
+        res = run_fit(target, ckpt, MAX_ITER if frac_rows == N_ROWS else 3)
+        model, el, epochs = res
+        rps = frac_rows * epochs / el
+        curve[f"{frac_rows}"] = round(rps, 1)
+        print(
+            f"curve {frac_rows} rows: {el:.1f}s, {epochs} epochs, "
+            f"{rps:,.0f} rows/s/epoch", file=sys.stderr, flush=True,
+        )
+    out["scaling_curve_rows_per_sec_per_epoch"] = curve
+
+    # preemption rehearsal on the full file: start, kill mid-fit, resume
+    # (kill time scales with the dataset so the child dies mid-solve at
+    # any rehearsal size)
+    for f in os.listdir(ckpt_dir):
+        os.remove(os.path.join(ckpt_dir, f))
+    # floor covers the child's interpreter+jax startup and the
+    # label-moments pre-scan, so the kill lands inside the solver loop
+    die_after = max(30.0, min(120.0, N_ROWS / 1e6 * 1.5))
+    run_fit(path, ckpt, MAX_ITER, die_after_s=die_after)
+    resumed_from = [
+        f for f in os.listdir(ckpt_dir)
+    ]
+    out["checkpoint_files_after_kill"] = len(resumed_from)
+    t0 = time.perf_counter()
+    model, el, epochs = run_fit(path, ckpt, MAX_ITER)
+    out["resumed_fit_sec"] = round(el, 1)
+    out["resumed_epochs"] = epochs
+    rps = N_ROWS * epochs / el
+    out["value"] = round(rps, 1)
+    out["train_acc_proxy"] = None
+    out["projection_1Bx256_epoch_hours"] = round(
+        1e9 / (rps * (N_COLS / 256.0)) / 3600.0, 2
+    )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
