@@ -25,10 +25,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
+from spark_rapids_ml_tpu._jax_env import apply_jax_platforms_env
 
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+apply_jax_platforms_env()
 
 N_ROWS = int(os.environ.get("REHEARSAL_ROWS", 100_000_000))
 N_COLS = int(os.environ.get("REHEARSAL_COLS", 64))
@@ -85,7 +84,7 @@ def gen_dataset(path: str) -> None:
     print(f"generated {path} in {time.time()-t0:.0f}s", file=sys.stderr)
 
 
-def run_fit(path: str, ckpt: str, max_iter: int, die_after_s: float = 0.0):
+def run_fit(path: str, ckpt_dir: str, max_iter: int, die_after_s: float = 0.0):
     """One fit attempt; with die_after_s > 0, run in a subprocess that is
     SIGKILLed after that many seconds (preemption rehearsal)."""
     if die_after_s > 0:
@@ -113,7 +112,7 @@ def run_fit(path: str, ckpt: str, max_iter: int, die_after_s: float = 0.0):
 
     set_config(
         force_streaming_stats=True,
-        streaming_checkpoint_dir=os.path.dirname(ckpt) or ".",
+        streaming_checkpoint_dir=ckpt_dir,
     )
     t0 = time.perf_counter()
     model = LogisticRegression(regParam=1e-4, maxIter=max_iter, tol=0.0).fit(
@@ -129,11 +128,10 @@ def main() -> None:
     path = os.path.join(DATA_DIR, f"data_{N_ROWS}x{N_COLS}.parquet")
     ckpt_dir = os.path.join(DATA_DIR, "ckpt")
     os.makedirs(ckpt_dir, exist_ok=True)
-    ckpt = os.path.join(ckpt_dir, "x")
     gen_dataset(path)
 
     if os.environ.get("_REHEARSAL_CHILD"):
-        run_fit(path, ckpt, MAX_ITER)
+        run_fit(path, ckpt_dir, MAX_ITER)
         return
 
     out: dict = {
@@ -146,7 +144,9 @@ def main() -> None:
 
     curve = {}
     for frac_rows in [N_ROWS // 100, N_ROWS // 10, N_ROWS]:
-        sub = os.path.join(DATA_DIR, f"sub_{frac_rows}.parquet")
+        if frac_rows == 0:
+            continue
+        sub = os.path.join(DATA_DIR, f"sub_{frac_rows}x{N_COLS}.parquet")
         if frac_rows < N_ROWS:
             # row-slice the big file once (arrow scan, fast)
             import pyarrow.dataset as ds
@@ -167,11 +167,12 @@ def main() -> None:
                         w = pq.ParquetWriter(sub, t.schema)
                     w.write_table(t)
                     got += take
-                w.close()
+                if w is not None:
+                    w.close()
             target = sub
         else:
             target = path
-        res = run_fit(target, ckpt, MAX_ITER if frac_rows == N_ROWS else 3)
+        res = run_fit(target, ckpt_dir, MAX_ITER if frac_rows == N_ROWS else 3)
         model, el, epochs = res
         rps = frac_rows * epochs / el
         curve[f"{frac_rows}"] = round(rps, 1)
@@ -189,13 +190,9 @@ def main() -> None:
     # floor covers the child's interpreter+jax startup and the
     # label-moments pre-scan, so the kill lands inside the solver loop
     die_after = max(30.0, min(120.0, N_ROWS / 1e6 * 1.5))
-    run_fit(path, ckpt, MAX_ITER, die_after_s=die_after)
-    resumed_from = [
-        f for f in os.listdir(ckpt_dir)
-    ]
-    out["checkpoint_files_after_kill"] = len(resumed_from)
-    t0 = time.perf_counter()
-    model, el, epochs = run_fit(path, ckpt, MAX_ITER)
+    run_fit(path, ckpt_dir, MAX_ITER, die_after_s=die_after)
+    out["checkpoint_files_after_kill"] = len(os.listdir(ckpt_dir))
+    model, el, epochs = run_fit(path, ckpt_dir, MAX_ITER)
     out["resumed_fit_sec"] = round(el, 1)
     out["resumed_epochs"] = epochs
     rps = N_ROWS * epochs / el

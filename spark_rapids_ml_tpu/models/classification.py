@@ -338,10 +338,10 @@ class LogisticRegression(
             history=int(p.get("lbfgs_memory", 10)),
             ls_max=int(p.get("linesearch_max_iter", 20)),
             dtype=dtype,
-            checkpoint_path=(
-                os.path.join(ckpt_dir, f"logreg-{self.uid}.npz")
-                if ckpt_dir else None
-            ),
+            # filename derives from the fit's content tag inside the
+            # solver: stable across process restarts (a uid-based name
+            # made a preempted-and-restarted fit miss its checkpoint)
+            checkpoint_dir=ckpt_dir or None,
         )
         dtype = np.dtype(dtype)
         if "degenerate_label" in res:

@@ -202,10 +202,7 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
             init_steps=int(p.get("init_steps") or 2),
             oversample=float(p.get("oversampling_factor") or 2.0),
             dtype=dtype,
-            checkpoint_path=(
-                _os.path.join(ckpt_dir, f"kmeans-{self.uid}.npz")
-                if ckpt_dir else None
-            ),
+            checkpoint_dir=ckpt_dir or None,
         )
         dtype = np.dtype(dtype)
         return {

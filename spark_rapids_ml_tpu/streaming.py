@@ -835,6 +835,21 @@ def _label_moments_scan(
     }
 
 
+def checkpoint_file_for(ckpt_dir: str, tag: str) -> str:
+    """Deterministic checkpoint filename from the solver's content tag
+    (dataset path, shape, hyperparams).  A preempted process RESTARTS
+    with fresh Python state, so the name must not depend on anything
+    per-process (estimator uid counters made a restarted fit silently
+    miss its checkpoint); the tag is identical across restarts of the
+    same fit by construction, and the in-file tag check still guards
+    against hash collisions/config drift."""
+    import hashlib
+
+    h = hashlib.sha1(tag.encode()).hexdigest()[:16]
+    kind = tag.split("|", 1)[0]
+    return os.path.join(ckpt_dir, f"{kind}-{h}.npz")
+
+
 def logreg_streaming_fit(
     path: str,
     features_col,
@@ -853,6 +868,7 @@ def logreg_streaming_fit(
     dtype=np.float32,
     chunk_rows: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> dict:
     """Epoch-streaming logistic regression: host L-BFGS/OWL-QN
     (`ops/lbfgs.py lbfgs_minimize_host`) whose every evaluation streams the
@@ -957,6 +973,12 @@ def logreg_streaming_fit(
         grad = np.asarray(agg["g"], np.float64) / wsum + l2 * beta
         return f, grad
 
+    ckpt_tag = (
+        f"logreg|{path}|n={scan['n_total']}|d={d}|C={n_classes}|"
+        f"l2={l2}|l1={l1}|int={fit_intercept}|std={standardization}"
+    )
+    if checkpoint_path is None and checkpoint_dir:
+        checkpoint_path = checkpoint_file_for(checkpoint_dir, ckpt_tag)
     theta, n_iter, converged, hist = lbfgs_minimize_host(
         oracle,
         np.zeros((n_param,), np.float64),
@@ -967,10 +989,7 @@ def logreg_streaming_fit(
         l1_mask=coef_mask,
         ls_max=ls_max,
         checkpoint_path=checkpoint_path,
-        checkpoint_tag=(
-            f"logreg|{path}|n={scan['n_total']}|d={d}|C={n_classes}|"
-            f"l2={l2}|l1={l1}|int={fit_intercept}|std={standardization}"
-        ),
+        checkpoint_tag=ckpt_tag,
     )
     logger.info(
         f"Epoch-streaming logreg: {n_iter} iterations, {epochs['n']} data "
@@ -1016,6 +1035,7 @@ def kmeans_streaming_fit(
     chunk_rows: Optional[int] = None,
     init_rows: int = 262_144,
     checkpoint_path: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> dict:
     """Epoch-streaming Lloyd: centers are seeded from a strided global
     subsample (k-means|| on device), then each iteration streams the
@@ -1133,6 +1153,8 @@ def kmeans_streaming_fit(
         return agg["sums"], agg["counts"], float(agg["cost"])
 
     ckpt_tag = f"kmeans|{path}|n={n_total}|d={d}|k={k}|seed={seed}"
+    if checkpoint_path is None and checkpoint_dir:
+        checkpoint_path = checkpoint_file_for(checkpoint_dir, ckpt_tag)
 
     def save_ckpt(C_host, it) -> None:
         if checkpoint_path and jax.process_index() == 0:
