@@ -812,40 +812,80 @@ class _TpuModel(Model, _TpuCaller):
         n_dev = mesh.devices.size
         outs: Dict[str, List[np.ndarray]] = {}
         lo = 0
-        while lo < n:
-            try:
-                with trace(
-                    f"transform_chunk[{lo}:{min(lo + chunk, n)}]", self.logger
-                ):
-                    hi = min(lo + chunk, n)
-                    if sparse_in:
-                        from .native import densify_csr
+        def _dispatch(lo: int):
+            """Stage one chunk and launch its device program (ASYNC — jax
+            dispatch returns with the transfer/compute in flight)."""
+            hi = min(lo + chunk, n)
+            with trace(f"dispatch_chunk[{lo}:{hi}]", self.logger):
+                if sparse_in:
+                    from .native import densify_csr
 
-                        Xc = densify_csr(X[lo:hi], hi - lo, x_dtype)
-                    else:
-                        Xc = np.ascontiguousarray(X[lo:hi])
-                    st = RowStager.for_replicated(Xc.shape[0], mesh)
-                    dev = self._transform_device(st.stage(Xc, x_dtype))
-                    # fetch the whole chunk before publishing: a failure on a
-                    # later column must not leave earlier columns appended
-                    # (the retry would duplicate their rows)
-                    fetched = {
-                        col: (
-                            st.fetch(v)
-                            if isinstance(v, jax.Array)
-                            else st.trim_host(np.asarray(v))
-                        )
-                        for col, v in dev.items()
-                    }
-                    for col, v in fetched.items():
-                        outs.setdefault(col, []).append(v)
-                lo += chunk
+                    Xc = densify_csr(X[lo:hi], hi - lo, x_dtype)
+                else:
+                    Xc = np.ascontiguousarray(X[lo:hi])
+                st = RowStager.for_replicated(Xc.shape[0], mesh)
+                dev = self._transform_device(st.stage(Xc, x_dtype))
+            return lo, hi, st, dev
+
+        def _collect(pending) -> None:
+            """Fetch one in-flight chunk (the sync point) and publish it
+            whole: a failure on a later column must not leave earlier
+            columns appended (the retry would duplicate their rows)."""
+            lo_p, hi_p, st, dev = pending
+            with trace(f"transform_chunk[{lo_p}:{hi_p}]", self.logger):
+                fetched = {
+                    col: (
+                        st.fetch(v)
+                        if isinstance(v, jax.Array)
+                        else st.trim_host(np.asarray(v))
+                    )
+                    for col, v in dev.items()
+                }
+            for col, v in fetched.items():
+                outs.setdefault(col, []).append(v)
+
+        # one-deep pipeline: chunk i+1's host->device transfer rides the
+        # wire while chunk i computes and fetches — on transfer-dominated
+        # attachments (the axon tunnel) this overlaps the two directions
+        # instead of serializing stage -> compute -> fetch per chunk.
+        # Two chunks are in flight, so each gets HALF the single-chunk
+        # budget (same peak device footprint as the serial loop)
+        chunk = max(chunk // 2, n_dev)
+        pending = None
+        while lo < n or pending is not None:
+            current = None  # a dispatch failure must not reuse last round's
+            try:
+                current = _dispatch(lo) if lo < n else None
+                if lo < n:
+                    lo = current[1]
+                if pending is not None:
+                    _collect(pending)
+                pending = current
             except Exception as e:
-                # OOM backoff: halve the chunk and RESUME at the failing row
-                # (completed chunks are kept — the analog of the reference's
-                # reserved-memory OOM loop, utils.py:403-522)
+                # OOM backoff: halve the chunk and RESUME at the first
+                # unpublished row — async errors surface at the fetch, so
+                # both in-flight chunks are discarded and re-run
+                # (completed chunks are kept — the analog of the
+                # reference's reserved-memory OOM loop, utils.py:403-522)
                 if not _is_oom(e) or chunk <= n_dev:
                     raise
+                resume_at = pending[0] if pending is not None else (
+                    current[0] if current is not None else lo
+                )
+                # drain the discarded in-flight programs BEFORE the retry:
+                # dropping the refs only queues deletion, and an immediate
+                # re-dispatch would contend with their unfreed buffers
+                for inflight in (pending, current):
+                    if inflight is None:
+                        continue
+                    for v in inflight[3].values():
+                        if isinstance(v, jax.Array):
+                            try:
+                                v.block_until_ready()
+                            except Exception:
+                                pass  # the original error already surfaced
+                pending = current = None
+                lo = resume_at
                 chunk = max(chunk // 2, n_dev)
                 self.logger.warning(
                     f"Transform chunk exhausted device memory; resuming at "
