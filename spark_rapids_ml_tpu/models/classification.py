@@ -463,7 +463,31 @@ class LogisticRegression(
             if standardization:
                 _, std = ell_weighted_moments(vals, cols, w, d=d)
                 vals = ell_scale_columns(vals, cols, 1.0 / std)
-            if binomial:
+            # same per-program budget gate as the dense branch: a
+            # reference-scale sparse fit must not compile the whole solve
+            # into one program either (45 s dispatch rule)
+            from ..config import get_config
+
+            C_eff = 1 if binomial else n_classes
+            per_eval = 4.0 * vals.shape[0] * vals.shape[1] * C_eff
+            budget = float(get_config("dispatch_flops_limit"))
+            if per_eval * max_iter * 2.0 > budget:
+                from ..ops.logistic import logreg_fit_host_dispatch
+                from ..ops.sparse import ell_matmat, ell_matvec
+
+                self.logger.info(
+                    "LogisticRegression: host-dispatched L-BFGS (sparse; "
+                    f"{per_eval * max_iter * 2.0:.2e} fused FLOPs > "
+                    f"budget {budget:.0e})"
+                )
+                coef, b, loss, n_iter, hist = logreg_fit_host_dispatch(
+                    vals, w, fit_input.y, n_classes=n_classes,
+                    binomial=binomial, d=d,
+                    margin_fn=lambda beta: ell_matvec(vals, cols, beta),
+                    logits_fn=lambda Wm: ell_matmat(vals, cols, Wm),
+                    **kwargs,
+                )
+            elif binomial:
                 coef, b, loss, n_iter, hist = logreg_fit_binary_ell(
                     vals, cols, w, fit_input.y, d=d, **kwargs
                 )
@@ -495,7 +519,27 @@ class LogisticRegression(
                 # f32 (the MXU consumes bf16 natively).  Opt-in: costs ~3
                 # decimal digits of feature precision.
                 X = X.astype(jnp.bfloat16)
-            if binomial:
+            # fused single-program L-BFGS until the whole solve could
+            # exceed the per-program device-time budget (45 s dispatch
+            # rule; the reference 1M x 3000 maxIter=200 config crosses
+            # it) — then host-driven L-BFGS, one evaluation per program
+            C_eff = 1 if binomial else n_classes
+            per_eval = 4.0 * X.shape[0] * X.shape[1] * C_eff
+            fused_flops = per_eval * max_iter * 2.0  # ~2 evals/iter
+            budget = float(get_config("dispatch_flops_limit"))
+            if fused_flops > budget:
+                from ..ops.logistic import logreg_fit_host_dispatch
+
+                self.logger.info(
+                    f"LogisticRegression: host-dispatched L-BFGS "
+                    f"({fused_flops:.2e} fused FLOPs > budget "
+                    f"{budget:.0e})"
+                )
+                coef, b, loss, n_iter, hist = logreg_fit_host_dispatch(
+                    X, w, fit_input.y, n_classes=n_classes,
+                    binomial=binomial, **kwargs
+                )
+            elif binomial:
                 coef, b, loss, n_iter, hist = logreg_fit_binary(
                     X, w, fit_input.y, **kwargs
                 )

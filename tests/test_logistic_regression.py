@@ -326,3 +326,60 @@ def test_evaluate_with_features_cols_and_weights(rng):
     assert s.accuracy > 0.9
     assert "rawPrediction" in s.predictions.columns
     assert set("abc") <= set(s.predictions.columns)
+
+
+def test_host_dispatched_lbfgs_matches_fused(rng):
+    # forcing a tiny per-program budget routes the dense fit through the
+    # host-driven L-BFGS (one dispatched evaluation per program, the 45s
+    # dispatch rule path); the optimum must match the fused while_loop
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    n, d = 4000, 12
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    tw = rng.normal(size=d).astype(np.float32)
+    y = (X @ tw > 0).astype(np.float64)
+    y_mc = np.digitize(X @ tw, np.quantile(X @ tw, [0.33, 0.66])).astype(
+        np.float64
+    )
+    for labels, fam in ((y, "binomial"), (y_mc, "multinomial")):
+        kw = dict(regParam=0.01, maxIter=120, tol=1e-9)
+        m_fused = LogisticRegression(**kw).fit((X, labels))
+        set_config(dispatch_flops_limit=1e6)
+        try:
+            m_host = LogisticRegression(**kw).fit((X, labels))
+        finally:
+            reset_config()
+        np.testing.assert_allclose(
+            np.asarray(m_host.coefficientMatrix),
+            np.asarray(m_fused.coefficientMatrix), rtol=2e-3, atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_host.interceptVector),
+            np.asarray(m_fused.interceptVector), rtol=2e-3, atol=2e-4,
+        )
+        assert abs(
+            m_host.summary.objectiveHistory[-1]
+            - m_fused.summary.objectiveHistory[-1]
+        ) < 1e-5
+
+
+def test_host_dispatched_lbfgs_elasticnet(rng):
+    # OWL-QN (l1>0) through the host path: same sparsity pattern and
+    # objective as the fused solver
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    n, d = 3000, 10
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - X[:, 3] > 0).astype(np.float64)
+    kw = dict(regParam=0.05, elasticNetParam=0.7, maxIter=200,
+              standardization=False)
+    m_fused = LogisticRegression(**kw).fit((X, y))
+    set_config(dispatch_flops_limit=1e6)
+    try:
+        m_host = LogisticRegression(**kw).fit((X, y))
+    finally:
+        reset_config()
+    cf = np.asarray(m_fused.coefficientMatrix).ravel()
+    ch = np.asarray(m_host.coefficientMatrix).ravel()
+    np.testing.assert_array_equal(np.abs(cf) < 1e-8, np.abs(ch) < 1e-8)
+    np.testing.assert_allclose(ch, cf, rtol=5e-3, atol=5e-4)

@@ -282,3 +282,27 @@ def test_sparse_transform_never_whole_densifies(rng, monkeypatch):
         reset_config()
     assert seen, "sparse transform never reached the blocked densify"
     assert max(seen) < 4000, f"whole-matrix densify happened: {seen}"
+
+
+def test_sparse_host_dispatched_lbfgs_matches_fused(rng):
+    # the dispatch-budget gate covers the ELL sparse path too: a tiny
+    # budget routes through host-driven L-BFGS with the same
+    # gather-contract margin, matching the fused sparse solver
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    n, d = 2000, 24
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[rng.random((n, d)) < 0.75] = 0.0
+    y = (X @ rng.normal(size=d) > 0).astype(np.float64)
+    csr = sp.csr_matrix(X)
+    kw = dict(regParam=0.01, maxIter=150, tol=1e-10)
+    m_fused = LogisticRegression(**kw).fit((csr, y))
+    set_config(dispatch_flops_limit=1e5)
+    try:
+        m_host = LogisticRegression(**kw).fit((csr, y))
+    finally:
+        reset_config()
+    np.testing.assert_allclose(
+        np.asarray(m_host.coef_), np.asarray(m_fused.coef_),
+        rtol=2e-3, atol=2e-4,
+    )
