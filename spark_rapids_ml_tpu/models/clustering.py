@@ -183,8 +183,6 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         reference's cluster-memory-scaled ingest, utils.py:403-522)."""
         from ..streaming import kmeans_streaming_fit
 
-        import os as _os
-
         from ..config import get_config
 
         fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
