@@ -461,6 +461,15 @@ def bench_umap(extra: dict):
         "BASELINE: 10Mx128 (reference fits on ONE worker's sample too); "
         "run: 100kx32 (rows/100, dims/4)"
     )
+    import jax as _jax
+
+    from spark_rapids_ml_tpu.config import get_config as _gc
+
+    # conf + backend recorded verbatim (the op layer picks the kernel;
+    # re-deriving its predicate here would drift)
+    extra["umap_kernel_conf"] = (
+        f"{_gc('umap_kernel')} on {_jax.default_backend()}"
+    )
     n, d = 100_000, 32
     X = _rng(5).standard_normal((n, d)).astype("float32")
     t0 = time.perf_counter()

@@ -711,20 +711,30 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
             d2, pos = ivf_ops.search_ivfpq(
                 Qs, centers, codebooks, codes, bids, bvalid, nprobe=nprobe, k=k2
             )
-            if k2 > k:  # exact re-rank of the PQ shortlist (cuVS `refine`,
-                # reference knn.py:1627-1657)
-                d2, pos = qst.fetch(d2), qst.fetch(pos)
-                safe = np.maximum(pos, 0)
-                cand = self.item_features[safe]  # (q, k2, d)
-                diff = cand - Q[:, None, :]
-                exact = (diff * diff).sum(axis=2).astype(np.float32)
-                exact = np.where(pos >= 0, exact, np.inf)
-                order = np.argsort(exact, axis=1)[:, :k]
-                return (
-                    self._apply_metric(np.take_along_axis(exact, order, axis=1)),
-                    np.take_along_axis(pos, order, axis=1),
-                )
-        return self._apply_metric(qst.fetch(d2)), qst.fetch(pos)
+            return self._exact_rerank(Q, qst.fetch(pos), k)
+        # CAGRA / IVF-Flat: the kernels rank by matmul-identity distances
+        # (x2 + c2 - 2xc), whose f32 cancellation leaves ~1e-4 absolute
+        # error (a point's own distance comes back ~0.008, not 0).  The
+        # final top-k is re-scored in the cancellation-free diff form —
+        # the same exact pass cuVS `refine` runs (reference
+        # knn.py:1627-1657) — so reported distances are exact and
+        # near-ties order correctly.
+        return self._exact_rerank(Q, qst.fetch(pos), k)
+
+    def _exact_rerank(
+        self, Q: np.ndarray, pos: np.ndarray, k: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact diff-form re-score + re-rank of a (q, >=k) candidate id
+        block; invalid slots (pos < 0) sort last and stay -1."""
+        safe = np.maximum(pos, 0)
+        cand = self.item_features[safe]  # (q, k2, d)
+        diff = cand - Q[:, None, :]
+        exact = (diff * diff).sum(axis=2).astype(np.float32)
+        exact = np.where(pos >= 0, exact, np.inf)
+        order = np.argsort(exact, axis=1, kind="stable")[:, :k]
+        d2 = np.take_along_axis(exact, order, axis=1)
+        out_pos = np.take_along_axis(pos, order, axis=1)
+        return self._apply_metric(d2), out_pos
 
     def approxSimilarityJoin(self, query_df: DatasetLike, distCol: str = "distCol"):
         """Flattened approximate join (reference knn.py:1671-1729); slots
