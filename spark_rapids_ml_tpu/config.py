@@ -105,6 +105,11 @@ _DEFAULTS: Dict[str, Any] = {
     # whose total fitted work exceeds this switch from the fused
     # single-program fit to stepwise host dispatch.
     "dispatch_flops_limit": 2e12,
+    # MXU precision for sufficient-statistics matmuls feeding a matrix
+    # inversion/eigendecomposition (PCA covariance, LinReg Gram) —
+    # ops/precision.py stats_precision().  "highest" = f32-exact (cuML
+    # parity); "high"/"default" trade fidelity for speed at very large d.
+    "stats_precision": "highest",
     # UMAP SGD epoch kernel: "auto" picks the scatter-free structured
     # kernel on TPU backends (unsorted scatter-adds serialize on TPU; the
     # structured form replaces them with dense sums + one sorted
@@ -180,24 +185,34 @@ def _invalidate_traced(old: Any, new: Any) -> None:
     jax.clear_caches()
 
 
+def _traced_keys_locked() -> tuple:
+    """Effective values of every conf baked into kernels at TRACE time
+    (precision levels); caller must hold _lock.  A change to any of them
+    must drop compiled programs."""
+    return (
+        _effective_locked("distance_precision"),
+        _effective_locked("stats_precision"),
+    )
+
+
 def set_config(**kwargs: Any) -> None:
     # read-check-update under ONE lock acquisition so two concurrent
     # precision changes cannot both observe old==new and skip cache
     # invalidation; the invalidation itself runs after release (it may
     # import jax, which must not happen under the config lock)
     with _lock:
-        prev = _effective_locked("distance_precision")
+        prev = _traced_keys_locked()
         for k, v in kwargs.items():
             if k not in _DEFAULTS:
                 raise KeyError(f"Unknown config key: {k}")
         _config.update(kwargs)
-        new = _effective_locked("distance_precision")
+        new = _traced_keys_locked()
     _invalidate_traced(prev, new)
 
 
 def reset_config() -> None:
     with _lock:
-        prev = _effective_locked("distance_precision")
+        prev = _traced_keys_locked()
         _config.clear()
-        new = _effective_locked("distance_precision")
+        new = _traced_keys_locked()
     _invalidate_traced(prev, new)

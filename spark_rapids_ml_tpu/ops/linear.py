@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Dict, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 
@@ -31,9 +32,14 @@ import numpy as np
 def linreg_sufficient_stats(X: jax.Array, w: jax.Array, y: jax.Array):
     """One pass: weighted Gram, moment, and cross terms.  X (N_pad,d)
     row-sharded, w validity*sample weights, y labels (0 on padding)."""
+    from .precision import stats_precision
+
     Xw = X * w[:, None]
-    gram = Xw.T @ X  # (d,d) — MXU, psum over shards
-    sxy = Xw.T @ y  # (d,)
+    # the normal equations invert this Gram: f32-exact products by
+    # default (cuML parity; see ops/precision.py stats_precision)
+    hi = stats_precision()
+    gram = jnp.matmul(Xw.T, X, precision=hi)  # (d,d) — MXU, psum over shards
+    sxy = jnp.matmul(Xw.T, y, precision=hi)  # (d,)
     s1 = Xw.sum(axis=0)  # (d,)
     sw = w.sum()
     sy = (y * w).sum()

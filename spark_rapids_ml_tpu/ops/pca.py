@@ -30,10 +30,13 @@ def pca_fit(X: jax.Array, w: jax.Array, k: int):
     """
     wsum = w.sum()
     mean = (X * w[:, None]).sum(axis=0) / wsum
+    from .precision import stats_precision
+
     # sqrt-weighted centering keeps cov = A^T A symmetric in one matmul;
-    # padded rows have w=0 and drop out.
+    # padded rows have w=0 and drop out.  stats_precision(): f32-exact
+    # covariance by default (cuML parity; see ops/precision.py)
     A = (X - mean) * jnp.sqrt(w)[:, None]
-    cov = (A.T @ A) / (wsum - 1.0)
+    cov = jnp.matmul(A.T, A, precision=stats_precision()) / (wsum - 1.0)
     evals, evecs = jnp.linalg.eigh(cov)  # ascending order
     evals = evals[::-1]
     evecs = evecs[:, ::-1]

@@ -39,3 +39,20 @@ def distance_precision() -> jax.lax.Precision:
             f"distance_precision must be one of {sorted(_LEVELS)}, got {name!r}"
         )
     return _LEVELS[name]
+
+
+def stats_precision() -> jax.lax.Precision:
+    """Precision for sufficient-statistics matmuls whose output feeds a
+    matrix inversion or eigendecomposition (PCA covariance, the linear-
+    regression Gram/cross terms; in-memory AND streaming accumulators).
+    cuML computes these in fp32; a default bf16 pass costs eigenvector/
+    coefficient fidelity for almost nothing — the Gram is <1 s of device
+    time even at the reference's 1M x 3000 config.  Config key
+    `stats_precision`, default "highest"; "high" (3-pass bf16) trades
+    ~2^-14 relative error for ~2x on very large-d grams."""
+    name = str(get_config("stats_precision")).lower()
+    if name not in _LEVELS:
+        raise ValueError(
+            f"stats_precision must be one of {sorted(_LEVELS)}; got {name!r}"
+        )
+    return _LEVELS[name]

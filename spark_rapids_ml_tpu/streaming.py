@@ -546,11 +546,14 @@ def _linreg_acc(d: int, dtype):
     import jax
     import jax.numpy as jnp
 
+    from .ops.precision import stats_precision
+
     def _step(acc, X, w, y):
         Xw = X * w[:, None]
+        hi = stats_precision()  # f32-exact stats by default (cuML parity)
         return {
-            "gram": acc["gram"] + Xw.T @ X,
-            "sxy": acc["sxy"] + Xw.T @ y,
+            "gram": acc["gram"] + jnp.matmul(Xw.T, X, precision=hi),
+            "sxy": acc["sxy"] + jnp.matmul(Xw.T, y, precision=hi),
             "s1": acc["s1"] + Xw.sum(axis=0),
             "sw": acc["sw"] + w.sum(),
             "sy": acc["sy"] + (y * w).sum(),
@@ -574,10 +577,13 @@ def _pca_acc(d: int, dtype):
     import jax
     import jax.numpy as jnp
 
+    from .ops.precision import stats_precision
+
     def _step(acc, X, w):
         Xw = X * w[:, None]
+        hi = stats_precision()  # f32-exact moments by default (cuML parity)
         return {
-            "S": acc["S"] + Xw.T @ X,
+            "S": acc["S"] + jnp.matmul(Xw.T, X, precision=hi),
             "s1": acc["s1"] + Xw.sum(axis=0),
             "sw": acc["sw"] + w.sum(),
         }
