@@ -643,7 +643,64 @@ def main() -> None:
     # emits a LABELED result rather than nothing
     import jax
 
+    # the hang is the real hazard: a ~28-min dead-tunnel init can eat the
+    # caller's whole bench timeout before the except below ever runs
+    # (BENCH_r03 recorded rc=124 exactly this way).  Probe the backend in
+    # a SUBPROCESS with a hard timeout — a healthy cold tunnel inits in
+    # 20-40 s — and switch to CPU without ever initializing a dead axon
+    # backend in this process.
     try:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
+    except ValueError:
+        probe_timeout = 300.0
+    probed_error = None
+    # probe unless the caller explicitly pinned CPU; the ambient
+    # environment pins JAX_PLATFORMS=axon, which is exactly the case the
+    # probe must cover (the child inherits it and tries the real init)
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu" and probe_timeout > 0:
+        import subprocess
+        import tempfile
+
+        # NOT subprocess.run: its post-timeout kill() is followed by an
+        # UNBOUNDED wait(), and a child stuck in an uninterruptible
+        # tunnel syscall can't take the SIGKILL — run() then blocks
+        # forever, exactly the hang this probe exists to avoid
+        with tempfile.TemporaryFile() as errf:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; assert any(d.platform != 'cpu' "
+                 "for d in jax.devices())"],
+                stdout=subprocess.DEVNULL, stderr=errf,
+                start_new_session=True,  # killpg reaches tunnel helpers
+            )
+            try:
+                rc = p.wait(timeout=probe_timeout)
+                if rc != 0:
+                    errf.seek(0)
+                    tail = errf.read()[-160:].decode("utf-8", "replace")
+                    tail = " ".join(tail.split())  # one line for the label
+                    probed_error = f"probe exit {rc}: {tail}"
+            except subprocess.TimeoutExpired:
+                probed_error = f"probe timeout after {probe_timeout:.0f}s"
+                try:
+                    os.killpg(p.pid, 9)
+                except OSError:
+                    p.kill()
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable D-state child; abandon it
+        if probed_error:
+            # single cpu-fallback site: env (spawned workers inherit it)
+            # + live config; the labeled-platform except below reuses it
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            jax.config.update("jax_platforms", "cpu")
+            print(f"bench: probe result: {probed_error!r}",
+                  file=sys.stderr, flush=True)
+
+    try:
+        if probed_error:
+            raise RuntimeError(probed_error)
         devs = jax.devices()
         _state["extra"]["platform"] = ",".join(
             sorted({d.platform for d in devs})
