@@ -810,6 +810,16 @@ class _TpuModel(Model, _TpuCaller):
         from .tracing import trace
 
         n_dev = mesh.devices.size
+
+        def _floor_chunk(c: int) -> int:
+            """Keep a (re)halved chunk on the bucket grid so full chunks
+            stay zero-bucket-padding (the invariant the initial floor
+            above establishes)."""
+            c = max(c, n_dev)
+            if get_config("shape_bucketing"):
+                c = max(bucket_rows_floor(c), n_dev)
+            return c
+
         outs: Dict[str, List[np.ndarray]] = {}
         lo = 0
         def _dispatch(lo: int):
@@ -849,8 +859,9 @@ class _TpuModel(Model, _TpuCaller):
         # attachments (the axon tunnel) this overlaps the two directions
         # instead of serializing stage -> compute -> fetch per chunk.
         # Two chunks are in flight, so each gets HALF the single-chunk
-        # budget (same peak device footprint as the serial loop)
-        chunk = max(chunk // 2, n_dev)
+        # budget (same peak device footprint as the serial loop), re-floored
+        # to the bucket grid
+        chunk = _floor_chunk(chunk // 2)
         pending = None
         while lo < n or pending is not None:
             current = None  # a dispatch failure must not reuse last round's
@@ -886,7 +897,7 @@ class _TpuModel(Model, _TpuCaller):
                                 pass  # the original error already surfaced
                 pending = current = None
                 lo = resume_at
-                chunk = max(chunk // 2, n_dev)
+                chunk = _floor_chunk(chunk // 2)
                 self.logger.warning(
                     f"Transform chunk exhausted device memory; resuming at "
                     f"row {lo} with chunk={chunk} rows"

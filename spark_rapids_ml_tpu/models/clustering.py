@@ -225,15 +225,27 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         # host-dispatched per-block iterations
         n, d = fit_input.X.shape
         budget = float(get_config("dispatch_flops_limit"))
-        fused_flops = 2.0 * n * d * k * max(max_iter, 1)
+        init = str(p["init"])
+        init_steps = int(p.get("init_steps") or 2)
+        oversample = float(p.get("oversampling_factor") or 2.0)
+        # the fused program also runs the init inside the same compiled
+        # region — count it, or a fit just under the Lloyd budget can
+        # still blow the per-program deadline (cost model shared with
+        # ops/kmeans.py: init_flops_accounting)
+        from ..ops.kmeans import init_flops_accounting
+
+        _, _, init_per_row = init_flops_accounting(
+            init, k, d, init_steps, oversample
+        )
+        fused_flops = 2.0 * n * d * k * max(max_iter, 1) + n * init_per_row
         kwargs = dict(
             k=k,
             seed=seed,
             max_iter=max_iter,
             tol=float(p["tol"]),
-            init=str(p["init"]),
-            init_steps=int(p.get("init_steps") or 2),
-            oversample=float(p.get("oversampling_factor") or 2.0),
+            init=init,
+            init_steps=init_steps,
+            oversample=oversample,
         )
         if fused_flops <= budget:
             fit_fn = kmeans_fit

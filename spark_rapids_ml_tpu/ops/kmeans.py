@@ -121,6 +121,32 @@ def kmeans_parallel_init(X: jax.Array, w: jax.Array, k: int, seed,
     return kmeans_init(cands, counts, k, seed + 1, "k-means++")
 
 
+def init_flops_accounting(
+    init: str, k: int, d: int, init_steps: int, oversample: float
+) -> tuple:
+    """Shared init cost model: (rounds, m, flops_per_row) for a given
+    init scheme.  Single source of truth for the fused-vs-stepwise gate
+    (models/clustering.py), the stepwise init subsampling below, and the
+    fused init's candidate-pool size — these MUST stay in lock-step or
+    the gate stops matching the budget it mirrors.
+      scalable: `rounds` D2 passes vs m candidates + one labeling pass
+                vs the 1 + rounds*m pool
+      random:   one Gumbel top-k pass, no matmuls
+      k-means++: k sequential D2 passes
+    """
+    rounds = max(init_steps, 1)
+    # per-round draw: l = oversample*k (Spark/cuML's oversampling
+    # factor), bumped so the candidate pool can cover k centers
+    m = max(int(round(oversample * k)), -(-(k - 1) // rounds), 1)
+    if init in ("scalable-k-means++", "k-means||"):
+        per_row = 2.0 * d * (rounds * m + (1 + rounds * m))
+    elif init == "random":
+        per_row = 1.0
+    else:  # sequential k-means++
+        per_row = 2.0 * d * k
+    return rounds, m, per_row
+
+
 @partial(jax.jit, static_argnames=("k", "max_iter", "init", "init_steps", "oversample"))
 def kmeans_fit(
     X: jax.Array,
@@ -141,13 +167,11 @@ def kmeans_fit(
     """
     n = X.shape[0]
     if init in ("scalable-k-means++", "k-means||"):
-        # per-round draw: l = oversample*k (Spark/cuML's oversampling
-        # factor), bumped so the candidate pool can cover k centers
-        m = max(int(round(oversample * k)), -(-(k - 1) // max(init_steps, 1)), 1)
-        m = min(m, n)
-        centers = kmeans_parallel_init(
-            X, w, k, seed, rounds=max(init_steps, 1), m=m
+        rounds, m, _ = init_flops_accounting(
+            init, k, X.shape[1], init_steps, oversample
         )
+        m = min(m, n)
+        centers = kmeans_parallel_init(X, w, k, seed, rounds=rounds, m=m)
     else:
         centers = kmeans_init(X, w, k, seed, init)
 
@@ -250,17 +274,12 @@ def kmeans_fit_stepwise(
     n, d = X.shape
     # ---- seeding ----
     # the init is ONE compiled program, so the subsample must bring ITS
-    # work under the same per-program budget the Lloyd blocks respect:
-    #   scalable: rounds passes vs m cands + one labeling pass vs 1+r*m
-    #   k-means++: k sequential D2 passes
-    rounds = max(init_steps, 1)
-    m = max(int(round(oversample * k)), -(-(k - 1) // rounds), 1)
-    if init in ("scalable-k-means++", "k-means||"):
-        per_row = 2.0 * d * (rounds * m + (1 + rounds * m))
-    elif init == "random":
-        per_row = 1.0  # one Gumbel top-k pass, no matmuls
-    else:  # sequential k-means++
-        per_row = 2.0 * d * k
+    # work under the same per-program budget the Lloyd blocks respect
+    # (cost model shared with the fused-vs-stepwise gate:
+    # init_flops_accounting above)
+    rounds, m, per_row = init_flops_accounting(
+        init, k, d, init_steps, oversample
+    )
     n_init_max = max(int(flops_budget // per_row), k)
     n_init = min(n, init_rows if per_row > 1.0 else n, n_init_max)
     if n_init < n:

@@ -89,8 +89,12 @@ def probe_num_features(
     on a 500k-row file (batch_size=1 forces a full row-group decode)."""
     if features_cols:
         return len(features_cols)
-    key = (path, features_col, _path_stamp(path))
-    hit = _PROBE_CACHE.get(key)
+    stamp = _path_stamp(path)
+    # no stamp (os.stat failed, e.g. an object-store URI pyarrow can still
+    # read): re-probe every call rather than cache under a key that would
+    # go stale if the remote dataset is rewritten in-place
+    key = None if stamp is None else (path, features_col, stamp)
+    hit = _PROBE_CACHE.get(key) if key is not None else None
     if hit is not None:
         return hit
     import pyarrow as pa
@@ -116,9 +120,10 @@ def probe_num_features(
             break
         if d is None:
             raise ValueError("Dataset is empty: nothing to fit/transform")
-    if len(_PROBE_CACHE) >= 64:
-        _PROBE_CACHE.pop(next(iter(_PROBE_CACHE)))
-    _PROBE_CACHE[key] = d
+    if key is not None:
+        if len(_PROBE_CACHE) >= 64:
+            _PROBE_CACHE.pop(next(iter(_PROBE_CACHE)))
+        _PROBE_CACHE[key] = d
     return d
 
 

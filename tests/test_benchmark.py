@@ -126,3 +126,29 @@ def test_pod_launcher_two_process(tmp_path):
     assert report.exists()
     content = report.read_text()
     assert "kmeans" in content and "inertia" in content
+
+
+def test_bench_refconfig_cpu_smoke(monkeypatch):
+    """The refconfig workload (bench.py's 1:1 reference-config matrix) is
+    chip-gated by default; this smoke exercises the whole path at toy
+    scale via the BENCH_REFCONFIG_CPU escape hatch so the code cannot rot
+    between TPU windows (VERDICT r4 weak 6).  All 7 workloads must
+    produce a *_fit_sec + *_vs_a10g_x pair, no *_error keys."""
+    monkeypatch.setenv("BENCH_REFCONFIG_CPU", "1")
+    monkeypatch.setenv("BENCH_REF_ROWS", "400")
+    monkeypatch.setenv("BENCH_REF_COLS", "16")
+    import importlib
+
+    import bench
+
+    importlib.reload(bench)  # re-read the env-driven sizes
+    extra = {}
+    bench.bench_refconfig(extra)
+    errors = {k: v for k, v in extra.items() if k.endswith("_error")}
+    assert not errors, errors
+    for name in ("pca", "logreg", "linreg", "kmeans",
+                 "ridge", "elasticnet", "rf_clf"):
+        # a scaled run must label keys with the REAL shape and emit no
+        # vs_a10g_x ratio (those belong to the 1:1 1Mx3000 config only)
+        assert f"refconfig_{name}_400x16_scaled_fit_sec" in extra, name
+        assert f"refconfig_{name}_vs_a10g_x" not in extra, name

@@ -115,3 +115,76 @@ def test_pca_cpu_fallback(rng):
         assert model.components_.shape == (2, 4)
     finally:
         config.reset_config()
+
+
+def test_stats_precision_config_retraces():
+    """Changing `stats_precision` must invalidate compiled kernels — it
+    is baked in at trace time (ops/precision.py), so without cache
+    invalidation a same-shape call would silently keep the old precision
+    (mirror of test_distance_precision_config_retraces)."""
+    import jax
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.ops.pca import pca_fit
+
+    X = np.random.default_rng(0).standard_normal((32, 5)).astype(np.float32)
+    w = np.ones((32,), np.float32)
+
+    def cov_fn(X, w):
+        return pca_fit(X, w, k=2)
+
+    jax.clear_caches()  # earlier tests' pca_fit shapes would skew counts
+    try:
+        set_config(stats_precision="highest")
+        assert "HIGHEST" in str(jax.make_jaxpr(cov_fn)(X, w))
+        pca_fit(X, w, k=2)
+        assert pca_fit._cache_size() == 1
+        set_config(stats_precision="default")
+        # the compiled HIGHEST executable must be GONE — a same-shape
+        # call would otherwise silently keep the old precision
+        assert pca_fit._cache_size() == 0
+        assert "HIGHEST" not in str(jax.make_jaxpr(cov_fn)(X, w))
+        pca_fit(X, w, k=2)
+        assert pca_fit._cache_size() == 1
+    finally:
+        reset_config()
+
+
+def test_stats_precision_invalid_value():
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.ops.precision import stats_precision
+
+    try:
+        set_config(stats_precision="sloppy")
+        with pytest.raises(ValueError, match="stats_precision"):
+            stats_precision()
+    finally:
+        reset_config()
+
+
+def test_stats_precision_results_invariant_on_cpu(rng):
+    """On CPU every precision level is true f32, so flipping the conf
+    must not change PCA components or LinReg coefficients — this pins
+    the conf to being a PRECISION knob, not a semantics knob."""
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    X = _make_data(rng, n=120, d=6)
+    yw = rng.standard_normal(6).astype(np.float32)
+    y = (X @ yw).astype(np.float32)
+    results = {}
+    try:
+        for level in ("highest", "high", "default"):
+            set_config(stats_precision=level)
+            m = PCA(k=3).setInputCol("features").fit(X)
+            lr = LinearRegression(regParam=0.0, elasticNetParam=0.0).fit(
+                (np.ascontiguousarray(X).astype(np.float32), y)
+            )
+            results[level] = (m.components_, np.asarray(lr.coefficients))
+    finally:
+        reset_config()
+    ref_c, ref_w = results["highest"]
+    for level in ("high", "default"):
+        c, wv = results[level]
+        np.testing.assert_allclose(np.abs(c), np.abs(ref_c), atol=1e-6)
+        np.testing.assert_allclose(wv, ref_w, atol=1e-6)
