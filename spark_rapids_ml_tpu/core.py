@@ -142,14 +142,29 @@ class _Writer:
         }
         arrays: Dict[str, np.ndarray] = {}
         if isinstance(inst, _TpuModel):
+            from .data import _is_sparse
+
             attrs: Dict[str, Any] = {}
+            sparse_attrs: List[str] = []
             for k, v in inst._get_model_attributes().items():
-                if isinstance(v, np.ndarray):
+                if _is_sparse(v):
+                    # CSR attributes (sparse kNN item sets, sparse UMAP raw
+                    # data) persist as their three component arrays + shape;
+                    # np.savez has no sparse container
+                    csr = v.tocsr()
+                    arrays[k + "__csr_data"] = np.asarray(csr.data)
+                    arrays[k + "__csr_indices"] = np.asarray(csr.indices)
+                    arrays[k + "__csr_indptr"] = np.asarray(csr.indptr)
+                    arrays[k + "__csr_shape"] = np.asarray(csr.shape, np.int64)
+                    sparse_attrs.append(k)
+                elif isinstance(v, np.ndarray):
                     arrays[k] = v
                 else:
                     attrs[k] = v
             metadata["attributes"] = attrs
             metadata["array_attributes"] = sorted(arrays)
+            if sparse_attrs:
+                metadata["sparse_attributes"] = sorted(sparse_attrs)
         with open(os.path.join(path, "metadata.json"), "w") as f:
             json.dump(metadata, f, default=_json_default)
         npz_path = os.path.join(path, "arrays.npz")
@@ -201,6 +216,17 @@ class _ReadWriteMixin:
             wanted = meta.get("array_attributes")
             if wanted is not None:
                 arrays = {k: v for k, v in arrays.items() if k in wanted}
+            for name in meta.get("sparse_attributes", []):
+                import scipy.sparse as sp
+
+                arrays[name] = sp.csr_matrix(
+                    (
+                        arrays.pop(name + "__csr_data"),
+                        arrays.pop(name + "__csr_indices"),
+                        arrays.pop(name + "__csr_indptr"),
+                    ),
+                    shape=tuple(arrays.pop(name + "__csr_shape")),
+                )
             attrs = dict(meta.get("attributes", {}))
             attrs.update(arrays)
             inst = cls._from_attributes(attrs)
@@ -945,6 +971,7 @@ class _TpuModel(Model, _TpuCaller):
 
         if _is_sparse(batch.X) and (
             type(self)._transform_device is not _TpuModel._transform_device
+            or getattr(self, "_accepts_sparse_transform", False)
         ):
             # keep CSR: _transform_mesh densifies chunk-by-chunk, so peak
             # host memory is one dense chunk instead of the whole matrix

@@ -43,6 +43,78 @@ def _ensure_dense(X: Any) -> np.ndarray:
     return X
 
 
+def densify_to_device(X, dtype, row_transform=None):
+    """Assemble a DENSE single-device jax array from a host CSR matrix in
+    row chunks, bounded by the `host_batch_bytes` budget — the TPU-first
+    analog of the reference's sparse fit staging (cuML UMAP `_sparse_fit`
+    umap.py:904-969 concatenates CSR chunks on the GPU).  TPU kernels take
+    dense operands (no cusparse analog), so the dense matrix must exist in
+    HBM; what this avoids is ever materializing more than one dense chunk
+    in HOST memory.
+
+    `row_transform` (optional) is applied to each dense host chunk before
+    the transfer (metric row preprocessing, ops/distances.preprocess_rows).
+    Returns a (n, d) jax array on the default device.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .native import densify_csr
+    from .streaming import chunk_rows_for
+
+    X = X.tocsr()
+    n, d = X.shape
+    dtype = np.dtype(dtype)
+    chunk = max(1, int(chunk_rows_for(d, dtype.itemsize)))
+    if n <= chunk:
+        dense = densify_csr(X, n, dtype)
+        if row_transform is not None:
+            dense = np.asarray(row_transform(dense), dtype=dtype)
+        return jnp.asarray(dense)
+    return assemble_dense_chunks(X, n, dtype, chunk, row_transform)
+
+
+def assemble_dense_chunks(
+    X, n_rows_out: int, dtype, chunk: int, row_transform=None,
+    out_shardings=None,
+):
+    """The shared chunk-bounded CSR -> dense device assembly loop (used by
+    `densify_to_device` and `RowStager.stage_sparse`): a zero buffer of
+    `n_rows_out` rows (optionally sharded) receives each densified host
+    chunk via donated in-place dynamic_update_slice writes — one compile
+    plus one tail compile; the traced start index keeps every full chunk
+    on one program.  Rows past the input length stay zero (padding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .native import densify_csr
+
+    n, d = X.shape
+    dtype = np.dtype(dtype)
+
+    def _dus(b, c, lo):
+        return jax.lax.dynamic_update_slice(
+            b, c, (lo, jnp.zeros((), jnp.int32))
+        )
+
+    if out_shardings is not None:
+        buf = jax.jit(
+            lambda: jnp.zeros((n_rows_out, d), dtype),
+            out_shardings=out_shardings,
+        )()
+        upd = jax.jit(_dus, donate_argnums=0, out_shardings=out_shardings)
+    else:
+        buf = jnp.zeros((n_rows_out, d), dtype)
+        upd = jax.jit(_dus, donate_argnums=0)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        dense = densify_csr(X[lo:hi], hi - lo, dtype)
+        if row_transform is not None:
+            dense = np.asarray(row_transform(dense), dtype=dtype)
+        buf = upd(buf, dense, jnp.asarray(lo, jnp.int32))
+    return buf
+
+
 def _to_pandas(dataset: DatasetLike):
     import pandas as pd
     import pyarrow as pa

@@ -64,13 +64,18 @@ class _KNNParams(_TpuParams, HasFeaturesCol, HasFeaturesCols, HasIDCol):
 
 
 def _extract_with_ids(
-    inst, dataset: DatasetLike
+    inst, dataset: DatasetLike, keep_sparse: bool = False
 ) -> Tuple[np.ndarray, np.ndarray, Any, bool]:
     """Extract (X, ids, source_frame).  The analog of `_ensureIdCol`
     (reference params.py:91-129): when the user names an idCol it is read
     from the dataset, otherwise monotonically-increasing row ids are
-    generated."""
+    generated.  With `keep_sparse` a CSR input stays CSR — the exact-kNN
+    paths stage it dense chunk-by-chunk (RowStager.stage_sparse), the
+    analog of the reference keeping CSR end-to-end through fit staging
+    (core.py:183-265)."""
     import pandas as pd
+
+    from ..data import _is_sparse
 
     features_col, features_cols = _resolve_feature_params(inst)
     id_col = (
@@ -86,7 +91,10 @@ def _extract_with_ids(
         dtype=None,
         supervised=False,
     )
-    X = _ensure_dense(batch.X)
+    if keep_sparse and _is_sparse(batch.X):
+        X = batch.X.tocsr()
+    else:
+        X = _ensure_dense(batch.X)
     if batch.row_id is not None:
         ids = np.asarray(batch.row_id)
         auto_ids = False
@@ -102,9 +110,10 @@ def _gather_items(X: np.ndarray, ids: np.ndarray, auto_ids: bool):
     generated ids are LOCAL positions per process; regenerate them as global
     positions after the gather so they match single-process numbering
     (user-provided idCol values pass through untouched)."""
-    from ..parallel.mesh import allgather_host_rows
+    from ..data import _is_sparse
+    from ..parallel.mesh import allgather_host_csr, allgather_host_rows
 
-    X = allgather_host_rows(X)
+    X = allgather_host_csr(X) if _is_sparse(X) else allgather_host_rows(X)
     if auto_ids:
         ids = np.arange(X.shape[0], dtype=np.int64)
     else:
@@ -187,6 +196,9 @@ class _NNModelBase(_TpuModel):
     item_features: np.ndarray
     item_ids: np.ndarray
     _item_df: Any
+    # exact search stages CSR queries chunk-bounded; the ANN index probes
+    # take dense host queries
+    _sparse_query_ok = False
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:
         raise NotImplementedError
@@ -222,9 +234,13 @@ class _NNModelBase(_TpuModel):
         slots are id -1 at distance inf)."""
         import pandas as pd
 
-        Q, q_ids, q_df, _ = _extract_with_ids(self, query_df)
+        from ..data import _is_sparse
+
+        Q, q_ids, q_df, _ = _extract_with_ids(
+            self, query_df, keep_sparse=self._sparse_query_ok
+        )
         k = int(self._tpu_params.get("n_neighbors", self.getOrDefault("k")))
-        dist, pos = self._search(np.asarray(Q), k)
+        dist, pos = self._search(Q if _is_sparse(Q) else np.asarray(Q), k)
         indices = np.where(pos >= 0, self.item_ids[np.maximum(pos, 0)], -1)
         knn_df = _assemble_knn_df(q_ids, indices, dist, sort_knn_df_by_query_id)
         item_df = self._item_df
@@ -282,18 +298,22 @@ class NearestNeighbors(_NNClass, _TpuEstimator, _KNNParams):
         self._set_params(**kwargs)
 
     def _fit(self, dataset: DatasetLike) -> "NearestNeighborsModel":
-        X, ids, df, auto_ids = _extract_with_ids(self, dataset)
+        from ..data import _is_sparse
+
+        X, ids, df, auto_ids = _extract_with_ids(self, dataset,
+                                                 keep_sparse=True)
         # multi-process: each process fit() sees its local items.  Small
         # item sets replicate on every host (simple model contract); past
         # `knn_replicate_max_bytes` features stay PROCESS-LOCAL and only
         # the id vector replicates — kneighbors stages each process's
         # block into the global sharded layout, so no host or device ever
-        # holds the full N x d matrix.
+        # holds the full N x d matrix.  CSR items stay CSR on the host;
+        # kneighbors stages them dense chunk-by-chunk.
         X, ids, distributed, n_global = _item_layout_for(
-            np.asarray(X), np.asarray(ids), auto_ids
+            X if _is_sparse(X) else np.asarray(X), np.asarray(ids), auto_ids
         )
         model = NearestNeighborsModel(
-            item_features=np.asarray(X),
+            item_features=X if _is_sparse(X) else np.asarray(X),
             item_ids=ids,
             n_cols=int(X.shape[1]),
             dtype=str(X.dtype),
@@ -312,9 +332,19 @@ class NearestNeighbors(_NNClass, _TpuEstimator, _KNNParams):
 class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
     """Fitted exact k-NN model (reference NearestNeighborsModel knn.py:516-940)."""
 
+    _sparse_query_ok = True
+
     def __init__(self, **attrs: Any) -> None:
         super().__init__(**attrs)
-        self.item_features: np.ndarray = np.asarray(attrs["item_features"])
+        from ..data import _is_sparse
+
+        feats = attrs["item_features"]
+        # sparse fits keep the item set CSR on the host (persisted as CSR
+        # component arrays, core.py _Writer.save); search stages it dense
+        # chunk-by-chunk (stage_sparse), bounding host peak memory
+        self.item_features = (
+            feats.tocsr() if _is_sparse(feats) else np.asarray(feats)
+        )
         self.item_ids: np.ndarray = np.asarray(attrs["item_ids"])
         self.n_cols = int(attrs.get("n_cols", self.item_features.shape[1]))
         self.dtype = str(attrs.get("dtype", self.item_features.dtype))
@@ -336,17 +366,30 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         same layout in global process-major order and are remapped to user
         ids on the host afterwards (as the reference remaps cuml row ids,
         knn.py:787-801)."""
+        from ..data import _is_sparse
         from ..parallel.mesh import RowStager
 
         key = (id(mesh), str(dtype))
         if self._device_items is not None and self._device_items[0] == key:
             return self._device_items[1]
+        sparse_items = _is_sparse(self.item_features)
         if self.distributed_items:
-            st = RowStager(self.item_features.shape[0], mesh)
+            st = RowStager(
+                self.item_features.shape[0], mesh,
+                bucketing=False if sparse_items else None,
+            )
         else:
-            st = RowStager.for_replicated(self.item_features.shape[0], mesh)
-        staged = (st.stage(self.item_features, dtype), st.mask(dtype),
-                  st.row_ids())
+            st = RowStager.for_replicated(
+                self.item_features.shape[0], mesh,
+                bucketing=False if sparse_items else None,
+            )
+        staged = (
+            st.stage_sparse(self.item_features, dtype)
+            if sparse_items
+            else st.stage(self.item_features, dtype),
+            st.mask(dtype),
+            st.row_ids(),
+        )
         self._device_items = (key, staged)
         return staged
 
@@ -377,6 +420,8 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         from ..parallel import TpuContext
         from ..parallel.mesh import RowStager
 
+        from ..data import _is_sparse
+
         n_items = self.n_items_global
         if k > n_items:
             raise ValueError(f"k={k} exceeds the number of items ({n_items})")
@@ -384,8 +429,12 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
             mesh = ctx.mesh
         dtype = self._out_dtype(self.item_features)
         items, valid, ids = self._staged_items(mesh, dtype)
-        qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
-        queries = qst.stage(np.asarray(Q), dtype)
+        if _is_sparse(Q):
+            qst = RowStager.for_replicated(Q.shape[0], mesh, bucketing=False)
+            queries = qst.stage_sparse(Q, dtype)
+        else:
+            qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
+            queries = qst.stage(np.asarray(Q), dtype)
         if mesh.devices.size == 1:
             d2, idx = knn_topk_single(items, valid, ids, queries, k=k)
         else:

@@ -50,8 +50,10 @@ class UMAPClass:
         from ..ops.distances import SUPPORTED_METRICS
 
         return {
-            # the cuML metric zoo minus sparse-only jaccard (reference
-            # umap.py:203-212); ops/distances.py implements the kernels
+            # the full cuML metric zoo incl. jaccard (which the reference
+            # limits to sparse inputs, umap.py:1145-1146 — the tiled
+            # elementwise kernel here serves dense inputs too);
+            # ops/distances.py implements the kernels
             "metric": lambda x: x if x in SUPPORTED_METRICS else None,
             "init": lambda x: x if x in ("spectral", "random") else None,
             "build_algo": lambda x: x
@@ -179,6 +181,43 @@ class _UMAPParams(
         return self
 
 
+# spectral(PCA) init on sparse input builds a d x d Gram on the host; past
+# this feature count the eigh dominates fit time, so fall back to random
+_SPARSE_SPECTRAL_MAX_D = 4096
+
+
+def _sparse_pca_basis_project(X, n_comp: int, dtype) -> np.ndarray:
+    """Chunked-Gram PCA projection of a CSR matrix — the sparse stand-in
+    for the dense-SVD spectral-init basis.  Accumulates the d x d Gram over
+    dense row chunks on the device (donated in-place adds), eigh's the
+    covariance on the host, then projects chunks.  Host peak memory is one
+    `host_batch_bytes` chunk plus the d x d Gram."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..native import densify_csr
+    from ..streaming import chunk_rows_for
+
+    n, d = X.shape
+    # f64 projection chunks: size by 8-byte items so the dense chunk stays
+    # within the host_batch_bytes budget
+    chunk = max(1, int(chunk_rows_for(d, 8)))
+    mean = np.asarray(X.mean(axis=0)).ravel().astype(np.float64)
+    G = jnp.zeros((d, d), jnp.float32)
+    acc = jax.jit(lambda g, c: g + c.T @ c, donate_argnums=0)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        G = acc(G, jnp.asarray(densify_csr(X[lo:hi], hi - lo, np.float32)))
+    cov = np.asarray(jax.device_get(G), np.float64) / n - np.outer(mean, mean)
+    _, v = np.linalg.eigh(cov)
+    V = v[:, ::-1][:, :n_comp]  # top components, descending eigenvalue
+    pc = np.empty((n, n_comp), np.float64)
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        pc[lo:hi] = (densify_csr(X[lo:hi], hi - lo, np.float64) - mean) @ V
+    return pc.astype(dtype)
+
+
 class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
     """Uniform Manifold Approximation and Projection on TPU (API parity:
     reference UMAP umap.py:681-1348).
@@ -219,19 +258,31 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
 
         t0 = time.time()
         batch = self._extract(dataset)
-        X = _ensure_dense(batch.X)
-        dtype = self._out_dtype(X)
-        X = np.ascontiguousarray(X, dtype=dtype)
+        from ..data import _is_sparse
+
+        sparse_in = _is_sparse(batch.X)
+        if sparse_in:
+            # CSR fit (the analog of reference `_sparse_fit` umap.py:904-969,
+            # which concatenates CSR chunks on the GPU): rows stay CSR on the
+            # host end-to-end; the dense device matrix the TPU kernels need
+            # is assembled chunk-by-chunk (densify_to_device), so host peak
+            # memory is one `host_batch_bytes` chunk, never the full matrix
+            X = batch.X.tocsr()
+            dtype = self._out_dtype(X)
+        else:
+            X = _ensure_dense(batch.X)
+            dtype = self._out_dtype(X)
+            X = np.ascontiguousarray(X, dtype=dtype)
         p = self._tpu_params
         rs = p.get("random_state")
         seed = int(rs) if rs is not None else 42
 
-        from ..parallel.mesh import allgather_host_rows
+        from ..parallel.mesh import allgather_host_csr, allgather_host_rows
 
         # single-worker fit strategy (the reference forces UMAP fit onto one
         # worker, umap.py:926-948): in multi-process mode every process
         # gathers the full sample and computes the identical model
-        X = allgather_host_rows(X)
+        X = allgather_host_csr(X) if sparse_in else allgather_host_rows(X)
         y_all: Optional[np.ndarray] = None
         if batch.y is not None:
             y_all = allgather_host_rows(np.asarray(batch.y, np.float64))
@@ -254,11 +305,19 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         metric = str(p.get("metric", "euclidean"))
         pw = float(dict(p.get("metric_kwds") or {}).get("p", 2.0))
         X_graph = X_fit
+        row_tf = None
         if metric_kind(metric) == "matmul":
             # row transform folds cosine/correlation/hellinger onto the
             # MXU euclidean kernel (ops/distances.py); asarray keeps the
-            # identity metrics (euclidean/l2/sqeuclidean) copy-free
-            X_graph = np.asarray(preprocess_rows(X_fit, metric), dtype=dtype)
+            # identity metrics (euclidean/l2/sqeuclidean) copy-free.  The
+            # transform is row-local, so the sparse path applies it per
+            # dense chunk during device assembly instead
+            if sparse_in:
+                row_tf = lambda c: preprocess_rows(c, metric)  # noqa: E731
+            else:
+                X_graph = np.asarray(
+                    preprocess_rows(X_fit, metric), dtype=dtype
+                )
 
         # 1. kNN graph (self excluded).  build_algo mirrors cuML UMAP
         # (reference umap.py:362-370): brute force for small n, NN-descent
@@ -277,7 +336,12 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
                 "brute_force_knn (O(n\u00b2) at this row count)"
             )
             use_nnd = False
-        Xd = jnp.asarray(X_graph)
+        if sparse_in:
+            from ..data import densify_to_device
+
+            Xd = densify_to_device(X_graph, dtype, row_transform=row_tf)
+        else:
+            Xd = jnp.asarray(X_graph)
         if use_nnd:
             from ..ops.cagra import knn_graph_nn_descent
             from ..ops.distances import finalize_sqdist
@@ -342,12 +406,23 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
         # 4. init
         dim = int(p["n_components"])
         rng = np.random.default_rng(seed)
-        if str(p["init"]) == "random":
+        init = str(p["init"])
+        if init != "random" and sparse_in and d > _SPARSE_SPECTRAL_MAX_D:
+            self.logger.warning(
+                f"init='spectral' on sparse input needs a {d}x{d} Gram "
+                f"(> {_SPARSE_SPECTRAL_MAX_D} feature cap); using random "
+                "init"
+            )
+            init = "random"
+        if init == "random":
             emb0 = rng.uniform(-10.0, 10.0, (n, dim)).astype(dtype)
         else:  # "spectral" -> scaled PCA basis + jitter
-            Xc = X_fit - X_fit.mean(axis=0)
-            _, _, vt = np.linalg.svd(Xc, full_matrices=False)
-            pc = Xc @ vt[: min(dim, d)].T
+            if sparse_in:
+                pc = _sparse_pca_basis_project(X_fit, min(dim, d), dtype)
+            else:
+                Xc = X_fit - X_fit.mean(axis=0)
+                _, _, vt = np.linalg.svd(Xc, full_matrices=False)
+                pc = Xc @ vt[: min(dim, d)].T
             pc = pc / max(np.abs(pc).max(), 1e-12) * 10.0
             if dim > pc.shape[1]:  # fewer features than components: pad
                 pad = rng.uniform(-10.0, 10.0, (n, dim - pc.shape[1]))
@@ -373,6 +448,10 @@ class UMAP(UMAPClass, _TpuEstimator, _UMAPParams):
                 initial_alpha=float(p["learning_rate"]),
                 negative_sample_rate=int(p["negative_sample_rate"]),
                 repulsion_strength=float(p["repulsion_strength"]),
+                # an explicit random_state opts into reproducible fits:
+                # the umap_kernel=auto choice then follows the platform
+                # prior instead of the (noise-susceptible) measured probe
+                deterministic=rs is not None,
             )
         else:
             emb = jnp.asarray(emb0)
@@ -409,10 +488,19 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
     data, then initializes each query point at the membership-weighted
     average of its neighbors' embeddings (umap-learn transform init)."""
 
+    # core._transform hands CSR queries straight through (chunk-bounded
+    # densify happens in the staging below, never whole on the host)
+    _accepts_sparse_transform = True
+
     def __init__(self, **attrs: Any) -> None:
         super().__init__(**attrs)
+        from ..data import _is_sparse
+
         self.embedding_: np.ndarray = np.asarray(attrs["embedding_"])
-        self.raw_data_: np.ndarray = np.asarray(attrs["raw_data_"])
+        raw = attrs["raw_data_"]
+        # sparse fits keep the raw training data CSR (persisted as CSR
+        # component arrays, core.py _Writer.save)
+        self.raw_data_ = raw.tocsr() if _is_sparse(raw) else np.asarray(raw)
         self.rho_: np.ndarray = np.asarray(attrs["rho_"])
         self.sigma_: np.ndarray = np.asarray(attrs["sigma_"])
         self.a_: float = float(attrs["a_"])
@@ -448,32 +536,56 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
                 f"n_neighbors={k} exceeds the {self.raw_data_.shape[0]} "
                 f"training rows in the model"
             )
-        Xq = np.ascontiguousarray(X, dtype=self._out_dtype(X))
+        from ..data import _is_sparse
+
+        sparse_q = _is_sparse(X)
+        Xq = (
+            X.tocsr()
+            if sparse_q
+            else np.ascontiguousarray(X, dtype=self._out_dtype(X))
+        )
         items = self.raw_data_
+        sparse_items = _is_sparse(items)
+        dtype = np.dtype(self._out_dtype(Xq))
         metric = str(self._tpu_params.get("metric", "euclidean"))
         pw = float(
             dict(self._tpu_params.get("metric_kwds") or {}).get("p", 2.0)
         )
+        row_tf = None
         if metric_kind(metric) == "matmul":
             # the same row transform the fit applied, so the distances
             # match the fit's rho/sigma scales (NOTE: since round 3 the
             # cosine/correlation convention is 1-cos, not the chord
-            # distance older saved models were fitted with)
-            dt = Xq.dtype
-            items = np.asarray(preprocess_rows(items, metric), dtype=dt)
-            Xq = np.asarray(preprocess_rows(Xq, metric), dtype=dt)
+            # distance older saved models were fitted with).  Sparse
+            # operands apply it per dense chunk inside stage_sparse
+            row_tf = lambda c: preprocess_rows(c, metric)  # noqa: E731
+            if not sparse_items:
+                items = np.asarray(preprocess_rows(items, metric), dtype)
+            if not sparse_q:
+                Xq = np.asarray(preprocess_rows(Xq, metric), dtype)
 
         with TpuContext(self.num_workers, require_p2p=True) as ctx:
             mesh = ctx.mesh
-        dtype = Xq.dtype
         from ..parallel.mesh import RowStager
 
-        ist = RowStager.for_replicated(items.shape[0], mesh)
-        Xi = ist.stage(items, dtype)
+        ist = RowStager.for_replicated(
+            items.shape[0], mesh, bucketing=False if sparse_items else None
+        )
+        Xi = (
+            ist.stage_sparse(items, dtype, row_transform=row_tf)
+            if sparse_items
+            else ist.stage(items, dtype)
+        )
         validd = ist.mask(dtype)
         idsd = ist.row_ids()
-        qst = RowStager.for_replicated(Xq.shape[0], mesh)
-        Qs = qst.stage(Xq, dtype)
+        qst = RowStager.for_replicated(
+            Xq.shape[0], mesh, bucketing=False if sparse_q else None
+        )
+        Qs = (
+            qst.stage_sparse(Xq, dtype, row_transform=row_tf)
+            if sparse_q
+            else qst.stage(Xq, dtype)
+        )
         knn_d, inds = umap_knn_graph(
             Xi, validd, idsd, Qs, k=k, metric=metric, p=pw, mesh=mesh
         )

@@ -25,7 +25,7 @@ MATMUL_METRICS = {
 }
 ELEMENTWISE_METRICS = {
     "manhattan", "l1", "cityblock", "taxicab", "chebyshev", "linf",
-    "canberra", "minkowski", "hamming",
+    "canberra", "minkowski", "hamming", "jaccard",
 }
 SUPPORTED_METRICS = MATMUL_METRICS | ELEMENTWISE_METRICS
 
@@ -94,6 +94,23 @@ def _pairwise_elementwise(Qb, Xb, metric: str, p: float):
         return s ** (1.0 / p)
     if metric == "hamming":
         return (Qb[:, None, :] != Xb[None, :, :]).mean(axis=2).astype(Qb.dtype)
+    if metric == "jaccard":
+        # binarized set distance 1 - |x & y| / |x | y| (the cuML metric is
+        # sparse-input-only, reference umap.py:1145-1146; the tiled dense
+        # kernel here serves dense AND chunk-densified sparse rows).  Two
+        # all-zero rows are at distance 0, matching scipy/umap-learn.
+        # One 3-D reduction: union derives from the 2-D per-row nonzero
+        # counts as nnz(q) + nnz(x) - inter.
+        qa = Qb != 0
+        xa = Xb != 0
+        inter = (qa[:, None, :] & xa[None, :, :]).sum(axis=2).astype(Qb.dtype)
+        union = (
+            qa.sum(axis=1).astype(Qb.dtype)[:, None]
+            + xa.sum(axis=1).astype(Qb.dtype)[None, :]
+            - inter
+        )
+        return jnp.where(union > 0, 1.0 - inter / jnp.maximum(union, 1.0),
+                         0.0)
     raise ValueError(f"not an elementwise metric: {metric}")
 
 

@@ -293,6 +293,7 @@ def optimize_embedding(
     initial_alpha,
     negative_sample_rate: int = 5,
     repulsion_strength: float = 1.0,
+    deterministic: bool = False,
 ):
     """umap-learn SGD over `n_epochs`, dispatched from the host in epoch
     chunks sized adaptively so no single device program approaches the
@@ -339,6 +340,15 @@ def optimize_embedding(
     elif mode == "generic" or not structured_ok:
         structured = False
         decided_by = "forced" if mode == "generic" else "structure-missing"
+    elif deterministic:
+        # random_state set: reproducibility outranks the measured probe —
+        # two same-seed fits must not diverge because host timing noise
+        # flipped the kernel choice (cuML documents the same trade:
+        # "setting a random_state will [reduce] performance", umap.py
+        # random_state docstring).  The platform prior decides, the same
+        # way for every fit.
+        structured = jax.default_backend() == "tpu"
+        decided_by = "random-state-platform-prior"
     elif n_epochs < 10:
         # too few epochs to amortize a second kernel compile: fall back to
         # the platform prior (scatters serialize on TPU, are cheap on CPU)

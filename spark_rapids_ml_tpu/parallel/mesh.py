@@ -195,7 +195,9 @@ class RowStager:
         self.n_padded = s * n_dev
 
     @classmethod
-    def for_replicated(cls, n_rows: int, mesh: Mesh) -> "RowStager":
+    def for_replicated(
+        cls, n_rows: int, mesh: Mesh, bucketing: Optional[bool] = None
+    ) -> "RowStager":
         """Stager for host arrays REPLICATED on every process (model
         attributes, transform inputs the caller holds in full).  Each
         process stages only its even block of the global rows, so the
@@ -203,7 +205,7 @@ class RowStager:
         duplicate.  Single-process this is identical to RowStager."""
         _ensure_distributed()
         if jax.process_count() == 1:
-            return cls(n_rows, mesh)
+            return cls(n_rows, mesh, bucketing=bucketing)
         pid, n_proc = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
 
@@ -274,6 +276,75 @@ class RowStager:
             return jax.device_put(self._to_layout(padded), sharding)
         return jax.make_array_from_process_local_data(
             sharding, padded, (self.n_padded,) + padded.shape[1:]
+        )
+
+    def stage_sparse(
+        self,
+        X,
+        dtype: Optional[np.dtype] = None,
+        row_transform=None,
+    ) -> jax.Array:
+        """Stage a host CSR matrix as the DENSE padded sharded device array
+        `stage` would produce for its densification — without ever holding
+        more than one `host_batch_bytes` dense chunk in host memory
+        (single-process), or more than this process's local block
+        (multi-process, where the block is already the bounded working
+        set).  TPU kernels take dense operands; this bounds the HOST peak,
+        the analog of the reference keeping CSR end-to-end through staging
+        (core.py:183-265).
+
+        `row_transform` is applied per dense host chunk before transfer
+        (metric row preprocessing).  Requires a non-interleaved layout —
+        build the stager with ``bucketing=False`` for sparse staging."""
+        from ..native import densify_csr
+        from ..streaming import chunk_rows_for
+
+        if self._interleave:
+            raise ValueError(
+                "sparse chunked staging requires the contiguous row layout; "
+                "construct the RowStager with bucketing=False"
+            )
+        X = X.tocsr()
+        if self._replicated_input:
+            if X.shape[0] != self.n_valid:
+                raise ValueError(
+                    f"replicated matrix has {X.shape[0]} rows, expected "
+                    f"{self.n_valid}"
+                )
+            X = X[self._lo : self._lo + self.n_local]
+        if X.shape[0] != self.n_local:
+            raise ValueError(
+                f"matrix has {X.shape[0]} rows, stager expects {self.n_local}"
+            )
+        d = int(X.shape[1])
+        dtype = np.dtype(dtype) if dtype is not None else np.dtype(X.dtype)
+        ensure_x64(dtype)
+        chunk = max(1, int(chunk_rows_for(d, dtype.itemsize)))
+        sharding = NamedSharding(self.mesh, data_pspec(2))
+
+        def _chunk(lo: int, hi: int) -> np.ndarray:
+            dense = densify_csr(X[lo:hi], hi - lo, dtype)
+            if row_transform is not None:
+                dense = np.asarray(row_transform(dense), dtype=dtype)
+            return dense
+
+        if self.n_proc > 1:
+            # per-process block assembly: peak host memory is the local
+            # padded block (< 1/n_proc of the data + <1 device share of
+            # padding), the same bound the dense multi-process path has
+            padded = np.zeros((self.local_padded, d), dtype)
+            for lo in range(0, self.n_local, chunk):
+                hi = min(lo + chunk, self.n_local)
+                padded[lo:hi] = _chunk(lo, hi)
+            return jax.make_array_from_process_local_data(
+                sharding, padded, (self.n_padded, d)
+            )
+
+        from ..data import assemble_dense_chunks
+
+        return assemble_dense_chunks(
+            X, self.n_padded, dtype, chunk, row_transform,
+            out_shardings=sharding,
         )
 
     # -- single-process round-robin device layout ---------------------------
@@ -417,6 +488,28 @@ def allgather_host_rows(arr: np.ndarray) -> np.ndarray:
     gathered = np.asarray(multihost_utils.process_allgather(padded))
     return np.concatenate(
         [gathered[p, : int(c)] for p, c in enumerate(counts)], axis=0
+    )
+
+
+def allgather_host_csr(X):
+    """`allgather_host_rows` for scipy CSR matrices: concatenate per-process
+    CSR row blocks into the full CSR matrix on EVERY process WITHOUT any
+    process densifying — the three component arrays (data, indices, per-row
+    counts) gather as ragged 1-D blocks and the global indptr rebuilds from
+    the counts.  No-op single-process."""
+    _ensure_distributed()
+    X = X.tocsr()
+    if jax.process_count() == 1:
+        return X
+    import scipy.sparse as sp
+
+    n_cols = int(X.shape[1])
+    data = allgather_host_rows(np.asarray(X.data))
+    indices = allgather_host_rows(np.asarray(X.indices, np.int64))
+    row_nnz = allgather_host_rows(np.diff(X.indptr).astype(np.int64))
+    indptr = np.concatenate([[0], np.cumsum(row_nnz)])
+    return sp.csr_matrix(
+        (data, indices, indptr), shape=(len(row_nnz), n_cols)
     )
 
 

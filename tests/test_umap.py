@@ -174,14 +174,15 @@ def test_supervised_umap_regression_target_rejected(rng):
 
 
 # ---------------------------------------------------------------------------
-# Metric zoo (ops/distances.py — the cuML metric list minus sparse jaccard)
+# Metric zoo (ops/distances.py — the full cuML metric list; jaccard, which
+# cuML limits to sparse inputs, runs on the same tiled kernel here)
 # ---------------------------------------------------------------------------
 
 
 @pytest.mark.parametrize(
     "metric,kw",
     [("manhattan", {}), ("chebyshev", {}), ("canberra", {}),
-     ("minkowski", {"p": 3}), ("hamming", {})],
+     ("minkowski", {"p": 3}), ("hamming", {}), ("jaccard", {})],
 )
 def test_elementwise_knn_matches_sklearn(rng, metric, kw):
     import jax.numpy as jnp
@@ -190,7 +191,7 @@ def test_elementwise_knn_matches_sklearn(rng, metric, kw):
     from spark_rapids_ml_tpu.ops.distances import knn_topk_metric
 
     X = rng.normal(size=(300, 6)).astype(np.float32)
-    if metric == "hamming":
+    if metric in ("hamming", "jaccard"):
         X = (X > 0).astype(np.float32)
     Q = X[:40]
     k = 5
@@ -401,6 +402,22 @@ def test_umap_kernel_auto_probes_by_measurement(rng):
         set_config(umap_kernel="auto")
         uops.optimize_embedding(emb0, heads, tails, w, 0, 4, 1.58, 0.9, 1.0)
         assert uops.LAST_KERNEL_DECISION["decided_by"] == "platform-prior"
+
+        # deterministic (model fits with random_state set): reproducibility
+        # outranks the probe — same-seed fits must never diverge because
+        # timing noise flipped the kernel
+        set_config(umap_kernel="auto")
+        out_a = uops.optimize_embedding(
+            emb0, heads, tails, w, 0, 20, 1.58, 0.9, 1.0,
+            deterministic=True,
+        )
+        assert (uops.LAST_KERNEL_DECISION["decided_by"]
+                == "random-state-platform-prior")
+        out_b = uops.optimize_embedding(
+            emb0, heads, tails, w, 0, 20, 1.58, 0.9, 1.0,
+            deterministic=True,
+        )
+        np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
 
         # non-head-major edge list can never take the structured kernel
         set_config(umap_kernel="auto")
