@@ -578,79 +578,64 @@ def _bench_refconfig_inner(extra: dict, n: int, d: int, td: str):
     at_ref_scale = (n, d) == (1_000_000, 3000)
     label = "1Mx3000" if at_ref_scale else f"{n}x{d}_scaled"
 
+    from spark_rapids_ml_tpu import streaming as _streaming
+
     def record(name, el):
         extra[f"refconfig_{name}_{label}_fit_sec"] = round(el, 2)
         if at_ref_scale:
             extra[f"refconfig_{name}_vs_a10g_x"] = round(ref[name] / el, 2)
+        # stage-vs-solve split: on the tunneled dev chip the host->device
+        # link (~13 MB/s observed) dominates fit time; the solve number
+        # is what a real TPU host (TB/s DMA) would see next to the IO
+        stage = dict(_streaming.LAST_STAGE)
+        if stage:
+            extra[f"refconfig_{name}_stage_sec"] = stage["seconds"]
+            extra[f"refconfig_{name}_solve_sec"] = round(
+                max(el - stage["seconds"], 0.0), 2
+            )
+            extra.setdefault("stage_mb_per_s", stage["mb_per_s"])
 
-    try:
-        from spark_rapids_ml_tpu.feature import PCA
-
-        t0 = time.perf_counter()
-        PCA(k=3).setInputCol("features").fit(path)
-        record("pca", time.perf_counter() - t0)
-    except Exception as e:
-        extra["refconfig_pca_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    try:
-        from spark_rapids_ml_tpu.classification import LogisticRegression
-
-        t0 = time.perf_counter()
-        LogisticRegression(
-            maxIter=200, tol=1e-30, regParam=1e-5, standardization=False
-        ).fit(path)
-        record("logreg", time.perf_counter() - t0)
-    except Exception as e:
-        extra["refconfig_logreg_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    try:
-        from spark_rapids_ml_tpu.regression import LinearRegression
-
-        t0 = time.perf_counter()
-        LinearRegression(
-            regParam=0.0, elasticNetParam=0.0, standardization=False
-        ).fit(path)
-        record("linreg", time.perf_counter() - t0)
-    except Exception as e:
-        extra["refconfig_linreg_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    # ridge / elasticnet (reference run_benchmark.sh:104-124: regParam 1e-5,
-    # elasticNetParam 0.5 / 0.0, tol 1e-30, maxIter 10, no standardization)
-    for name, enet in (("ridge", 0.0), ("elasticnet", 0.5)):
+    def run(name, fit_fn):
+        # clear BEFORE the fit: a fit that stages then fails, or one that
+        # never calls stage_parquet (streamed-stats route), must not
+        # inherit the previous workload's staging split
+        _streaming.LAST_STAGE.clear()
         try:
-            from spark_rapids_ml_tpu.regression import LinearRegression
-
             t0 = time.perf_counter()
-            LinearRegression(
-                regParam=1e-5, elasticNetParam=enet, tol=1e-30,
-                maxIter=10, standardization=False,
-            ).fit(path)
+            fit_fn()
             record(name, time.perf_counter() - t0)
         except Exception as e:
             extra[f"refconfig_{name}_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    from spark_rapids_ml_tpu.classification import (
+        LogisticRegression,
+        RandomForestClassifier,
+    )
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.regression import LinearRegression
+
+    run("pca", lambda: PCA(k=3).setInputCol("features").fit(path))
+    run("logreg", lambda: LogisticRegression(
+        maxIter=200, tol=1e-30, regParam=1e-5, standardization=False
+    ).fit(path))
+    run("linreg", lambda: LinearRegression(
+        regParam=0.0, elasticNetParam=0.0, standardization=False
+    ).fit(path))
+    # ridge / elasticnet (reference run_benchmark.sh:104-124: regParam 1e-5,
+    # elasticNetParam 0.5 / 0.0, tol 1e-30, maxIter 10, no standardization)
+    for name, enet in (("ridge", 0.0), ("elasticnet", 0.5)):
+        run(name, lambda enet=enet: LinearRegression(
+            regParam=1e-5, elasticNetParam=enet, tol=1e-30,
+            maxIter=10, standardization=False,
+        ).fit(path))
     # RF classifier (run_benchmark.sh:129-136: 50 trees, depth 13, 128 bins)
-    try:
-        from spark_rapids_ml_tpu.classification import RandomForestClassifier
-
-        t0 = time.perf_counter()
-        RandomForestClassifier(
-            numTrees=50, maxDepth=13, maxBins=128, seed=0
-        ).fit(path)
-        record("rf_clf", time.perf_counter() - t0)
-    except Exception as e:
-        extra["refconfig_rf_clf_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    try:
-        from spark_rapids_ml_tpu.clustering import KMeans
-
-        t0 = time.perf_counter()
-        KMeans(
-            k=min(1000, n // 4), tol=1e-20, maxIter=30, initMode="random"
-        ).setFeaturesCol("features").fit(path)
-        record("kmeans", time.perf_counter() - t0)
-    except Exception as e:
-        extra["refconfig_kmeans_error"] = f"{type(e).__name__}: {e}"[:160]
+    run("rf_clf", lambda: RandomForestClassifier(
+        numTrees=50, maxDepth=13, maxBins=128, seed=0
+    ).fit(path))
+    run("kmeans", lambda: KMeans(
+        k=min(1000, n // 4), tol=1e-20, maxIter=30, initMode="random"
+    ).setFeaturesCol("features").fit(path))
 
 
 _state = {"rows_per_sec": 0.0, "vs_baseline": 0.0, "extra": {}, "printed": False}
@@ -792,6 +777,19 @@ def main() -> None:
     except OSError:
         pass
     extra["warm_runs_per_timing"] = 3  # min-of-3 for all *_warm_* keys
+    # host->device link bandwidth (one 32 MB put): on the tunneled dev
+    # chip this is ~13 MB/s and dominates staged fits — the artifact must
+    # say so itself rather than let the tunnel masquerade as solver time
+    try:
+        import numpy as _np
+
+        _buf = _np.zeros((8_000_000,), _np.float32)
+        _t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(_buf))
+        extra["device_put_mb_s"] = round(32.0 / (time.perf_counter() - _t0), 1)
+        del _buf
+    except Exception:
+        pass
 
     benches = {
         "pca": bench_pca,

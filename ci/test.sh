@@ -92,7 +92,7 @@ run_batch tests/test_common_estimator.py tests/test_metrics.py \
 run_batch tests/test_logistic_regression.py tests/test_sparse_logreg.py \
     tests/test_f32_and_weights.py tests/test_random_forest.py "$@"
 run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
-    tests/test_pallas_knn.py "$@"
+    tests/test_pallas_knn.py tests/test_sparse_fit.py "$@"
 run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \

@@ -65,14 +65,25 @@ def run_killable(cmd, timeout, env=None, stdout=None):
             tail = errf.read()[-2000:].decode("utf-8", "replace")
             return rc, False, tail
         except subprocess.TimeoutExpired:
+            # TERM first: bench.py's SIGTERM handler emits the partial
+            # JSON line (everything measured so far), which this loop can
+            # still parse and commit — a straight SIGKILL would discard
+            # hours of completed workloads
             try:
-                os.killpg(p.pid, 9)
+                os.killpg(p.pid, 15)
             except OSError:
-                p.kill()
+                p.terminate()
             try:
-                p.wait(timeout=10)
+                p.wait(timeout=60)
             except subprocess.TimeoutExpired:
-                pass  # abandon
+                try:
+                    os.killpg(p.pid, 9)
+                except OSError:
+                    p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass  # abandon
             return None, True, ""
 
 

@@ -372,16 +372,21 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
         key = (id(mesh), str(dtype))
         if self._device_items is not None and self._device_items[0] == key:
             return self._device_items[1]
+        # items ALWAYS stage contiguous (interleave=False): the
+        # interleaved layout breaks distance ties by device-layout
+        # position, so a sparse fit (contiguous-only staging) or a
+        # different device count would return different neighbors among
+        # tied candidates.  Contiguous staging ties break by original
+        # item position — identical for dense/sparse and for any n_dev —
+        # while bucketed padding still shares compiles.
         sparse_items = _is_sparse(self.item_features)
         if self.distributed_items:
             st = RowStager(
-                self.item_features.shape[0], mesh,
-                bucketing=False if sparse_items else None,
+                self.item_features.shape[0], mesh, interleave=False,
             )
         else:
             st = RowStager.for_replicated(
-                self.item_features.shape[0], mesh,
-                bucketing=False if sparse_items else None,
+                self.item_features.shape[0], mesh, interleave=False,
             )
         staged = (
             st.stage_sparse(self.item_features, dtype)
@@ -429,11 +434,19 @@ class NearestNeighborsModel(_NNClass, _NNModelBase, _KNNParams):
             mesh = ctx.mesh
         dtype = self._out_dtype(self.item_features)
         items, valid, ids = self._staged_items(mesh, dtype)
+        # queries stage contiguous like the items: the query's device
+        # decides its ring start offset, so an interleaved dense layout
+        # vs the contiguous sparse layout would merge item blocks in
+        # different orders and resolve distance TIES differently
         if _is_sparse(Q):
-            qst = RowStager.for_replicated(Q.shape[0], mesh, bucketing=False)
+            qst = RowStager.for_replicated(
+                Q.shape[0], mesh, interleave=False
+            )
             queries = qst.stage_sparse(Q, dtype)
         else:
-            qst = RowStager.for_replicated(np.asarray(Q).shape[0], mesh)
+            qst = RowStager.for_replicated(
+                np.asarray(Q).shape[0], mesh, interleave=False
+            )
             queries = qst.stage(np.asarray(Q), dtype)
         if mesh.devices.size == 1:
             d2, idx = knn_topk_single(items, valid, ids, queries, k=k)

@@ -568,8 +568,13 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
             mesh = ctx.mesh
         from ..parallel.mesh import RowStager
 
+        # contiguous staging (interleave=False) for items AND queries:
+        # same tie-determinism contract as exact kNN (models/knn.py
+        # _staged_items) — the interleaved layout would resolve tied
+        # neighbor distances differently for sparse vs dense input or
+        # across device counts, changing embeddings
         ist = RowStager.for_replicated(
-            items.shape[0], mesh, bucketing=False if sparse_items else None
+            items.shape[0], mesh, interleave=False
         )
         Xi = (
             ist.stage_sparse(items, dtype, row_transform=row_tf)
@@ -579,7 +584,7 @@ class UMAPModel(UMAPClass, _TpuModel, _UMAPParams):
         validd = ist.mask(dtype)
         idsd = ist.row_ids()
         qst = RowStager.for_replicated(
-            Xq.shape[0], mesh, bucketing=False if sparse_q else None
+            Xq.shape[0], mesh, interleave=False
         )
         Qs = (
             qst.stage_sparse(Xq, dtype, row_transform=row_tf)

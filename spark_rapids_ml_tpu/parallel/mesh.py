@@ -116,7 +116,14 @@ class RowStager:
     def __init__(
         self, n_local_rows: int, mesh: Mesh,
         bucketing: Optional[bool] = None,
+        interleave: Optional[bool] = None,
     ) -> None:
+        """`bucketing` pads the row count to the shape-bucket grid for
+        compile sharing; `interleave` round-robins rows over devices so
+        bucketed padding doesn't starve the tail devices of valid rows.
+        Pass `interleave=False` for order-sensitive consumers (top-k tie
+        breaking): the contiguous layout keeps original row order on the
+        devices while bucketed padding still shares compiles."""
         _ensure_distributed()
         self.mesh = mesh
         self.n_proc = jax.process_count()
@@ -137,9 +144,11 @@ class RowStager:
             # interleave only when padding is big enough to unbalance the
             # contiguous per-device split (bucketed padding); exact-shape
             # staging keeps the copy-free contiguous layout
-            self._interleave = (
-                n_dev > 1 and (self.local_padded - self.n_local) >= n_dev
-            )
+            if interleave is None:
+                interleave = (
+                    self.local_padded - self.n_local
+                ) >= n_dev
+            self._interleave = n_dev > 1 and interleave
         else:
             from jax.experimental import multihost_utils
 
@@ -196,7 +205,8 @@ class RowStager:
 
     @classmethod
     def for_replicated(
-        cls, n_rows: int, mesh: Mesh, bucketing: Optional[bool] = None
+        cls, n_rows: int, mesh: Mesh, bucketing: Optional[bool] = None,
+        interleave: Optional[bool] = None,
     ) -> "RowStager":
         """Stager for host arrays REPLICATED on every process (model
         attributes, transform inputs the caller holds in full).  Each
@@ -205,7 +215,8 @@ class RowStager:
         duplicate.  Single-process this is identical to RowStager."""
         _ensure_distributed()
         if jax.process_count() == 1:
-            return cls(n_rows, mesh, bucketing=bucketing)
+            return cls(n_rows, mesh, bucketing=bucketing,
+                       interleave=interleave)
         pid, n_proc = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
 
@@ -295,14 +306,16 @@ class RowStager:
 
         `row_transform` is applied per dense host chunk before transfer
         (metric row preprocessing).  Requires a non-interleaved layout —
-        build the stager with ``bucketing=False`` for sparse staging."""
+        build the stager with ``interleave=False`` for sparse staging
+        (bucketed padding is fine; only the round-robin permutation is
+        incompatible with chunkwise assembly)."""
         from ..native import densify_csr
         from ..streaming import chunk_rows_for
 
         if self._interleave:
             raise ValueError(
                 "sparse chunked staging requires the contiguous row layout; "
-                "construct the RowStager with bucketing=False"
+                "construct the RowStager with interleave=False"
             )
         X = X.tocsr()
         if self._replicated_input:

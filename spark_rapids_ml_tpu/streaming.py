@@ -21,12 +21,19 @@
 from __future__ import annotations
 
 import os
+import time
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .config import get_config
 from .utils import get_logger
+
+# wall-clock + bandwidth of the most recent stage_parquet (read by
+# bench.py to split fit time into stage vs on-chip solve: on a tunneled
+# dev chip the host->device link can dominate, and an artifact that
+# can't show the split misattributes the tunnel to the solver)
+LAST_STAGE: dict = {}
 
 logger = get_logger("spark_rapids_ml_tpu.streaming")
 
@@ -403,6 +410,7 @@ def stage_parquet(
     from .parallel.mesh import _ensure_distributed, get_mesh
 
     _ensure_distributed()
+    t_stage0 = time.perf_counter()
     dtype = np.dtype(dtype)
     n_total = parquet_row_count(path)
     if n_total == 0:
@@ -504,9 +512,21 @@ def stage_parquet(
         )
         off += chunk_rows
         n_chunks += 1
+    # block so the recorded staging time covers the actual host->device
+    # transfer, not just async dispatch (on a tunneled chip these differ
+    # by minutes)
+    jax.block_until_ready(bufX)
+    el = time.perf_counter() - t_stage0
+    mb = n_padded * d * dtype.itemsize / 1e6
+    LAST_STAGE.clear()
+    LAST_STAGE.update(
+        {"seconds": round(el, 2), "mb": round(mb, 1),
+         "mb_per_s": round(mb / max(el, 1e-9), 1)}
+    )
     logger.info(
         f"Streamed {n_total} rows x {d} cols from {path} in {n_chunks} "
-        f"chunks of {chunk_rows} rows onto {mesh}"
+        f"chunks of {chunk_rows} rows onto {mesh} "
+        f"({el:.1f}s, {mb / max(el, 1e-9):.0f} MB/s)"
     )
     return DeviceDataset(mesh, bufX, n_total, y=bufy, weight=bufw)
 
