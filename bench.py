@@ -770,12 +770,9 @@ def main() -> None:
     # self-describing artifact: host load at start/end + run counts, so a
     # contended run can never masquerade as the uncontended number again
     # (round-4 found a 360k-vs-594k artifact/claim divergence)
-    try:
-        extra["host_loadavg_start"] = [round(v, 2) for v in os.getloadavg()]
-        extra["host_cpu_count"] = os.cpu_count()
-        extra["contended"] = os.getloadavg()[0] > 0.5 * (os.cpu_count() or 1)
-    except OSError:
-        pass
+    from spark_rapids_ml_tpu.utils import host_load_metadata
+
+    extra.update(host_load_metadata())
     extra["warm_runs_per_timing"] = 3  # min-of-3 for all *_warm_* keys
     # host->device link bandwidth (one 32 MB put): on the tunneled dev
     # chip this is ~13 MB/s and dominates staged fits — the artifact must

@@ -46,11 +46,9 @@ def main() -> None:
         "n_queries": N_QUERIES,
         "platform": f"{jax.default_backend()} x{jax.device_count()}",
     }
-    try:
-        out["host_loadavg_start"] = [round(v, 2) for v in os.getloadavg()]
-        out["contended"] = os.getloadavg()[0] > 0.5 * (os.cpu_count() or 1)
-    except OSError:
-        pass
+    from spark_rapids_ml_tpu.utils import host_load_metadata
+
+    out.update(host_load_metadata())
 
     # clustered data (mixture of gaussians) so approximate recall is a
     # meaningful measure — iid-uniform makes every index look equally bad

@@ -48,11 +48,15 @@ def gen_dataset(path: str) -> None:
     if os.path.exists(path):
         import pyarrow.dataset as ds
 
-        have = ds.dataset(path, format="parquet").count_rows()
+        try:
+            have = ds.dataset(path, format="parquet").count_rows()
+        except Exception:
+            have = -1  # killed mid-write last run: regenerate
         if have == N_ROWS:
             print(f"dataset exists: {path} ({have} rows)", file=sys.stderr)
             return
         os.remove(path)
+    tmp = path + ".tmp"
     rng = np.random.default_rng(42)
     true_w = rng.standard_normal(N_COLS).astype(np.float32)
     writer = None
@@ -73,7 +77,7 @@ def gen_dataset(path: str) -> None:
             }
         )
         if writer is None:
-            writer = pq.ParquetWriter(path, t.schema)
+            writer = pq.ParquetWriter(tmp, t.schema)
         writer.write_table(t)
         if (at // SLAB) % 10 == 0:
             done = at + m
@@ -85,6 +89,7 @@ def gen_dataset(path: str) -> None:
                 file=sys.stderr, flush=True,
             )
     writer.close()
+    os.replace(tmp, path)  # atomic: a kill mid-write leaves only .tmp
     print(f"generated {path} in {time.time()-t0:.0f}s", file=sys.stderr)
 
 
@@ -208,9 +213,15 @@ def _pod_child() -> None:
         force_streaming_stats=True,
         streaming_checkpoint_dir=os.environ["_REHEARSAL_POD_CKPT"],
     )
+    # pod fits run to CONVERGENCE (tol > 0), unlike the throughput-curve
+    # fits (tol=0, iteration-capped): parity between process layouts is
+    # only well-defined at the optimum — mid-descent iterates diverge
+    # along flat directions from f32 reduction-order differences alone
     t0 = time.perf_counter()
     model = LogisticRegression(
-        regParam=1e-4, maxIter=MAX_ITER, tol=0.0
+        regParam=1e-4,
+        maxIter=int(os.environ.get("_REHEARSAL_POD_MAXITER", 40)),
+        tol=float(os.environ.get("_REHEARSAL_POD_TOL", 1e-9)),
     ).fit(os.environ["_REHEARSAL_POD_TARGET"])
     el = time.perf_counter() - t0
     if pid == 0:
@@ -222,6 +233,15 @@ def _pod_child() -> None:
                     "coef": np.asarray(model.coef_, np.float64).ravel().tolist(),
                     "intercept": float(
                         np.asarray(model.intercept_).ravel()[0]
+                    ),
+                    "objective": float(
+                        model._model_attributes.get("objective", float("nan"))
+                    ),
+                    "converged": bool(
+                        model._model_attributes.get("converged", False)
+                    ),
+                    "num_iters": int(
+                        model._model_attributes.get("num_iters", 0)
                     ),
                     "fit_sec": round(el, 1),
                     "epochs": int(
@@ -255,7 +275,6 @@ def _spawn_pod(nproc: int, target: str, ckpt: str, out_path: str,
             _REHEARSAL_POD_TARGET=target,
             _REHEARSAL_POD_CKPT=ckpt,
             _REHEARSAL_POD_OUT=out_path,
-            REHEARSAL_MAX_ITER=str(MAX_ITER),
         )
         env.pop("_REHEARSAL_CHILD", None)
         procs.append(subprocess.Popen(
@@ -311,12 +330,32 @@ def run_pod_phase(path: str, out: dict) -> None:
     c1 = np.asarray(res["1proc"]["coef"])
     c2 = np.asarray(res["2proc"]["coef"])
     out["pod_coef_max_abs_diff"] = float(np.abs(c1 - c2).max())
-    # streamed-stats parity tolerance established by
-    # tests/test_multiprocess.py (f32 reduction order differs per layout)
+    # CONVERGED parity (ridge-regularized logloss has a unique optimum):
+    # objective to 1e-5 relative AND coefficients to f32-convergence
+    # tolerance.  At an iteration CAP (tol=0) this comparison is not
+    # well-defined — the f32 chunk-gradient reduction-order difference
+    # between layouts amplifies through L-BFGS line searches into 1e-2
+    # scale iterate differences along flat directions (measured at 10M
+    # rows) while objectives agree to ~3e-4; trajectory-level parity is
+    # separately proven bit-exact by pod_resume_ok and at small scale by
+    # tests/test_multiprocess.py.
+    o1, o2 = res["1proc"]["objective"], res["2proc"]["objective"]
+    out["pod_1proc_objective"] = o1
+    out["pod_2proc_objective"] = o2
+    # the converged premise is part of the claim: an iteration-capped
+    # pair would silently revert to the ill-defined mid-descent
+    # comparison, so record it and require it
+    both_converged = bool(
+        res["1proc"]["converged"] and res["2proc"]["converged"]
+    )
+    out["pod_both_converged"] = both_converged
     out["pod_parity_ok"] = bool(
-        np.allclose(c1, c2, rtol=1e-4, atol=1e-5)
+        both_converged
+        and np.isfinite(o1) and np.isfinite(o2)
+        and abs(o1 - o2) <= 1e-5 * max(abs(o1), 1e-12)
+        and np.allclose(c1, c2, rtol=1e-3, atol=1e-4)
         and np.isclose(res["1proc"]["intercept"], res["2proc"]["intercept"],
-                       rtol=1e-4, atol=1e-5)
+                       rtol=1e-3, atol=1e-4)
     )
 
     # whole-pod preemption: both processes SIGKILLed mid-solve, then the
@@ -359,13 +398,54 @@ def main() -> None:
         "unit": "rows/sec/epoch",
     }
     # self-describing artifact (VERDICT r4 item 8): a contended run can
-    # never masquerade as the uncontended number again
-    try:
-        out["host_loadavg_start"] = [round(v, 2) for v in os.getloadavg()]
-        out["host_cpus"] = os.cpu_count()
-        out["contended"] = os.getloadavg()[0] > 0.5 * (os.cpu_count() or 1)
-    except OSError:
-        pass
+    # never masquerade as the uncontended number again — and the platform
+    # must be explicit (the tunneled dev chip moves 13 MB/s host->device,
+    # so epoch-streaming rehearsals run faster PINNED to the host CPU;
+    # see TPU_STATUS_r05.md).  Unpinned callers get the same killable
+    # subprocess probe bench.py uses: a dead tunnel must cost one probe
+    # timeout and fall back to cpu, not hang the multi-hour rehearsal
+    # inside an unkillable backend init at the first fit.
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        import subprocess
+
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; assert any(d.platform != 'cpu' "
+             "for d in jax.devices())"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+        try:
+            healthy = p.wait(timeout=300) == 0
+        except subprocess.TimeoutExpired:
+            healthy = False
+            os.killpg(p.pid, 9)
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable D-state child; abandon
+        if not healthy:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            print("rehearsal: accelerator backend unavailable; pinned cpu",
+                  file=sys.stderr, flush=True)
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    out["platform"] = f"{jax.default_backend()} x{jax.device_count()}"
+    from spark_rapids_ml_tpu.utils import host_load_metadata
+
+    out.update(host_load_metadata())
+
+    if os.environ.get("REHEARSAL_POD_ONLY") == "1":
+        # pod phase alone (dataset/subsets reused from a prior full run)
+        run_pod_phase(path, out)
+        try:
+            out["host_loadavg_end"] = [round(v, 2) for v in os.getloadavg()]
+        except OSError:
+            pass
+        print(json.dumps(out), flush=True)
+        return
 
     # scaling curve: rows/s/epoch at increasing row counts (same engine)
     import numpy as np  # noqa: F401
