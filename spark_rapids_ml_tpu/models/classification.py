@@ -484,8 +484,9 @@ class LogisticRegression(
                 coef, b, loss, n_iter, hist = logreg_fit_host_dispatch(
                     vals, w, fit_input.y, n_classes=n_classes,
                     binomial=binomial, d=d,
-                    margin_fn=lambda beta: ell_matvec(vals, cols, beta),
-                    logits_fn=lambda Wm: ell_matmat(vals, cols, Wm),
+                    data=(vals, cols),
+                    margin_fn=lambda dat, beta: ell_matvec(*dat, beta),
+                    logits_fn=lambda dat, Wm: ell_matmat(*dat, Wm),
                     **kwargs,
                 )
             elif binomial:

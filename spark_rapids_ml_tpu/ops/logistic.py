@@ -25,6 +25,31 @@ import jax.numpy as jnp
 from .lbfgs import lbfgs_minimize
 
 
+def _theta_layout(C: int, d: int, dtype, fit_intercept: bool):
+    """Single source of truth for the packed-theta layout — coefficients
+    first, then intercepts — shared by the problem builders (fused
+    solvers) and `logreg_fit_host_dispatch`.  C=1 is the binomial
+    single-β family (scalar intercept); C>1 the softmax multinomial.
+    Returns (n_coef, n_param, l1_mask, unpack)."""
+    n_coef = C * d
+    n_param = n_coef + (C if fit_intercept else 0)
+
+    def unpack(theta):
+        if C == 1:
+            beta = theta[:d]
+            b = theta[d] if fit_intercept else jnp.asarray(0.0, dtype)
+            return beta, b
+        Wm = theta[:n_coef].reshape(C, d)
+        b = theta[n_coef:] if fit_intercept else jnp.zeros((C,), dtype)
+        return Wm, b
+
+    l1_mask = jnp.concatenate(
+        [jnp.ones((n_coef,), dtype)]
+        + ([jnp.zeros((n_param - n_coef,), dtype)] if fit_intercept else [])
+    )
+    return n_coef, n_param, l1_mask, unpack
+
+
 def _binary_problem(
     margin_fn: Callable,  # beta (d,) -> margins (N_pad,)
     d: int,
@@ -41,12 +66,7 @@ def _binary_problem(
     host-dispatched solver."""
     wsum = w.sum()
     sgn = 2.0 * y.astype(dtype) - 1.0  # {-1, +1}
-    n_param = d + (1 if fit_intercept else 0)
-
-    def unpack(theta):
-        beta = theta[:d]
-        b = theta[d] if fit_intercept else jnp.asarray(0.0, dtype)
-        return beta, b
+    _, n_param, l1_mask, unpack = _theta_layout(1, d, dtype, fit_intercept)
 
     def loss_fn(theta):
         beta, b = unpack(theta)
@@ -57,9 +77,6 @@ def _binary_problem(
         reg = 0.5 * l2 * (beta * beta).sum()
         return data_loss + reg
 
-    l1_mask = jnp.concatenate(
-        [jnp.ones((d,), dtype)] + ([jnp.zeros((1,), dtype)] if fit_intercept else [])
-    )
     return loss_fn, unpack, l1_mask, n_param
 
 
@@ -103,13 +120,7 @@ def _multinomial_problem(
     objective, shared by the fused and host-dispatched solvers."""
     wsum = w.sum()
     y1h = jax.nn.one_hot(y, C, dtype=dtype)
-    n_coef = C * d
-    n_param = n_coef + (C if fit_intercept else 0)
-
-    def unpack(theta):
-        Wm = theta[:n_coef].reshape(C, d)
-        b = theta[n_coef:] if fit_intercept else jnp.zeros((C,), dtype)
-        return Wm, b
+    _, n_param, l1_mask, unpack = _theta_layout(C, d, dtype, fit_intercept)
 
     def loss_fn(theta):
         Wm, b = unpack(theta)
@@ -120,10 +131,6 @@ def _multinomial_problem(
         reg = 0.5 * l2 * (Wm * Wm).sum()
         return data_loss + reg
 
-    l1_mask = jnp.concatenate(
-        [jnp.ones((n_coef,), dtype)]
-        + ([jnp.zeros((C,), dtype)] if fit_intercept else [])
-    )
     return loss_fn, unpack, l1_mask, n_param
 
 
@@ -286,6 +293,7 @@ def logreg_fit_host_dispatch(
     margin_fn: Callable = None,
     logits_fn: Callable = None,
     d: int = None,
+    data=None,
 ):
     """HOST-driven L-BFGS over device-RESIDENT data: one dispatched
     value+grad program per evaluation instead of the whole solve in one
@@ -301,6 +309,15 @@ def logreg_fit_host_dispatch(
     the optimum matches the fused solver (same contract the
     epoch-streaming fit already satisfies).
 
+    `margin_fn`/`logits_fn` take (data, beta|W) and `data` is the array
+    pytree they consume (default: X itself).  Data MUST ride the jitted
+    evaluation as arguments — jitting a closure over the concrete arrays
+    captures them as lowered constants, which at the reference config is
+    a 12 GB host-side materialization during lowering plus a 12 GB
+    executable (jax's "large amount of constants were captured" warning);
+    as arguments they stay device-resident buffers referenced per
+    dispatch.
+
     Returns (W (C,d) | coef (d,), b, loss, n_iter, history) matching the
     fused kernels' shapes for the same `binomial` flag.
     """
@@ -311,20 +328,34 @@ def logreg_fit_host_dispatch(
     dtype = jnp.promote_types(X.dtype, jnp.float32)
     if d is None:
         d = X.shape[1]
-    if binomial:
-        loss_fn, unpack, l1_mask, n_param = _binary_problem(
-            margin_fn or (lambda beta: X @ beta), d, dtype, w, y, l2,
-            fit_intercept,
-        )
-    else:
-        loss_fn, unpack, l1_mask, n_param = _multinomial_problem(
-            logits_fn or (lambda Wm: X @ Wm.T), n_classes, d, dtype, w, y,
-            l2, fit_intercept,
-        )
-    vg = jax.jit(jax.value_and_grad(loss_fn))
+    operands = X if data is None else data
+    mfn = margin_fn or (lambda dat, beta: dat @ beta)
+    lfn = logits_fn or (lambda dat, Wm: dat @ Wm.T)
+
+    _, n_param, l1_mask, unpack = _theta_layout(
+        1 if binomial else n_classes, d, dtype, fit_intercept
+    )
+
+    @jax.jit
+    def vg_fn(theta, dat, w_, y_):
+        # problem built INSIDE the trace: dat/w_/y_ are tracers here, so
+        # the shared builders close over arguments, not concrete arrays
+        if binomial:
+            loss_fn, _, _, _ = _binary_problem(
+                lambda beta: mfn(dat, beta), d, dtype, w_, y_, l2,
+                fit_intercept,
+            )
+        else:
+            loss_fn, _, _, _ = _multinomial_problem(
+                lambda Wm: lfn(dat, Wm), n_classes, d, dtype, w_, y_, l2,
+                fit_intercept,
+            )
+        return jax.value_and_grad(loss_fn)(theta)
 
     def oracle(theta_np: np.ndarray):
-        f, g = jax.device_get(vg(jnp.asarray(theta_np, dtype)))
+        f, g = jax.device_get(
+            vg_fn(jnp.asarray(theta_np, dtype), operands, w, y)
+        )
         return float(f), np.asarray(g, np.float64)
 
     theta, n_iter, converged, hist = lbfgs_minimize_host(

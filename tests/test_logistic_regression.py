@@ -363,6 +363,38 @@ def test_host_dispatched_lbfgs_matches_fused(rng):
         ) < 1e-5
 
 
+def test_host_dispatched_lbfgs_no_constant_capture(rng):
+    # the host-driven evaluation must take the dataset as a jit ARGUMENT:
+    # jitting a closure over the concrete arrays captures them as lowered
+    # constants (at the refconfig 1M x 3000 scale that was a 12 GB
+    # host-side materialization during lowering — jax's "large amount of
+    # constants were captured" warning, observed live on chip).  Dropping
+    # the warn threshold to 16 KB and promoting the warning to an error
+    # makes any regression fail loudly at test scale.
+    import warnings
+
+    import jax
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+
+    n, d = 2000, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float64)
+    old = jax.config.jax_captured_constants_warn_bytes
+    jax.config.update("jax_captured_constants_warn_bytes", 16 * 1024)
+    set_config(dispatch_flops_limit=1e6)
+    try:
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "error", message=".*constants were captured.*"
+            )
+            m = LogisticRegression(maxIter=40).fit((X, y))
+            assert m.summary.totalIterations > 0
+    finally:
+        jax.config.update("jax_captured_constants_warn_bytes", old)
+        reset_config()
+
+
 def test_host_dispatched_lbfgs_elasticnet(rng):
     # OWL-QN (l1>0) through the host path: same sparsity pattern and
     # objective as the fused solver
