@@ -662,6 +662,296 @@ def _emit() -> None:
     _state["printed"] = True
 
 
+# host->device link probe shared by _probe_backend's subprocess and
+# main()'s inline fallback: one buffer of _PUT_PROBE_ELEMS f32 elements
+# = _PUT_PROBE_MB decimal megabytes
+_PUT_PROBE_ELEMS = 8_000_000
+_PUT_PROBE_MB = 32.0
+
+
+def _probe_backend(probe_timeout: float):
+    """Probe the accelerator backend in a killable SUBPROCESS: an
+    unguarded `jax.devices()` on a dead axon tunnel hangs ~25-28 min
+    (BENCH_r03 recorded rc=124 exactly this way); a healthy cold tunnel
+    inits in seconds.  A healthy probe also measures the platform label
+    and the host->device link bandwidth (one 32 MB put) so an isolated
+    supervisor never has to initialize the backend itself.  Returns
+    (error_or_None, platform_label_or_None, device_put_mb_s_or_None)."""
+    import subprocess
+    import tempfile
+
+    # NOT subprocess.run: its post-timeout kill() is followed by an
+    # UNBOUNDED wait(), and a child stuck in an uninterruptible tunnel
+    # syscall can't take the SIGKILL — run() then blocks forever,
+    # exactly the hang this probe exists to avoid
+    with tempfile.TemporaryFile() as errf, tempfile.TemporaryFile() as outf:
+        p = subprocess.Popen(
+            [sys.executable, "-c",
+             "import time, numpy, jax\n"
+             "ds = jax.devices()\n"
+             "assert any(d.platform != 'cpu' for d in ds)\n"
+             "label = ','.join(sorted({d.platform for d in ds}))\n"
+             "print(label + f' x{len(ds)}')\n"
+             f"buf = numpy.zeros(({_PUT_PROBE_ELEMS},), numpy.float32)\n"
+             "t0 = time.perf_counter()\n"
+             "jax.block_until_ready(jax.device_put(buf))\n"
+             f"print(round({_PUT_PROBE_MB} / (time.perf_counter() - t0), 1))\n"],
+            stdout=outf, stderr=errf,
+            start_new_session=True,  # killpg reaches tunnel helpers
+        )
+        try:
+            rc = p.wait(timeout=probe_timeout)
+            if rc != 0:
+                errf.seek(0)
+                tail = errf.read()[-160:].decode("utf-8", "replace")
+                tail = " ".join(tail.split())  # one line for the label
+                return f"probe exit {rc}: {tail}", None, None
+            outf.seek(0)
+            lines = [
+                ln.strip()
+                for ln in outf.read().decode("utf-8", "replace").splitlines()
+                if ln.strip()
+            ]
+            label = lines[0] if lines else None  # e.g. "tpu x1"
+            mbps = None
+            if len(lines) > 1:
+                try:
+                    mbps = float(lines[1])
+                except ValueError:
+                    pass
+            return None, label, mbps
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, 9)
+            except OSError:
+                p.kill()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass  # unkillable D-state child; abandon it
+            return f"probe timeout after {probe_timeout:.0f}s", None, None
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+_MERGE_PARENT_KEYS = frozenset({
+    "platform", "isolation", "terminated", "host_loadavg_start",
+    "host_loadavg_end", "host_cpus", "contended", "warm_runs_per_timing",
+})
+
+
+def _is_cpu_label(platform: str) -> bool:
+    """Classifier for artifact platform labels (bench-side analog of
+    ci/tpu_bench_loop.py is_on_chip, same token rule)."""
+    return platform.split(" ")[0].startswith("cpu")
+
+
+def _merge_child_line(
+    extra: dict, out_path: str, name: str, on_chip_verified: bool
+) -> bool:
+    """Parse a workload child's emitted JSON line (complete or
+    SIGTERM-partial) and merge its extra into the parent's, first value
+    wins; supervisor-level metadata keys stay the parent's.  A child that
+    measured the headline (value > 0) also supplies metric/vs_baseline.
+    When the supervisor's probe VERIFIED an on-chip backend, a child
+    that individually fell back to CPU is DISCARDED and recorded as an
+    error — merging it would smuggle cpu numbers into an artifact
+    labeled tpu.  An unverified supervisor ("axon (unprobed)") instead
+    adopts the first child's real platform label.  Returns True if a
+    line was parsed."""
+    try:
+        lines = [
+            ln for ln in open(out_path, errors="replace").read().splitlines()
+            if ln.strip()
+        ]
+        child = json.loads(lines[-1])
+    except Exception:
+        return False
+    child_platform = str(child.get("extra", {}).get("platform", ""))
+    if on_chip_verified and _is_cpu_label(child_platform):
+        extra[f"{name}_error"] = (
+            f"child fell back to {child_platform[:120]!r}; result discarded"
+        )
+        return True
+    if child_platform and "(unprobed)" in extra.get("platform", ""):
+        extra["platform"] = child_platform
+    for k, v in child.get("extra", {}).items():
+        if k not in _MERGE_PARENT_KEYS and k not in extra:
+            extra[k] = v
+    if child.get("value", 0) > 0 and _state["rows_per_sec"] == 0.0:
+        _state["rows_per_sec"] = child["value"]
+        _state["vs_baseline"] = child.get("vs_baseline", 0.0)
+    return True
+
+
+def _run_isolated(order, platform_label: str, probe_mbps, on_cpu: bool):
+    """Supervisor mode: each workload runs in its OWN child process with
+    a fresh jax client.  The first on-chip capture (BENCH_r05) showed
+    why in-process sequencing is fragile: one kmeans RESOURCE_EXHAUSTED
+    poisoned the axon backend and every later workload — including a
+    128 MB umap — failed RESOURCE_EXHAUSTED too.  A child's leaked HBM,
+    wedged tunnel RPC, or crashed worker dies with the child; the
+    server frees its allocations on disconnect and the next workload
+    starts clean.  Children partial-emit on TERM, so even a timed-out
+    workload contributes what it measured."""
+    import signal
+    import subprocess
+    import tempfile
+
+    extra = _state["extra"]
+    extra["platform"] = platform_label
+    extra["isolation"] = "process-per-workload"
+    if probe_mbps is not None:
+        extra["device_put_mb_s"] = probe_mbps
+    from spark_rapids_ml_tpu.utils import host_load_metadata
+
+    extra.update(host_load_metadata())
+    extra["warm_runs_per_timing"] = 3  # min-of-3 for all *_warm_* keys
+
+    # discard-on-fallback needs POSITIVE evidence of a chip: an
+    # "(unprobed)" axon label must not discard children's honest cpu
+    # results (they relabel the artifact via the merge instead)
+    on_chip_verified = (
+        "(unprobed)" not in platform_label and not _is_cpu_label(platform_label)
+    )
+    inflight = {"p": None, "out": None, "name": None}
+
+    def _reap(p, term_grace: float):
+        """TERM the child's group (it partial-emits), bounded-wait, then
+        KILL — never an unbounded wait on a D-state child."""
+        try:
+            os.killpg(p.pid, 15)
+        except OSError:
+            p.terminate()
+        try:
+            p.wait(timeout=term_grace)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        try:
+            os.killpg(p.pid, 9)
+        except OSError:
+            p.kill()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass  # abandon
+
+    def _on_term(signum, frame):
+        extra["terminated"] = f"signal {signum}"
+        p, out = inflight["p"], inflight["out"]
+        if p is not None:
+            _reap(p, term_grace=8)  # leave the loop's 60 s KILL grace room
+            if out:
+                _merge_child_line(
+                    extra, out, inflight["name"] or "unknown",
+                    on_chip_verified,
+                )
+        _emit()
+        raise SystemExit(1)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    default_to = _env_float("BENCH_WORKLOAD_TIMEOUT", 2400)
+    refconfig_to = _env_float("BENCH_REFCONFIG_TIMEOUT", 10800)
+    skip_rest = None
+    for i, name in enumerate(order):
+        if skip_rest:
+            extra[f"{name}_error"] = skip_rest
+            continue
+        timeout = refconfig_to if name == "refconfig" else default_to
+        child_env = dict(os.environ)
+        child_env.update(
+            BENCH_ISOLATE="0", BENCH_CHILD="1", BENCH_WORKLOADS=name,
+            BENCH_PROBE_TIMEOUT="0",  # supervisor already probed
+        )
+        if probe_mbps is not None:
+            # the probe measured the link; children need not re-pay the
+            # 32 MB put (first-value-wins merge would discard it anyway).
+            # Without a probe value (cpu-pinned / unprobed) the first
+            # child's inline measurement fills device_put_mb_s instead.
+            child_env["BENCH_SKIP_PUT_PROBE"] = "1"
+        fd, out_path = tempfile.mkstemp(prefix=f"bench_{name}_")
+        os.close(fd)
+        print(f"bench: [{name}] child starting (timeout {timeout:.0f}s)",
+              file=sys.stderr, flush=True)
+        with open(out_path, "wb") as outf:
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=outf, stderr=sys.stderr, env=child_env,
+                start_new_session=True,  # own group: reapable on timeout
+            )
+            inflight.update(p=p, out=out_path, name=name)
+            timed_out = False
+            try:
+                rc = p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                timed_out, rc = True, None
+                _reap(p, term_grace=30)
+            inflight.update(p=None, out=None, name=None)
+        merged = _merge_child_line(extra, out_path, name, on_chip_verified)
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+        if timed_out:
+            extra.setdefault(
+                f"{name}_error", f"workload timeout after {timeout:.0f}s"
+            )
+        elif rc != 0 and not merged:
+            extra[f"{name}_error"] = f"child exit {rc}"
+        fell_back = str(extra.get(f"{name}_error", "")).startswith(
+            "child fell back"
+        )
+        if (timed_out or fell_back) and not on_cpu and i + 1 < len(order):
+            # a timeout usually means the tunnel window closed
+            # mid-workload; a cpu-fallback child under a verified on-chip
+            # supervisor means the backend died FAST (children skip the
+            # probe, so their init falls back within the timeout).  Both
+            # ways, re-probe before burning a full timeout per remaining
+            # workload.
+            err, _, _ = _probe_backend(
+                _env_float("BENCH_PROBE_TIMEOUT", 300) or 300
+            )
+            if err:
+                skip_rest = f"skipped: backend down after {name} ({err})"
+                print(f"bench: {skip_rest}", file=sys.stderr, flush=True)
+    try:
+        extra["host_loadavg_end"] = [round(v, 2) for v in os.getloadavg()]
+    except OSError:
+        pass
+    _emit()
+
+
+def _cpu_shrink() -> None:
+    """CPU can't carry the chip-sized matrix in the driver's budget:
+    shrink whatever the caller didn't pin."""
+    global N_ROWS
+    if "BENCH_ROWS" not in os.environ:
+        N_ROWS = min(N_ROWS, 200_000)
+    if "BENCH_WORKLOADS" not in os.environ:
+        WORKLOADS[:] = ["pca", "streaming"]
+
+
+def _workload_order() -> list:
+    """BENCH_WORKLOADS order, so a caller (the probe-and-bench loop) can
+    front-load never-measured workloads into a possibly-short TPU window.
+    logreg is the headline and ALWAYS runs — at its WORKLOADS position if
+    listed, else appended last so the driver still gets its metric line
+    without eating the head of a short TPU window.  A single-workload
+    supervisor CHILD must not re-append it (the supervisor runs it as its
+    own child exactly once)."""
+    order = list(WORKLOADS)
+    if "logreg" not in order and os.environ.get("BENCH_CHILD") != "1":
+        order.append("logreg")
+    return order
+
+
 def main() -> None:
     import signal
 
@@ -676,53 +966,15 @@ def main() -> None:
     # emits a LABELED result rather than nothing
     import jax
 
-    # the hang is the real hazard: a ~28-min dead-tunnel init can eat the
-    # caller's whole bench timeout before the except below ever runs
-    # (BENCH_r03 recorded rc=124 exactly this way).  Probe the backend in
-    # a SUBPROCESS with a hard timeout — a healthy cold tunnel inits in
-    # 20-40 s — and switch to CPU without ever initializing a dead axon
-    # backend in this process.
-    try:
-        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 300))
-    except ValueError:
-        probe_timeout = 300.0
-    probed_error = None
+    probe_timeout = _env_float("BENCH_PROBE_TIMEOUT", 300)
+    probed_error = probe_platform = probe_mbps = None
     # probe unless the caller explicitly pinned CPU; the ambient
     # environment pins JAX_PLATFORMS=axon, which is exactly the case the
     # probe must cover (the child inherits it and tries the real init)
     if os.environ.get("JAX_PLATFORMS", "") != "cpu" and probe_timeout > 0:
-        import subprocess
-        import tempfile
-
-        # NOT subprocess.run: its post-timeout kill() is followed by an
-        # UNBOUNDED wait(), and a child stuck in an uninterruptible
-        # tunnel syscall can't take the SIGKILL — run() then blocks
-        # forever, exactly the hang this probe exists to avoid
-        with tempfile.TemporaryFile() as errf:
-            p = subprocess.Popen(
-                [sys.executable, "-c",
-                 "import jax; assert any(d.platform != 'cpu' "
-                 "for d in jax.devices())"],
-                stdout=subprocess.DEVNULL, stderr=errf,
-                start_new_session=True,  # killpg reaches tunnel helpers
-            )
-            try:
-                rc = p.wait(timeout=probe_timeout)
-                if rc != 0:
-                    errf.seek(0)
-                    tail = errf.read()[-160:].decode("utf-8", "replace")
-                    tail = " ".join(tail.split())  # one line for the label
-                    probed_error = f"probe exit {rc}: {tail}"
-            except subprocess.TimeoutExpired:
-                probed_error = f"probe timeout after {probe_timeout:.0f}s"
-                try:
-                    os.killpg(p.pid, 9)
-                except OSError:
-                    p.kill()
-                try:
-                    p.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass  # unkillable D-state child; abandon it
+        probed_error, probe_platform, probe_mbps = _probe_backend(
+            probe_timeout
+        )
         if probed_error:
             # single cpu-fallback site: env (spawned workers inherit it)
             # + live config; the labeled-platform except below reuses it
@@ -730,6 +982,25 @@ def main() -> None:
             jax.config.update("jax_platforms", "cpu")
             print(f"bench: probe result: {probed_error!r}",
                   file=sys.stderr, flush=True)
+
+    # supervisor (process-per-workload) mode: decide WITHOUT initializing
+    # the backend in this process — the supervisor holding a live axon
+    # client while children init their own would contend for the tunnel,
+    # and everything it needs (platform label, link bandwidth, cpu-ness)
+    # came from the probe child
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if on_cpu:
+        _cpu_shrink()
+    order = _workload_order()
+    if os.environ.get("BENCH_ISOLATE", "1") != "0" and len(order) > 1:
+        if probed_error:
+            label = f"cpu (TPU backend unavailable: {probed_error[:120]})"
+        elif probe_platform:
+            label = probe_platform
+        else:
+            label = "cpu (pinned)" if on_cpu else "axon (unprobed)"
+        _run_isolated(order, label, probe_mbps, on_cpu)
+        return
 
     try:
         if probed_error:
@@ -751,13 +1022,8 @@ def main() -> None:
               file=sys.stderr, flush=True)
     if all(d.platform == "cpu" for d in devs):
         # jax may also fall back to CPU SILENTLY (plugin absent / quiet
-        # registration failure).  CPU can't carry the chip-sized matrix
-        # in the driver's budget: shrink whatever the caller didn't pin.
-        global N_ROWS
-        if "BENCH_ROWS" not in os.environ:
-            N_ROWS = min(N_ROWS, 200_000)
-        if "BENCH_WORKLOADS" not in os.environ:
-            WORKLOADS[:] = ["pca", "streaming"]
+        # registration failure) — re-shrink from the real device list
+        _cpu_shrink()
 
     def _on_term(signum, frame):  # a driver timeout still records progress
         _state["extra"]["terminated"] = f"signal {signum}"
@@ -776,17 +1042,22 @@ def main() -> None:
     extra["warm_runs_per_timing"] = 3  # min-of-3 for all *_warm_* keys
     # host->device link bandwidth (one 32 MB put): on the tunneled dev
     # chip this is ~13 MB/s and dominates staged fits — the artifact must
-    # say so itself rather than let the tunnel masquerade as solver time
-    try:
-        import numpy as _np
+    # say so itself rather than let the tunnel masquerade as solver time.
+    # Skipped only when the supervisor's probe already measured the link
+    # (the merge would keep the parent's value anyway).
+    if os.environ.get("BENCH_SKIP_PUT_PROBE") != "1":
+        try:
+            import numpy as _np
 
-        _buf = _np.zeros((8_000_000,), _np.float32)
-        _t0 = time.perf_counter()
-        jax.block_until_ready(jax.device_put(_buf))
-        extra["device_put_mb_s"] = round(32.0 / (time.perf_counter() - _t0), 1)
-        del _buf
-    except Exception:
-        pass
+            _buf = _np.zeros((_PUT_PROBE_ELEMS,), _np.float32)
+            _t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(_buf))
+            extra["device_put_mb_s"] = round(
+                _PUT_PROBE_MB / (time.perf_counter() - _t0), 1
+            )
+            del _buf
+        except Exception:
+            pass
 
     benches = {
         "pca": bench_pca,
@@ -799,14 +1070,10 @@ def main() -> None:
         "refconfig": bench_refconfig,
         "rf": bench_rf,
     }
-    # run in BENCH_WORKLOADS order so a caller (the probe-and-bench loop)
-    # can front-load never-measured workloads into a possibly-short TPU
-    # window.  Default env order keeps rf LAST: a failed TPU remote-compile
-    # of the deep-forest program has been observed to crash the TPU worker
+    # Default env order keeps rf LAST: a failed TPU remote-compile of the
+    # deep-forest program has been observed to crash the TPU worker
     # process, and every workload after it then fails UNAVAILABLE (BENCH
-    # r03, 2026-07-31).  logreg is the headline and ALWAYS runs — at its
-    # WORKLOADS position if listed, else appended last so the driver still
-    # gets its metric line without eating the head of a short TPU window.
+    # r03, 2026-07-31).
     def _run_logreg():
         print("bench: logreg ...", file=sys.stderr, flush=True)
         try:
@@ -814,9 +1081,8 @@ def main() -> None:
         except Exception as e:
             extra["logreg_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    order = list(WORKLOADS)
-    if "logreg" not in order:
-        order.append("logreg")
+    # recompute: the silent-fallback path above may have shrunk WORKLOADS
+    order = _workload_order()
     for name in order:
         if name == "logreg":
             _run_logreg()

@@ -87,6 +87,14 @@ def run_killable(cmd, timeout, env=None, stdout=None):
             return None, True, ""
 
 
+def is_on_chip(platform: str) -> bool:
+    """Single classifier for bench artifact platform labels — used both
+    when deciding whether a fresh result is an on-chip capture and when
+    a restarted loop checks the committed artifact.  Same token rule as
+    bench.py _is_cpu_label."""
+    return bool(platform) and not platform.split(" ")[0].startswith("cpu")
+
+
 def probe() -> bool:
     env = dict(os.environ)
     if env.get("JAX_PLATFORMS") == "cpu":
@@ -135,7 +143,7 @@ def run_bench(have_on_chip: bool) -> bool:
         return False
     os.unlink(out_path)
     platform = str(result.get("extra", {}).get("platform", ""))
-    on_chip = "cpu" not in platform.split(" ")[0]
+    on_chip = is_on_chip(platform)
     if have_on_chip and not on_chip:
         log(f"bench: DISCARDED cpu-fallback result (platform={platform!r}) "
             f"— an on-chip {BENCH_FILE} already exists")
@@ -156,7 +164,18 @@ def run_bench(have_on_chip: bool) -> bool:
 def main() -> None:
     log(f"loop: start (interval={PROBE_INTERVAL:.0f}s, "
         f"probe_timeout={PROBE_TIMEOUT:.0f}s)")
+    # a restarted loop must not let a cpu-fallback refresh clobber an
+    # on-chip artifact a previous loop already committed
     captured = False
+    try:
+        with open(os.path.join(REPO, BENCH_FILE)) as f:
+            platform = str(json.load(f).get("extra", {}).get("platform", ""))
+        captured = is_on_chip(platform)
+        if captured:
+            log(f"loop: existing on-chip {BENCH_FILE} (platform="
+                f"{platform!r}); cpu fallbacks will be discarded")
+    except Exception:
+        pass
     attempts = 0
     while True:
         attempts += 1

@@ -583,18 +583,28 @@ class _TpuEstimator(Estimator, _TpuCaller):
             # streamed-statistics fit when the estimator supports it
             if not _is_oom(e):
                 raise
-            if self._supports_streaming_stats():
-                self.logger.warning(
-                    "Device staging exhausted HBM; retrying as a "
-                    "multi-pass streaming-statistics fit."
-                )
-                return self._fit_streaming(path)
-            raise RuntimeError(
-                "Dataset exceeds device memory while stream-staging and "
-                f"{type(self).__name__} cannot fit from streamed "
-                "statistics; raise num_workers (more chips) or reduce "
-                "the dataset"
-            ) from e
+            if not self._supports_streaming_stats():
+                raise RuntimeError(
+                    "Dataset exceeds device memory while stream-staging and "
+                    f"{type(self).__name__} cannot fit from streamed "
+                    "statistics; raise num_workers (more chips) or reduce "
+                    "the dataset"
+                ) from e
+        # the retry runs OUTSIDE the except block: while handling, the
+        # interpreter's exception state (sys.exc_info) pins the solver's
+        # inner frames via the traceback, whose locals reference the
+        # staged device arrays — a retry inside the block would run with
+        # the exhausted HBM still held (observed live: the refconfig
+        # kmeans retry itself died RESOURCE_EXHAUSTED, BENCH_r05 first
+        # capture).  Leaving the block pops the exception and frees them.
+        import gc
+
+        gc.collect()
+        self.logger.warning(
+            "Device staging exhausted HBM; retrying as a "
+            "multi-pass streaming-statistics fit."
+        )
+        return self._fit_streaming(path)
 
     def _fit(self, dataset: DatasetLike) -> "_TpuModel":
         if self._use_cpu_fallback():

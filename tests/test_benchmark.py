@@ -154,6 +154,39 @@ def test_bench_refconfig_cpu_smoke(monkeypatch):
         assert f"refconfig_{name}_vs_a10g_x" not in extra, name
 
 
+def test_bench_isolated_supervisor(tmp_path):
+    """bench.py's process-per-workload supervisor (BENCH_r05 first
+    capture: one kmeans RESOURCE_EXHAUSTED poisoned the in-process axon
+    client and turned every later workload into an error — isolation
+    gives each workload a fresh client).  Two tiny workloads + the
+    auto-appended logreg must merge into ONE JSON line carrying all
+    three workloads' keys, the headline from the logreg child, and the
+    isolation marker."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_WORKLOADS="pca,knn",
+        BENCH_ROWS="5000", BENCH_COLS="16", BENCH_WORKLOAD_TIMEOUT="300",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    extra = result["extra"]
+    assert extra.get("isolation") == "process-per-workload"
+    errors = {k: v for k, v in extra.items() if k.endswith("_error")}
+    assert not errors, errors
+    assert any(k.startswith("pca_") for k in extra), sorted(extra)
+    assert any(k.startswith("knn_") for k in extra), sorted(extra)
+    assert result["value"] > 0  # the logreg child's headline merged
+
+
 def test_rehearsal_pod_phase_smoke(tmp_path):
     """benchmark/rehearsal_100m.py's 2-process pod phase at toy scale
     (VERDICT r4 item 4): 2-process streaming fit must match the
