@@ -42,20 +42,55 @@ class IVFFlatIndex(NamedTuple):
     bucket_valid: np.ndarray  # (nlist, max_bucket) 1.0 real / 0.0 pad
 
 
+def _quantizer_train_rows(n: int, nlist: int) -> int:
+    """Coarse-quantizer training-set size: bounded like cuVS ivf_flat's
+    sampled trainset (its kmeans_trainset_fraction default trains on a
+    fraction, not all rows) — full data at small n, 256 rows/list capped
+    at n for BASELINE-scale builds where kmeans over all rows would
+    materialize an (n, nlist) distance block (40 GB at 10M x 1024)."""
+    return min(n, max(nlist * 256, 16384))
+
+
+def _assign_chunked(X: np.ndarray, centers) -> np.ndarray:
+    """kmeans_predict over bounded row chunks: the per-chunk device
+    footprint is chunk x (k + d) f32 — the (chunk, k) distance block PLUS
+    the staged (chunk, d) rows themselves — bounded to ~1 GiB instead of
+    the full (n, k) + (n, d)."""
+    from .kmeans import kmeans_predict
+
+    n = X.shape[0]
+    k = int(centers.shape[0])
+    d = int(X.shape[1])
+    chunk = int(max(8192, min(n, (1 << 28) // max(k + d, 1))))
+    out = np.empty((n,), np.int32)
+    for at in range(0, n, chunk):
+        out[at : at + chunk] = np.asarray(
+            kmeans_predict(jnp.asarray(X[at : at + chunk]), centers)
+        )
+    return out
+
+
 def build_ivfflat(
     X: np.ndarray, nlist: int, seed: int = 42, kmeans_iters: int = 20
 ) -> IVFFlatIndex:
     """Train the coarse quantizer and assemble the padded inverted file."""
-    from .kmeans import kmeans_fit, kmeans_predict
+    from .kmeans import kmeans_fit
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n = X.shape[0]
-    w = jnp.ones((n,), jnp.float32)
+    n_train = _quantizer_train_rows(n, nlist)
+    if n_train < n:
+        sel = np.random.default_rng(seed).choice(n, size=n_train,
+                                                 replace=False)
+        Xtr = jnp.asarray(X[sel])
+    else:
+        Xtr = jnp.asarray(X)
+    w = jnp.ones((Xtr.shape[0],), jnp.float32)
     centers, _, _ = kmeans_fit(
-        jnp.asarray(X), w, k=nlist, seed=seed, max_iter=kmeans_iters, tol=1e-4,
+        Xtr, w, k=nlist, seed=seed, max_iter=kmeans_iters, tol=1e-4,
         init="k-means++",
     )
-    assign = np.asarray(kmeans_predict(jnp.asarray(X), centers))
+    assign = _assign_chunked(X, centers)
     centers = np.asarray(centers)
     order = np.argsort(assign, kind="stable")
     counts = np.bincount(assign, minlength=nlist)
@@ -132,7 +167,7 @@ def build_ivfpq(
 ) -> IVFPQIndex:
     """IVF-PQ build: coarse quantizer + per-subspace residual codebooks
     (the cuVS ivf_pq analog, reference knn.py:1581-1612)."""
-    from .kmeans import kmeans_fit, kmeans_predict
+    from .kmeans import kmeans_fit
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n, d = X.shape
@@ -146,17 +181,24 @@ def build_ivfpq(
         ids = flat.bucket_ids[lst][flat.bucket_valid[lst] > 0]
         assign[ids] = lst
     resid = X - flat.centers[assign]  # (n, d) residuals to coarse centers
+    # codebooks train on the same bounded sample policy as the coarse
+    # quantizer; codes assign in bounded chunks (an (n, ksub) block is
+    # 10 GB at 10M x 256)
+    n_train = _quantizer_train_rows(n, ksub)
+    tr = (np.random.default_rng(seed + 7).choice(n, size=n_train,
+                                                 replace=False)
+          if n_train < n else slice(None))
     codebooks = np.zeros((M, ksub, dsub), np.float32)
     codes = np.zeros((n, M), np.uint8)
     for m in range(M):
         sub = resid[:, m * dsub : (m + 1) * dsub]
         cb, _, _ = kmeans_fit(
-            jnp.asarray(sub), jnp.ones((n,), jnp.float32), k=ksub,
+            jnp.asarray(sub[tr]), jnp.ones((n_train,), jnp.float32), k=ksub,
             seed=seed + m + 1, max_iter=kmeans_iters, tol=1e-4, init="k-means++",
         )
         codebooks[m] = np.asarray(cb)
-        codes[:, m] = np.asarray(
-            kmeans_predict(jnp.asarray(sub), jnp.asarray(codebooks[m]))
+        codes[:, m] = _assign_chunked(
+            np.ascontiguousarray(sub), jnp.asarray(codebooks[m])
         ).astype(np.uint8)
     mb = flat.bucket_ids.shape[1]
     bucket_codes = np.zeros((nlist, mb, M), np.uint8)

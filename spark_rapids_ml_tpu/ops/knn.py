@@ -109,6 +109,16 @@ def knn_topk_local(items, item_valid, item_ids, queries, k: int):
     return -neg_d, jnp.take(masked_ids, pos)
 
 
+# default query-block rows shared by knn_topk_blocked/coltiled and the
+# dispatch's tile-size model in knn_topk_single — one constant so a
+# retune can't desynchronize the guard from the kernel
+_QUERY_BLOCK = 1024
+# one (qblock, n) blocked-kernel distance tile must leave room for the
+# item matrix itself in 16 GB HBM; 2 GiB keeps the faster blocked kernel
+# for everything up to ~500k items at the default query block
+_BLOCKED_TILE_LIMIT_BYTES = 2 << 30
+
+
 def knn_topk_single(items, item_valid, item_ids, queries, k: int):
     """Single-device brute force with automatic kernel dispatch: the fused
     Pallas distance+top-k kernel (ops/pallas_knn.py) when the `pallas_knn`
@@ -129,12 +139,22 @@ def knn_topk_single(items, item_valid, item_ids, queries, k: int):
                 f"fused Pallas kNN kernel failed ({type(e).__name__}: "
                 f"{str(e)[:200]}); falling back to the XLA blocked kernel"
             )
+    # query-tiled blocked kernel while one (qblock, n) distance tile fits
+    # comfortably; past that, the double-tiled kernel (exact-equivalent,
+    # ~0.5x qps on chip but peak memory one (qblock, cblock) tile) — at
+    # 10M items a single blocked tile is 1024 x 10M x f32 = 40 GB and
+    # fails TPU compile with RESOURCE_EXHAUSTED (BASELINE-scale ANN run)
+    n = int(items.shape[0])
+    qb = min(_QUERY_BLOCK, max(int(queries.shape[0]), 1))
+    tile_bytes = qb * n * jnp.dtype(queries.dtype).itemsize
+    if tile_bytes > _BLOCKED_TILE_LIMIT_BYTES:
+        return knn_topk_coltiled(items, item_valid, item_ids, queries, k=k)
     return knn_topk_blocked(items, item_valid, item_ids, queries, k=k)
 
 
 @partial(jax.jit, static_argnames=("k", "block"))
 def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
-                     block: int = 1024):
+                     block: int = _QUERY_BLOCK):
     """Brute force with the query axis tiled: peak memory is one
     (block, n) distance tile instead of (q, n) — the single-device analog
     of the reference's batched GPU brute force (cuML handles this blocking
@@ -164,7 +184,7 @@ def knn_topk_blocked(items, item_valid, item_ids, queries, k: int,
 
 @partial(jax.jit, static_argnames=("k", "block", "cblock"))
 def knn_topk_coltiled(items, item_valid, item_ids, queries, k: int,
-                      block: int = 1024, cblock: int = 8192):
+                      block: int = _QUERY_BLOCK, cblock: int = 8192):
     """Brute force with BOTH axes tiled: each (block, cblock) distance
     tile folds into a running (block, k) top-k via `_merge_topk`, so the
     widest sort is over cblock+k columns instead of n.  XLA's full-width

@@ -108,6 +108,37 @@ def test_save_load(tmp_path, rng):
     )
 
 
+def test_single_dispatch_routes_big_n_to_coltiled(monkeypatch):
+    """knn_topk_single must route to the double-tiled kernel once one
+    (qblock, n) blocked tile would exceed the byte limit — at 10M items
+    a blocked tile is 40 GB and fails TPU compile RESOURCE_EXHAUSTED
+    (BASELINE-scale ANN run).  Forcing a tiny limit must keep results
+    exact-equivalent."""
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.ops import knn as knn_ops
+
+    rng = np.random.default_rng(3)
+    X = jnp.asarray(rng.standard_normal((2000, 8), dtype=np.float32))
+    Q = jnp.asarray(rng.standard_normal((100, 8), dtype=np.float32))
+    v = jnp.ones((2000,), jnp.float32)
+    ids = jnp.arange(2000, dtype=jnp.int32)
+    d_ref, i_ref = knn_ops.knn_topk_blocked(X, v, ids, Q, k=5)
+    calls = []
+    real = knn_ops.knn_topk_coltiled
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(knn_ops, "knn_topk_coltiled", spy)
+    monkeypatch.setattr(knn_ops, "_BLOCKED_TILE_LIMIT_BYTES", 1024)
+    d, i = knn_ops.knn_topk_single(X, v, ids, Q, k=5)
+    assert calls, "big-n dispatch did not route to the coltiled kernel"
+    np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+
+
 def test_coltiled_kernel_matches_blocked():
     """knn_topk_coltiled (sort-narrowing column-tiled merge) must be
     exact-equivalent to knn_topk_blocked, including invalid-item masking
