@@ -78,41 +78,28 @@ def assemble_dense_chunks(
     X, n_rows_out: int, dtype, chunk: int, row_transform=None,
     out_shardings=None,
 ):
-    """The shared chunk-bounded CSR -> dense device assembly loop (used by
-    `densify_to_device` and `RowStager.stage_sparse`): a zero buffer of
-    `n_rows_out` rows (optionally sharded) receives each densified host
-    chunk via donated in-place dynamic_update_slice writes — one compile
-    plus one tail compile; the traced start index keeps every full chunk
-    on one program.  Rows past the input length stay zero (padding)."""
-    import jax
-    import jax.numpy as jnp
-
+    """The chunk-bounded CSR -> dense device assembly (used by
+    `densify_to_device` and `RowStager.stage_sparse`): each host chunk
+    densifies then lands in the device buffer via the shared
+    bounded-upload loop (`mesh.assemble_rows_chunked`).  Rows past the
+    input length stay zero (padding)."""
     from .native import densify_csr
+    from .parallel.mesh import assemble_rows_chunked
 
     n, d = X.shape
     dtype = np.dtype(dtype)
 
-    def _dus(b, c, lo):
-        return jax.lax.dynamic_update_slice(
-            b, c, (lo, jnp.zeros((), jnp.int32))
-        )
+    def pieces():
+        for lo in range(0, n, chunk):
+            hi = min(lo + chunk, n)
+            dense = densify_csr(X[lo:hi], hi - lo, dtype)
+            if row_transform is not None:
+                dense = np.asarray(row_transform(dense), dtype=dtype)
+            yield lo, dense
 
-    if out_shardings is not None:
-        buf = jax.jit(
-            lambda: jnp.zeros((n_rows_out, d), dtype),
-            out_shardings=out_shardings,
-        )()
-        upd = jax.jit(_dus, donate_argnums=0, out_shardings=out_shardings)
-    else:
-        buf = jnp.zeros((n_rows_out, d), dtype)
-        upd = jax.jit(_dus, donate_argnums=0)
-    for lo in range(0, n, chunk):
-        hi = min(lo + chunk, n)
-        dense = densify_csr(X[lo:hi], hi - lo, dtype)
-        if row_transform is not None:
-            dense = np.asarray(row_transform(dense), dtype=dtype)
-        buf = upd(buf, dense, jnp.asarray(lo, jnp.int32))
-    return buf
+    return assemble_rows_chunked(
+        (n_rows_out, d), dtype, pieces(), out_shardings=out_shardings
+    )
 
 
 def _to_pandas(dataset: DatasetLike):

@@ -599,16 +599,18 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
             "nlist": nlist,
         }
         if algo == "cagra":
-            import jax.numpy as jnp
-
             from ..ops.cagra import build_cagra_graph
+            from ..parallel.mesh import _chunked_device_put
 
             deg = int(ap.get("graph_degree", 32))
             deg = max(1, min(deg, n - 1))
             rounds = int(ap.get("nn_descent_niter", 8))
             sample = ap.get("nn_descent_sample")
+            # bounded-piece upload: a one-shot put of a BASELINE-scale
+            # item matrix (10M x 128 = 5 GB) exceeds the tunnel
+            # transfer-RPC ceiling (mesh._chunked_device_put rationale)
             graph = build_cagra_graph(
-                jnp.asarray(X),
+                _chunked_device_put(np.ascontiguousarray(X)),
                 seed=0,
                 deg=deg,
                 rounds=max(rounds, 1),
@@ -669,13 +671,29 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
 
     def _staged_index(self, names):
         """The inverted file staged into HBM once and reused across
-        kneighbors calls (replicated; queries are what gets sharded)."""
-        import jax.numpy as jnp
+        kneighbors calls (replicated; queries are what gets sharded).
+        Large arrays (a 10M-item inverted file is ~5+ GB) upload in
+        bounded pieces — a one-shot put of that size can never finish
+        inside the tunnel transfer-RPC deadline (mesh._chunked_device_put
+        rationale)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import _chunked_device_put
 
         if self._device_index is None or self._device_index[0] != names:
-            self._device_index = (
-                names, tuple(jnp.asarray(self._attrs[n]) for n in names)
+            from ..parallel import TpuContext
+
+            with TpuContext(self.num_workers) as ctx:
+                repl = NamedSharding(ctx.mesh, PartitionSpec())
+            # every attribute gets the same replicated placement; the
+            # helper one-shot-puts anything under the transfer ceiling
+            staged = tuple(
+                _chunked_device_put(
+                    np.ascontiguousarray(np.asarray(self._attrs[n])), repl
+                )
+                for n in names
             )
+            self._device_index = (names, staged)
         return self._device_index[1]
 
     def _search(self, Q: np.ndarray, k: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -54,14 +54,22 @@ def _quantizer_train_rows(n: int, nlist: int) -> int:
 def _assign_chunked(X: np.ndarray, centers) -> np.ndarray:
     """kmeans_predict over bounded row chunks: the per-chunk device
     footprint is chunk x (k + d) f32 — the (chunk, k) distance block PLUS
-    the staged (chunk, d) rows themselves — bounded to ~1 GiB instead of
-    the full (n, k) + (n, d)."""
+    the staged (chunk, d) rows themselves — bounded to ~1 GiB, and the
+    per-chunk host->device transfer additionally capped at the single-put
+    ceiling (mesh._MAX_PUT_BYTES: one oversized put can never finish
+    inside the tunnel transfer-RPC deadline)."""
+    from ..parallel.mesh import _MAX_PUT_BYTES
     from .kmeans import kmeans_predict
 
     n = X.shape[0]
     k = int(centers.shape[0])
     d = int(X.shape[1])
-    chunk = int(max(8192, min(n, (1 << 28) // max(k + d, 1))))
+    itemsize = 4  # rows stage f32
+    chunk = int(max(8192, min(
+        n,
+        (1 << 28) // max(k + d, 1),
+        _MAX_PUT_BYTES // max(d * itemsize, 1),
+    )))
     out = np.empty((n,), np.int32)
     for at in range(0, n, chunk):
         out[at : at + chunk] = np.asarray(
@@ -78,13 +86,15 @@ def build_ivfflat(
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n = X.shape[0]
+    from ..parallel.mesh import _chunked_device_put
+
     n_train = _quantizer_train_rows(n, nlist)
     if n_train < n:
         sel = np.random.default_rng(seed).choice(n, size=n_train,
                                                  replace=False)
-        Xtr = jnp.asarray(X[sel])
+        Xtr = _chunked_device_put(np.ascontiguousarray(X[sel]))
     else:
-        Xtr = jnp.asarray(X)
+        Xtr = _chunked_device_put(X)
     w = jnp.ones((Xtr.shape[0],), jnp.float32)
     centers, _, _ = kmeans_fit(
         Xtr, w, k=nlist, seed=seed, max_iter=kmeans_iters, tol=1e-4,
@@ -190,10 +200,13 @@ def build_ivfpq(
           if n_train < n else slice(None))
     codebooks = np.zeros((M, ksub, dsub), np.float32)
     codes = np.zeros((n, M), np.uint8)
+    from ..parallel.mesh import _chunked_device_put
+
     for m in range(M):
         sub = resid[:, m * dsub : (m + 1) * dsub]
         cb, _, _ = kmeans_fit(
-            jnp.asarray(sub[tr]), jnp.ones((n_train,), jnp.float32), k=ksub,
+            _chunked_device_put(np.ascontiguousarray(sub[tr])),
+            jnp.ones((n_train,), jnp.float32), k=ksub,
             seed=seed + m + 1, max_iter=kmeans_iters, tol=1e-4, init="k-means++",
         )
         codebooks[m] = np.asarray(cb)

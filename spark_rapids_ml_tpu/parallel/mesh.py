@@ -93,6 +93,64 @@ def replicated_pspec() -> PartitionSpec:
     return PartitionSpec()
 
 
+# any single host->device transfer must stay well under the tunneled
+# dev chip's transfer-RPC deadline ceiling (60 s x link rate: ~1.8 GB at
+# 30 MB/s — TPU_STATUS_r05 hang class 3; a 5 GB one-shot device_put of a
+# 10M x 128 fit input wedged the axon client in an infinite serialize/
+# retry loop).  512 MiB survives links down to ~10 MB/s and matches the
+# streaming path's chunk sizing.
+_MAX_PUT_BYTES = 512 * 1024 * 1024
+
+
+def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None):
+    """The shared bounded-upload assembly loop: a zero device buffer of
+    `shape` (optionally sharded) receives host row-pieces via donated
+    in-place dynamic_update_slice writes — one compile plus one tail
+    compile.  `pieces` yields (row_offset, np_chunk).  Used by
+    `_chunked_device_put` here and `data.assemble_dense_chunks` (the
+    CSR densify path), so the donation/out_shardings subtleties live in
+    exactly one place."""
+    import jax.numpy as jnp
+
+    dtype = np.dtype(dtype)
+    ensure_x64(dtype)  # the zeros buffer must not truncate f64/i64
+    ndim = len(shape)
+
+    def _dus(b, c, lo):
+        idx = (lo,) + tuple(jnp.zeros((), jnp.int32) for _ in range(ndim - 1))
+        return jax.lax.dynamic_update_slice(b, c, idx)
+
+    if out_shardings is not None:
+        buf = jax.jit(
+            lambda: jnp.zeros(shape, dtype), out_shardings=out_shardings
+        )()
+        upd = jax.jit(_dus, donate_argnums=0, out_shardings=out_shardings)
+    else:
+        buf = jnp.zeros(shape, dtype)
+        upd = jax.jit(_dus, donate_argnums=0)
+    for lo, piece in pieces:
+        buf = upd(buf, piece, jnp.asarray(lo, jnp.int32))
+    return buf
+
+
+def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
+    """device_put for arrays beyond _MAX_PUT_BYTES: bounded row pieces
+    assembled on device instead of one transfer.  sharding=None targets
+    the default device."""
+    ensure_x64(arr.dtype)
+    if arr.nbytes <= _MAX_PUT_BYTES or arr.ndim == 0 or arr.shape[0] <= 1:
+        return (jax.device_put(arr, sharding) if sharding is not None
+                else jax.device_put(arr))
+    row_bytes = max(arr.nbytes // arr.shape[0], 1)
+    chunk = max(1, int(_MAX_PUT_BYTES // row_bytes))
+    pieces = (
+        (lo, np.ascontiguousarray(arr[lo : lo + chunk]))
+        for lo in range(0, arr.shape[0], chunk)
+    )
+    return assemble_rows_chunked(arr.shape, arr.dtype, pieces,
+                                 out_shardings=sharding)
+
+
 class RowStager:
     """Stages host arrays onto the mesh with one consistent padded row
     layout, so X / y / weights / masks / row-ids always line up.
@@ -284,7 +342,7 @@ class RowStager:
             padded = arr
         sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
         if self.n_proc == 1:
-            return jax.device_put(self._to_layout(padded), sharding)
+            return _chunked_device_put(self._to_layout(padded), sharding)
         return jax.make_array_from_process_local_data(
             sharding, padded, (self.n_padded,) + padded.shape[1:]
         )
