@@ -73,15 +73,38 @@ def main() -> None:
 
     # exact ground truth from the framework's own exact kNN (blocked,
     # chip-tiled; the sklearn cross-check lives in tests/, not here —
-    # at 10M x 128 sklearn brute would take far longer than the index)
-    from spark_rapids_ml_tpu.knn import NearestNeighbors
+    # at 10M x 128 sklearn brute would take far longer than the index).
+    # ANN_GT_CACHE persists it so per-algo runs in SEPARATE processes
+    # (one crashed build must not poison the next algo's backend — the
+    # bench isolation lesson) don't re-pay the exact pass.  The data is
+    # seed-deterministic, so a cache keyed on the config is exact.
+    gt_cache = os.environ.get("ANN_GT_CACHE", "")
+    if gt_cache and not gt_cache.endswith(".npz"):
+        gt_cache += ".npz"  # np.savez appends it; keep load/save agreed
+    cfg = np.asarray([N_ROWS, N_COLS, N_QUERIES, K])
+    gt_idx = None
+    if gt_cache and os.path.exists(gt_cache):
+        try:
+            with np.load(gt_cache) as z:
+                if np.array_equal(z["cfg"], cfg):
+                    gt_idx = z["gt"]
+                    out["exact_ground_truth_cached"] = True
+        except Exception:
+            gt_idx = None  # truncated/foreign cache: recompute
+    if gt_idx is None:
+        from spark_rapids_ml_tpu.knn import NearestNeighbors
 
-    t0 = time.perf_counter()
-    exact = NearestNeighbors(k=K).fit(X)
-    _, gt_idx = exact._search(Q, K)
-    out["exact_ground_truth_sec"] = round(time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        exact = NearestNeighbors(k=K).fit(X)
+        _, gt_idx = exact._search(Q, K)
+        gt_idx = np.asarray(gt_idx)
+        out["exact_ground_truth_sec"] = round(time.perf_counter() - t0, 1)
+        del exact
+        if gt_cache:
+            tmp = gt_cache + ".tmp.npz"
+            np.savez(tmp, cfg=cfg, gt=gt_idx)
+            os.replace(tmp, gt_cache)  # a killed run can't truncate it
     gt_sets = [set(row) for row in np.asarray(gt_idx)]
-    del exact
 
     from spark_rapids_ml_tpu.knn import ApproximateNearestNeighbors
 

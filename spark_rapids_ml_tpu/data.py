@@ -84,10 +84,13 @@ def assemble_dense_chunks(
     bounded-upload loop (`mesh.assemble_rows_chunked`).  Rows past the
     input length stay zero (padding)."""
     from .native import densify_csr
-    from .parallel.mesh import assemble_rows_chunked
+    from .parallel.mesh import _MAX_PUT_BYTES, assemble_rows_chunked
 
     n, d = X.shape
     dtype = np.dtype(dtype)
+    # host_batch_bytes is a host-RAM knob; the per-piece device transfer
+    # must still respect the single-put ceiling regardless of its value
+    chunk = max(1, min(chunk, _MAX_PUT_BYTES // max(d * dtype.itemsize, 1)))
 
     def pieces():
         for lo in range(0, n, chunk):

@@ -212,51 +212,31 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         }
 
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
-        from ..config import get_config
-        from ..ops.kmeans import kmeans_fit, kmeans_fit_stepwise
+        from ..ops.kmeans import kmeans_fit_auto
 
         p = fit_input.params
         k = int(p["n_clusters"])
         seed = p.get("random_state")
         seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
         max_iter = int(p["max_iter"])
-        # fused single-program Lloyd until the whole solve could exceed
-        # the per-program device-time budget (45 s dispatch rule); then
-        # host-dispatched per-block iterations
-        n, d = fit_input.X.shape
-        budget = float(get_config("dispatch_flops_limit"))
-        init = str(p["init"])
-        init_steps = int(p.get("init_steps") or 2)
-        oversample = float(p.get("oversampling_factor") or 2.0)
-        # the fused program also runs the init inside the same compiled
-        # region — count it, or a fit just under the Lloyd budget can
-        # still blow the per-program deadline (cost model shared with
-        # ops/kmeans.py: init_flops_accounting)
-        from ..ops.kmeans import init_flops_accounting
-
-        _, _, init_per_row = init_flops_accounting(
-            init, k, d, init_steps, oversample
-        )
-        fused_flops = 2.0 * n * d * k * max(max_iter, 1) + n * init_per_row
-        kwargs = dict(
+        # fused single-program Lloyd until the whole solve (init
+        # included) could exceed the per-program device-time budget
+        # (45 s dispatch rule); then host-dispatched per-block
+        # iterations.  The gate itself lives in ops/kmeans.py
+        # kmeans_fit_auto, shared with the IVF quantizer training.
+        centers, cost, n_iter, stepwise = kmeans_fit_auto(
+            fit_input.X,
+            fit_input.w,
             k=k,
             seed=seed,
             max_iter=max_iter,
             tol=float(p["tol"]),
-            init=init,
-            init_steps=init_steps,
-            oversample=oversample,
+            init=str(p["init"]),
+            init_steps=int(p.get("init_steps") or 2),
+            oversample=float(p.get("oversampling_factor") or 2.0),
         )
-        if fused_flops <= budget:
-            fit_fn = kmeans_fit
-        else:
-            fit_fn = kmeans_fit_stepwise
-            kwargs["flops_budget"] = budget
-            self.logger.info(
-                f"KMeans: stepwise host-dispatched Lloyd "
-                f"({fused_flops:.2e} fused FLOPs > budget {budget:.0e})"
-            )
-        centers, cost, n_iter = fit_fn(fit_input.X, fit_input.w, **kwargs)
+        if stepwise:
+            self.logger.info("KMeans: stepwise host-dispatched Lloyd")
         return {
             "cluster_centers_": np.asarray(centers),
             "inertia_": float(cost),

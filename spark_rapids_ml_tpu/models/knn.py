@@ -722,7 +722,9 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         from ..config import get_config
 
         budget = int(get_config("hbm_bytes")) // 8
-        chunk = max(64, min(nq, budget // max(per_q, 1)))
+        # floor 1, not a fixed batch: a 64-query floor at BASELINE-scale
+        # bucket sizes forced a working set far past HBM (10M ANN run)
+        chunk = max(1, min(nq, budget // max(per_q, 1)))
         if nq <= chunk:
             return self._search_chunk(Q, k, mesh)
         outs = [
@@ -742,13 +744,14 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
             beam = max(int(ap.get("itopk_size", 64)), k)
             width = beam * (1 + deg) + deg
         elif self.algorithm_ == "ivfflat":
+            # the probe-rank fold visits ONE list per step: per-query
+            # peak is a single (mb, d) gather + distances, not nprobe x
             mb = int(self._attrs["ivf_buckets"].shape[1])
-            width = max(1, min(int(ap.get("nprobe", 20)), self.nlist_)) * mb
-        else:  # ivfpq: LUTs + codes dominate; refine gathers run host-side
+            width = mb
+        else:  # ivfpq: one (mb, M) code gather + (M, ksub) LUT per step
             mb = int(self._attrs["pq_codes"].shape[1])
             M = int(self._attrs.get("pq_M", 8))
-            width = max(1, min(int(ap.get("nprobe", 20)), self.nlist_)) * mb
-            return width * (M + 8) * 4
+            return mb * (M * 4 + 8) * 4
         # distances + gathered vectors + dedup/sort keys, ~2x slack
         return width * (d + 4) * 4 * 2
 

@@ -78,12 +78,25 @@ def _assign_chunked(X: np.ndarray, centers) -> np.ndarray:
     return out
 
 
+def _train_kmeans_budgeted(Xtr, k: int, seed: int, max_iter: int,
+                           init: str = "k-means++"):
+    """Quantizer/codebook kmeans through the shared fused-vs-stepwise
+    dispatch gate (ops/kmeans.py kmeans_fit_auto — one cost model with
+    the KMeans model, so the 45 s per-program rule cannot diverge
+    between the two training paths)."""
+    from .kmeans import kmeans_fit_auto
+
+    w = jnp.ones((int(Xtr.shape[0]),), jnp.float32)
+    centers, _, _, _ = kmeans_fit_auto(
+        Xtr, w, k=k, seed=seed, max_iter=max_iter, tol=1e-4, init=init
+    )
+    return centers
+
+
 def build_ivfflat(
     X: np.ndarray, nlist: int, seed: int = 42, kmeans_iters: int = 20
 ) -> IVFFlatIndex:
     """Train the coarse quantizer and assemble the padded inverted file."""
-    from .kmeans import kmeans_fit
-
     X = np.ascontiguousarray(X, dtype=np.float32)
     n = X.shape[0]
     from ..parallel.mesh import _chunked_device_put
@@ -95,11 +108,7 @@ def build_ivfflat(
         Xtr = _chunked_device_put(np.ascontiguousarray(X[sel]))
     else:
         Xtr = _chunked_device_put(X)
-    w = jnp.ones((Xtr.shape[0],), jnp.float32)
-    centers, _, _ = kmeans_fit(
-        Xtr, w, k=nlist, seed=seed, max_iter=kmeans_iters, tol=1e-4,
-        init="k-means++",
-    )
+    centers = _train_kmeans_budgeted(Xtr, nlist, seed, kmeans_iters)
     assign = _assign_chunked(X, centers)
     centers = np.asarray(centers)
     order = np.argsort(assign, kind="stable")
@@ -130,24 +139,38 @@ def search_ivfflat(
     nprobe: int,
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Probe the nprobe nearest lists per query; exact distances within the
-    gathered candidates.  Returns (sq_distances (q,k), ids (q,k), -1 = none)."""
+    """Probe the nprobe nearest lists per query, folding ONE probed list
+    per step into a running top-k: peak memory is a single (q, mb, d)
+    gather instead of (q, nprobe, mb, d).  The all-at-once gather is
+    tens of GB at BASELINE scale (10M items -> mb ~ 10-20k, nprobe 64)
+    and crashed the axon remote compile during the 10M ANN run; the fold
+    visits the same candidates with identical distances.  Returns
+    (sq_distances (q,k), ids (q,k), -1 = none)."""
+    qn = queries.shape[0]
+    mb = buckets.shape[1]
     q2 = (queries * queries).sum(axis=1, keepdims=True)
     dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
     _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
 
-    cand_x = jnp.take(buckets, probe, axis=0)  # (q, nprobe, mb, d)
-    cand_id = jnp.take(bucket_ids, probe, axis=0).reshape(queries.shape[0], -1)
-    cand_v = jnp.take(bucket_valid, probe, axis=0).reshape(queries.shape[0], -1)
-    qn, np_, mb, d = cand_x.shape
-    cand_x = cand_x.reshape(qn, np_ * mb, d)
-    x2 = (cand_x * cand_x).sum(axis=2)
-    d2 = sqdist_gathered(queries, cand_x, q2[:, 0], x2)
-    d2 = jnp.where(cand_v > 0, d2, jnp.inf)
-    kk = min(k, d2.shape[1])
-    neg_d, pos = jax.lax.top_k(-d2, kk)
-    ids = jnp.take_along_axis(cand_id, pos, axis=1)
-    dist = -neg_d
+    kk = min(k, nprobe * mb)
+
+    def fold(r, carry):
+        run_d, run_i = carry
+        lists = probe[:, r]  # (q,) — distinct per query across steps
+        cx = jnp.take(buckets, lists, axis=0)  # (q, mb, d)
+        cid = jnp.take(bucket_ids, lists, axis=0)  # (q, mb)
+        cv = jnp.take(bucket_valid, lists, axis=0)  # (q, mb)
+        x2 = (cx * cx).sum(axis=2)
+        d2 = sqdist_gathered(queries, cx, q2[:, 0], x2)  # (q, mb)
+        d2 = jnp.where(cv > 0, d2, jnp.inf)
+        cat_d = jnp.concatenate([run_d, d2], axis=1)
+        cat_i = jnp.concatenate([run_i, cid], axis=1)
+        neg_d, pos = jax.lax.top_k(-cat_d, kk)
+        return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
+
+    run_d = jnp.full((qn, kk), jnp.inf, queries.dtype)
+    run_i = jnp.full((qn, kk), -1, bucket_ids.dtype)
+    dist, ids = jax.lax.fori_loop(0, nprobe, fold, (run_d, run_i))
     if kk < k:  # fewer candidates than k: pad with inf/-1
         pad = k - kk
         dist = jnp.concatenate(
@@ -177,8 +200,6 @@ def build_ivfpq(
 ) -> IVFPQIndex:
     """IVF-PQ build: coarse quantizer + per-subspace residual codebooks
     (the cuVS ivf_pq analog, reference knn.py:1581-1612)."""
-    from .kmeans import kmeans_fit
-
     X = np.ascontiguousarray(X, dtype=np.float32)
     n, d = X.shape
     if d % M != 0:
@@ -204,10 +225,9 @@ def build_ivfpq(
 
     for m in range(M):
         sub = resid[:, m * dsub : (m + 1) * dsub]
-        cb, _, _ = kmeans_fit(
+        cb = _train_kmeans_budgeted(
             _chunked_device_put(np.ascontiguousarray(sub[tr])),
-            jnp.ones((n_train,), jnp.float32), k=ksub,
-            seed=seed + m + 1, max_iter=kmeans_iters, tol=1e-4, init="k-means++",
+            ksub, seed + m + 1, kmeans_iters,
         )
         codebooks[m] = np.asarray(cb)
         codes[:, m] = _assign_chunked(
@@ -233,41 +253,53 @@ def search_ivfpq(
     nprobe: int,
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """ADC search: per (query, probed list) distance lookup tables over the
-    residual codebooks, summed across subspaces per candidate code."""
+    """ADC search: per (query, probed list) distance lookup tables over
+    the residual codebooks, summed across subspaces per candidate code.
+    Folds ONE probed list per step (same rationale and structure as
+    `search_ivfflat`): peak memory one (q, mb, M) code gather + a
+    (q, M, ksub) table instead of the nprobe-times-larger all-at-once
+    forms."""
     M, ksub, dsub = codebooks.shape
     qn, d = queries.shape
     q2 = (queries * queries).sum(axis=1, keepdims=True)
     dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
     _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
 
-    # residual of each query to each probed coarse center: (q, nprobe, d)
-    resid = queries[:, None, :] - jnp.take(centers, probe, axis=0)
-    resid_sub = resid.reshape(qn, nprobe, M, dsub)
-    # lookup tables: ||r_m - c_{m,j}||^2 for each subspace code j
     cb2 = (codebooks * codebooks).sum(axis=2)  # (M, ksub)
-    dot = jnp.einsum(
-        "qpmd,mjd->qpmj", resid_sub, codebooks,
-        precision=distance_precision(),
-    )
-    r2 = (resid_sub * resid_sub).sum(axis=3, keepdims=True)  # (q,nprobe,M,1)
-    luts = r2 + cb2[None, None] - 2.0 * dot  # (q, nprobe, M, ksub)
+    mb = codes.shape[1]
+    kk = min(k, nprobe * mb)
 
-    cand_codes = jnp.take(codes, probe, axis=0).astype(jnp.int32)  # (q,np,mb,M)
-    # ADC: sum the per-subspace table entries selected by each code
-    d2 = jnp.take_along_axis(
-        luts[:, :, None, :, :],  # (q, np, 1, M, ksub)
-        cand_codes[..., None],  # (q, np, mb, M, 1)
-        axis=4,
-    ).squeeze(4).sum(axis=3)  # (q, np, mb)
-    cand_v = jnp.take(bucket_valid, probe, axis=0)
-    cand_id = jnp.take(bucket_ids, probe, axis=0)
-    d2 = jnp.where(cand_v > 0, jnp.maximum(d2, 0.0), jnp.inf).reshape(qn, -1)
-    cand_id = cand_id.reshape(qn, -1)
-    kk = min(k, d2.shape[1])
-    neg_d, pos = jax.lax.top_k(-d2, kk)
-    ids = jnp.take_along_axis(cand_id, pos, axis=1)
-    dist = -neg_d
+    def fold(r, carry):
+        run_d, run_i = carry
+        lists = probe[:, r]  # (q,)
+        # residual of each query to its r-th probed coarse center
+        resid = queries - jnp.take(centers, lists, axis=0)  # (q, d)
+        resid_sub = resid.reshape(qn, M, dsub)
+        # lookup tables: ||r_m - c_{m,j}||^2 for each subspace code j
+        dot = jnp.einsum(
+            "qmd,mjd->qmj", resid_sub, codebooks,
+            precision=distance_precision(),
+        )
+        r2 = (resid_sub * resid_sub).sum(axis=2, keepdims=True)  # (q, M, 1)
+        luts = r2 + cb2[None] - 2.0 * dot  # (q, M, ksub)
+        cand_codes = jnp.take(codes, lists, axis=0).astype(jnp.int32)
+        # ADC: sum the per-subspace table entries selected by each code
+        d2 = jnp.take_along_axis(
+            luts[:, None, :, :],  # (q, 1, M, ksub)
+            cand_codes[..., None],  # (q, mb, M, 1)
+            axis=3,
+        ).squeeze(3).sum(axis=2)  # (q, mb)
+        cv = jnp.take(bucket_valid, lists, axis=0)
+        cid = jnp.take(bucket_ids, lists, axis=0)
+        d2 = jnp.where(cv > 0, jnp.maximum(d2, 0.0), jnp.inf)
+        cat_d = jnp.concatenate([run_d, d2], axis=1)
+        cat_i = jnp.concatenate([run_i, cid], axis=1)
+        neg_d, pos = jax.lax.top_k(-cat_d, kk)
+        return -neg_d, jnp.take_along_axis(cat_i, pos, axis=1)
+
+    run_d = jnp.full((qn, kk), jnp.inf, queries.dtype)
+    run_i = jnp.full((qn, kk), -1, bucket_ids.dtype)
+    dist, ids = jax.lax.fori_loop(0, nprobe, fold, (run_d, run_i))
     if kk < k:
         pad = k - kk
         dist = jnp.concatenate(

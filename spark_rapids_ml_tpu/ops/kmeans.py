@@ -242,6 +242,45 @@ def _lloyd_center_update(C, sums, counts):
     return new_C, shift2
 
 
+def kmeans_fit_auto(
+    X: jax.Array,
+    w: jax.Array,
+    k: int,
+    seed,
+    max_iter: int = 300,
+    tol: float = 1e-4,
+    init: str = "scalable-k-means++",
+    init_steps: int = 2,
+    oversample: float = 2.0,
+    budget: float = None,
+):
+    """The ONE fused-vs-stepwise gate (dispatch rule): the fused
+    single-program solver while `2·n·d·k·max_iter + n·init_per_row`
+    FLOPs fit the per-program budget (`dispatch_flops_limit` when
+    `budget` is None), else the host-dispatched stepwise Lloyd.  Shared
+    by the KMeans model (models/clustering.py) and the IVF quantizer/
+    codebook training (ops/ivf.py) so the cost model cannot diverge.
+    Returns (centers, cost, n_iter, used_stepwise)."""
+    if budget is None:
+        from ..config import get_config
+
+        budget = float(get_config("dispatch_flops_limit"))
+    n, d = int(X.shape[0]), int(X.shape[1])
+    _, _, init_per_row = init_flops_accounting(
+        init, k, d, init_steps, oversample
+    )
+    fused_flops = 2.0 * n * d * k * max(max_iter, 1) + n * init_per_row
+    kwargs = dict(k=k, seed=seed, max_iter=max_iter, tol=tol, init=init,
+                  init_steps=init_steps, oversample=oversample)
+    if fused_flops <= budget:
+        centers, cost, n_iter = kmeans_fit(X, w, **kwargs)
+        return centers, cost, n_iter, False
+    centers, cost, n_iter = kmeans_fit_stepwise(
+        X, w, flops_budget=budget, **kwargs
+    )
+    return centers, cost, n_iter, True
+
+
 def kmeans_fit_stepwise(
     X: jax.Array,
     w: jax.Array,

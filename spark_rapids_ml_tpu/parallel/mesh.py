@@ -8,6 +8,7 @@
 #
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple, Union
 
 import jax
@@ -102,32 +103,54 @@ def replicated_pspec() -> PartitionSpec:
 _MAX_PUT_BYTES = 512 * 1024 * 1024
 
 
+def _dus_rows(b, c, lo):
+    """Write rows `c` into buffer `b` at row offset `lo` (any ndim)."""
+    import jax.numpy as jnp
+
+    idx = (lo,) + tuple(jnp.zeros((), jnp.int32) for _ in range(b.ndim - 1))
+    return jax.lax.dynamic_update_slice(b, c, idx)
+
+
+@functools.lru_cache(maxsize=64)
+def _chunked_upload_fns(shape, dtype, out_shardings):
+    """Jitted (zeros-maker, donated-updater) pair for the bounded-upload
+    loop, cached so repeated stagings of the same shape/sharding reuse
+    the compiled programs instead of re-tracing per call."""
+    import jax.numpy as jnp
+
+    if out_shardings is not None:
+        mk = jax.jit(
+            lambda: jnp.zeros(shape, dtype), out_shardings=out_shardings
+        )
+        upd = jax.jit(_dus_rows, donate_argnums=0,
+                      out_shardings=out_shardings)
+    else:
+        mk = jax.jit(lambda: jnp.zeros(shape, dtype))
+        upd = jax.jit(_dus_rows, donate_argnums=0)
+    return mk, upd
+
+
 def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None):
     """The shared bounded-upload assembly loop: a zero device buffer of
     `shape` (optionally sharded) receives host row-pieces via donated
-    in-place dynamic_update_slice writes — one compile plus one tail
-    compile.  `pieces` yields (row_offset, np_chunk).  Used by
-    `_chunked_device_put` here and `data.assemble_dense_chunks` (the
-    CSR densify path), so the donation/out_shardings subtleties live in
-    exactly one place."""
+    in-place dynamic_update_slice writes — compiles are cached per
+    (shape, dtype, sharding).  `pieces` yields (row_offset, np_chunk).
+    Used by `_chunked_device_put` here and `data.assemble_dense_chunks`
+    (the CSR densify path), so the donation/out_shardings subtleties
+    live in exactly one place.
+
+    Note for future multi-device tunneled setups: each host piece enters
+    the jitted update unsharded, so GSPMD replicates it to every device
+    of a row-sharded target — n_dev x the minimal traffic.  On the
+    current targets (one real chip; local CPU meshes) the factor is 1 /
+    free; per-device slicing + make_array_from_single_device_arrays is
+    the upgrade path if a real multi-chip tunnel appears."""
     import jax.numpy as jnp
 
     dtype = np.dtype(dtype)
     ensure_x64(dtype)  # the zeros buffer must not truncate f64/i64
-    ndim = len(shape)
-
-    def _dus(b, c, lo):
-        idx = (lo,) + tuple(jnp.zeros((), jnp.int32) for _ in range(ndim - 1))
-        return jax.lax.dynamic_update_slice(b, c, idx)
-
-    if out_shardings is not None:
-        buf = jax.jit(
-            lambda: jnp.zeros(shape, dtype), out_shardings=out_shardings
-        )()
-        upd = jax.jit(_dus, donate_argnums=0, out_shardings=out_shardings)
-    else:
-        buf = jnp.zeros(shape, dtype)
-        upd = jax.jit(_dus, donate_argnums=0)
+    mk, upd = _chunked_upload_fns(tuple(shape), dtype, out_shardings)
+    buf = mk()
     for lo, piece in pieces:
         buf = upd(buf, piece, jnp.asarray(lo, jnp.int32))
     return buf
@@ -139,6 +162,16 @@ def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
     the default device."""
     ensure_x64(arr.dtype)
     if arr.nbytes <= _MAX_PUT_BYTES or arr.ndim == 0 or arr.shape[0] <= 1:
+        if arr.nbytes > _MAX_PUT_BYTES:
+            # a single row past the ceiling cannot be split on the row
+            # axis; make the hang class attributable instead of silent
+            from ..utils import get_logger
+
+            get_logger("mesh").warning(
+                f"one-shot device_put of {arr.nbytes/2**20:.0f} MiB "
+                "(single row over the transfer ceiling) — may exceed "
+                "the tunnel transfer-RPC deadline"
+            )
         return (jax.device_put(arr, sharding) if sharding is not None
                 else jax.device_put(arr))
     row_bytes = max(arr.nbytes // arr.shape[0], 1)
