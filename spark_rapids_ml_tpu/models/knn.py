@@ -624,6 +624,7 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
                 ivf_buckets=index.buckets,
                 ivf_bucket_ids=index.bucket_ids,
                 ivf_bucket_valid=index.bucket_valid,
+                ivf_sub_table=index.sub_table,
             )
         else:  # ivfpq
             M = int(ap.get("M", 8))
@@ -641,6 +642,7 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
                 pq_codes=index.codes,
                 ivf_bucket_ids=index.bucket_ids,
                 ivf_bucket_valid=index.bucket_valid,
+                ivf_sub_table=index.sub_table,
                 pq_M=M,
             )
         model = ApproximateNearestNeighborsModel(**attrs)
@@ -665,6 +667,16 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         self.dtype = str(attrs.get("dtype", self.item_features.dtype))
         self.algorithm_: str = str(attrs.get("algorithm", "ivfflat"))
         self.nlist_: int = int(attrs.get("nlist", 1))
+        if (
+            self.algorithm_ in ("ivfflat", "ivfpq")
+            and "ivf_sub_table" not in attrs
+            and "ivf_centers" in attrs
+        ):
+            # models persisted before sub-list splitting: every list is
+            # its own (only) sub-list — the identity table
+            attrs["ivf_sub_table"] = np.arange(
+                np.asarray(attrs["ivf_centers"]).shape[0], dtype=np.int32
+            )[:, None]
         self._attrs = attrs
         self._item_df = None
         self._device_index = None  # lazily cached device-resident index
@@ -765,6 +777,8 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
         Qs = qst.stage(Q, np.float32)
         ap = dict(self._tpu_params.get("algo_params") or {})
         nprobe = int(ap.get("nprobe", 20))
+        # nprobe means DISTINCT coarse parent cells — sub-list splitting
+        # (ops/ivf.py) is expanded inside the search via sub_table
         nprobe = max(1, min(nprobe, self.nlist_))
         if self.algorithm_ == "cagra":
             from ..ops.cagra import search_cagra
@@ -777,22 +791,26 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
                 Qs, items, graph, k=k, beam=beam, iters=max(iters, 1)
             )
         elif self.algorithm_ == "ivfflat":
-            centers, buckets, bids, bvalid = self._staged_index(
+            centers, buckets, bids, bvalid, stab = self._staged_index(
                 ("ivf_centers", "ivf_buckets", "ivf_bucket_ids",
-                 "ivf_bucket_valid")
+                 "ivf_bucket_valid", "ivf_sub_table")
             )
             d2, pos = ivf_ops.search_ivfflat(
-                Qs, centers, buckets, bids, bvalid, nprobe=nprobe, k=k
+                Qs, centers, buckets, bids, bvalid, stab,
+                nprobe=nprobe, k=k,
             )
         else:
-            centers, codebooks, codes, bids, bvalid = self._staged_index(
-                ("ivf_centers", "pq_codebooks", "pq_codes", "ivf_bucket_ids",
-                 "ivf_bucket_valid")
+            centers, codebooks, codes, bids, bvalid, stab = (
+                self._staged_index(
+                    ("ivf_centers", "pq_codebooks", "pq_codes",
+                     "ivf_bucket_ids", "ivf_bucket_valid", "ivf_sub_table")
+                )
             )
             refine = int(ap.get("refine_ratio", 2))
             k2 = min(max(k * refine, k), self.item_features.shape[0])
             d2, pos = ivf_ops.search_ivfpq(
-                Qs, centers, codebooks, codes, bids, bvalid, nprobe=nprobe, k=k2
+                Qs, centers, codebooks, codes, bids, bvalid, stab,
+                nprobe=nprobe, k=k2,
             )
             return self._exact_rerank(Q, qst.fetch(pos), k)
         # CAGRA / IVF-Flat: the kernels rank by matmul-identity distances

@@ -36,10 +36,18 @@ import numpy as np
 
 
 class IVFFlatIndex(NamedTuple):
-    centers: np.ndarray  # (nlist, d) coarse centroids
-    buckets: np.ndarray  # (nlist, max_bucket, d) padded inverted lists
-    bucket_ids: np.ndarray  # (nlist, max_bucket) int32 positional item ids, -1 pad
-    bucket_valid: np.ndarray  # (nlist, max_bucket) 1.0 real / 0.0 pad
+    """Inverted file with oversized lists split into capped SUB-LISTS:
+    `centers` stays the (nlist, d) coarse parents a query probes;
+    `sub_table[p]` names the sub-lists storing parent p's rows (-1 pad).
+    Padding is bounded at ~cap x nsub ~= 1.25x the data instead of
+    nlist x max_count (one hot list made the padded file ~15 GB at
+    10M x 128 on a 16 GB chip)."""
+
+    centers: np.ndarray  # (nlist, d) coarse PARENT centroids
+    buckets: np.ndarray  # (nsub, cap, d) capped sub-list vectors
+    bucket_ids: np.ndarray  # (nsub, cap) int32 positional item ids, -1 pad
+    bucket_valid: np.ndarray  # (nsub, cap) 1.0 real / 0.0 pad
+    sub_table: np.ndarray  # (nlist, max_sub) int32 sub-list ids, -1 pad
 
 
 def _quantizer_train_rows(n: int, nlist: int) -> int:
@@ -113,55 +121,92 @@ def build_ivfflat(
     centers = np.asarray(centers)
     order = np.argsort(assign, kind="stable")
     counts = np.bincount(assign, minlength=nlist)
-    max_bucket = max(int(counts.max()), 1)
+    # oversized lists split into capped sub-lists (see IVFFlatIndex):
+    # probing stays over the nlist PARENT centers, and the search
+    # expands each probed parent to its sub-lists via sub_table — the
+    # probe top-k therefore still covers nprobe DISTINCT coarse cells
+    # (duplicated sub-centers in the probe would let one hot cell crowd
+    # every other cell out of the top-k on exactly the skewed data the
+    # split targets)
     d = X.shape[1]
-    buckets = np.zeros((nlist, max_bucket, d), np.float32)
-    bucket_ids = np.full((nlist, max_bucket), -1, np.int32)
-    bucket_valid = np.zeros((nlist, max_bucket), np.float32)
-    start = 0
-    for lst in range(nlist):
-        c = int(counts[lst])
-        idx = order[start : start + c]
-        buckets[lst, :c] = X[idx]
-        bucket_ids[lst, :c] = idx.astype(np.int32)
-        bucket_valid[lst, :c] = 1.0
-        start += c
-    return IVFFlatIndex(centers, buckets, bucket_ids, bucket_valid)
+    n_mean = max(int(np.ceil(n / max(nlist, 1))), 1)
+    cap = max(32, int(np.ceil(1.25 * n_mean)))
+    # empty coarse lists get NO sub-list (an all -1 sub_table row, which
+    # the search fold masks) — at high nlist with skew, a zero sub-list
+    # per empty cell would waste cap x d x 4 bytes each
+    sub_of = [
+        (lst, at) for lst in range(nlist)
+        for at in range(0, int(counts[lst]), cap)
+    ]
+    nsub = max(len(sub_of), 1)
+    max_sub = max(int((-(-counts // cap)).max()), 1) if nlist else 1
+    sub_table = np.full((nlist, max_sub), -1, np.int32)
+    buckets = np.zeros((nsub, cap, d), np.float32)
+    bucket_ids = np.full((nsub, cap), -1, np.int32)
+    bucket_valid = np.zeros((nsub, cap), np.float32)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    fill = np.zeros((nlist,), np.int64)
+    for s, (lst, at) in enumerate(sub_of):
+        sub_table[lst, fill[lst]] = s
+        fill[lst] += 1
+        c = min(cap, int(counts[lst]) - at)
+        if c <= 0:
+            continue
+        idx = order[starts[lst] + at : starts[lst] + at + c]
+        buckets[s, :c] = X[idx]
+        bucket_ids[s, :c] = idx.astype(np.int32)
+        bucket_valid[s, :c] = 1.0
+    return IVFFlatIndex(centers, buckets, bucket_ids, bucket_valid, sub_table)
 
 
 @partial(jax.jit, static_argnames=("nprobe", "k"))
 def search_ivfflat(
     queries: jax.Array,  # (q, d)
-    centers: jax.Array,  # (nlist, d)
-    buckets: jax.Array,  # (nlist, mb, d)
-    bucket_ids: jax.Array,  # (nlist, mb)
-    bucket_valid: jax.Array,  # (nlist, mb)
+    centers: jax.Array,  # (nlist, d) parent centroids
+    buckets: jax.Array,  # (nsub, cap, d) sub-list vectors
+    bucket_ids: jax.Array,  # (nsub, cap)
+    bucket_valid: jax.Array,  # (nsub, cap)
+    sub_table: jax.Array,  # (nlist, max_sub) sub-list ids, -1 pad
     nprobe: int,
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Probe the nprobe nearest lists per query, folding ONE probed list
-    per step into a running top-k: peak memory is a single (q, mb, d)
-    gather instead of (q, nprobe, mb, d).  The all-at-once gather is
-    tens of GB at BASELINE scale (10M items -> mb ~ 10-20k, nprobe 64)
-    and crashed the axon remote compile during the 10M ANN run; the fold
-    visits the same candidates with identical distances.  Returns
+    """Probe the nprobe nearest PARENT cells per query (distinct coarse
+    cells, as in the unsplit inverted file), expand each to its
+    sub-lists via `sub_table`, and fold ONE sub-list per step into a
+    running top-k: peak memory is a single (q, cap, d) gather instead
+    of (q, nprobe, mb, d).  The all-at-once gather is tens of GB at
+    BASELINE scale (10M items -> mb ~ 10-20k, nprobe 64) and crashed
+    the axon remote compile during the 10M ANN run; the fold visits the
+    same candidates with identical distances.  Returns
     (sq_distances (q,k), ids (q,k), -1 = none)."""
     qn = queries.shape[0]
-    mb = buckets.shape[1]
+    cap = buckets.shape[1]
+    max_sub = sub_table.shape[1]
     q2 = (queries * queries).sum(axis=1, keepdims=True)
     dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
-    _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
+    _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe) parent ids
+    # (q, nprobe*max_sub) sub-list ids, front-packed DESCENDING so the
+    # -1 padding sinks to the tail; the fold then runs only to the
+    # batch-max count of real sub-lists instead of nprobe*max_sub — on
+    # skewed data most fixed steps would gather fully-masked padding
+    nsteps = nprobe * max_sub
+    expanded = -jnp.sort(
+        -jnp.take(sub_table, probe, axis=0).reshape(qn, -1), axis=1
+    )
+    n_live = jnp.max(jnp.sum(expanded >= 0, axis=1))
 
-    kk = min(k, nprobe * mb)
+    kk = min(k, nsteps * cap)
 
     def fold(r, carry):
         run_d, run_i = carry
-        lists = probe[:, r]  # (q,) — distinct per query across steps
-        cx = jnp.take(buckets, lists, axis=0)  # (q, mb, d)
-        cid = jnp.take(bucket_ids, lists, axis=0)  # (q, mb)
-        cv = jnp.take(bucket_valid, lists, axis=0)  # (q, mb)
+        lists = expanded[:, r]  # (q,) sub-list ids, may be -1
+        safe = jnp.maximum(lists, 0)
+        cx = jnp.take(buckets, safe, axis=0)  # (q, cap, d)
+        cid = jnp.take(bucket_ids, safe, axis=0)  # (q, cap)
+        cv = jnp.take(bucket_valid, safe, axis=0)  # (q, cap)
+        cv = cv * (lists >= 0)[:, None]
         x2 = (cx * cx).sum(axis=2)
-        d2 = sqdist_gathered(queries, cx, q2[:, 0], x2)  # (q, mb)
+        d2 = sqdist_gathered(queries, cx, q2[:, 0], x2)  # (q, cap)
         d2 = jnp.where(cv > 0, d2, jnp.inf)
         cat_d = jnp.concatenate([run_d, d2], axis=1)
         cat_i = jnp.concatenate([run_i, cid], axis=1)
@@ -170,7 +215,9 @@ def search_ivfflat(
 
     run_d = jnp.full((qn, kk), jnp.inf, queries.dtype)
     run_i = jnp.full((qn, kk), -1, bucket_ids.dtype)
-    dist, ids = jax.lax.fori_loop(0, nprobe, fold, (run_d, run_i))
+    # traced upper bound: lowers to a while_loop running exactly the
+    # batch's live steps
+    dist, ids = jax.lax.fori_loop(0, n_live, fold, (run_d, run_i))
     if kk < k:  # fewer candidates than k: pad with inf/-1
         pad = k - kk
         dist = jnp.concatenate(
@@ -183,11 +230,12 @@ def search_ivfflat(
 
 
 class IVFPQIndex(NamedTuple):
-    centers: np.ndarray  # (nlist, d) coarse centroids
+    centers: np.ndarray  # (nlist, d) coarse PARENT centroids
     codebooks: np.ndarray  # (M, ksub, dsub) per-subspace codebooks
-    codes: np.ndarray  # (nlist, max_bucket, M) uint8 PQ codes of residuals
-    bucket_ids: np.ndarray  # (nlist, max_bucket) int32
-    bucket_valid: np.ndarray  # (nlist, max_bucket)
+    codes: np.ndarray  # (nsub, cap, M) uint8 PQ codes of residuals
+    bucket_ids: np.ndarray  # (nsub, cap) int32
+    bucket_valid: np.ndarray  # (nsub, cap)
+    sub_table: np.ndarray  # (nlist, max_sub) int32 sub-list ids, -1 pad
 
 
 def build_ivfpq(
@@ -207,11 +255,19 @@ def build_ivfpq(
     dsub = d // M
     ksub = min(2**n_bits, max(n // 4, 2))
     flat = build_ivfflat(X, nlist, seed=seed, kmeans_iters=kmeans_iters)
-    assign = np.full((n,), 0, np.int64)
-    for lst in range(nlist):
+    nsub = flat.buckets.shape[0]  # sub-lists after oversize splitting
+    assign = np.full((n,), 0, np.int64)  # sub-list id per row
+    for lst in range(nsub):
         ids = flat.bucket_ids[lst][flat.bucket_valid[lst] > 0]
         assign[ids] = lst
-    resid = X - flat.centers[assign]  # (n, d) residuals to coarse centers
+    # map each sub-list back to its parent cell: residuals (and the
+    # search's LUTs) are against the PARENT coarse center
+    parent_of = np.zeros((nsub,), np.int64)
+    for p in range(flat.sub_table.shape[0]):
+        for s in flat.sub_table[p]:
+            if s >= 0:
+                parent_of[s] = p
+    resid = X - flat.centers[parent_of[assign]]
     # codebooks train on the same bounded sample policy as the coarse
     # quantizer; codes assign in bounded chunks (an (n, ksub) block is
     # 10 GB at 10M x 256)
@@ -234,46 +290,58 @@ def build_ivfpq(
             np.ascontiguousarray(sub), jnp.asarray(codebooks[m])
         ).astype(np.uint8)
     mb = flat.bucket_ids.shape[1]
-    bucket_codes = np.zeros((nlist, mb, M), np.uint8)
-    for lst in range(nlist):
+    bucket_codes = np.zeros((nsub, mb, M), np.uint8)
+    for lst in range(nsub):
         mask = flat.bucket_valid[lst] > 0
         bucket_codes[lst, mask] = codes[flat.bucket_ids[lst][mask]]
     return IVFPQIndex(flat.centers, codebooks, bucket_codes, flat.bucket_ids,
-                      flat.bucket_valid)
+                      flat.bucket_valid, flat.sub_table)
 
 
 @partial(jax.jit, static_argnames=("nprobe", "k"))
 def search_ivfpq(
     queries: jax.Array,  # (q, d)
-    centers: jax.Array,  # (nlist, d)
+    centers: jax.Array,  # (nlist, d) parent centroids
     codebooks: jax.Array,  # (M, ksub, dsub)
-    codes: jax.Array,  # (nlist, mb, M) uint8
-    bucket_ids: jax.Array,
-    bucket_valid: jax.Array,
+    codes: jax.Array,  # (nsub, cap, M) uint8
+    bucket_ids: jax.Array,  # (nsub, cap)
+    bucket_valid: jax.Array,  # (nsub, cap)
+    sub_table: jax.Array,  # (nlist, max_sub)
     nprobe: int,
     k: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """ADC search: per (query, probed list) distance lookup tables over
+    """ADC search: per (query, probed cell) distance lookup tables over
     the residual codebooks, summed across subspaces per candidate code.
-    Folds ONE probed list per step (same rationale and structure as
-    `search_ivfflat`): peak memory one (q, mb, M) code gather + a
-    (q, M, ksub) table instead of the nprobe-times-larger all-at-once
-    forms."""
+    Probes parent cells and folds ONE sub-list per step (same rationale
+    and structure as `search_ivfflat`): peak memory one (q, cap, M)
+    code gather + a (q, M, ksub) table instead of the
+    nprobe-times-larger all-at-once forms."""
     M, ksub, dsub = codebooks.shape
     qn, d = queries.shape
+    max_sub = sub_table.shape[1]
     q2 = (queries * queries).sum(axis=1, keepdims=True)
     dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
-    _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe)
+    _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe) parent ids
+    expanded = jnp.take(sub_table, probe, axis=0).reshape(qn, -1)
+    parents = jnp.repeat(probe, max_sub, axis=1)  # (q, nprobe*max_sub)
+    nsteps = nprobe * max_sub
+    # front-pack real sub-lists (same rationale as search_ivfflat),
+    # carrying the aligned parent ids through the same permutation
+    ordr = jnp.argsort(-expanded, axis=1)
+    expanded = jnp.take_along_axis(expanded, ordr, axis=1)
+    parents = jnp.take_along_axis(parents, ordr, axis=1)
+    n_live = jnp.max(jnp.sum(expanded >= 0, axis=1))
 
     cb2 = (codebooks * codebooks).sum(axis=2)  # (M, ksub)
-    mb = codes.shape[1]
-    kk = min(k, nprobe * mb)
+    cap = codes.shape[1]
+    kk = min(k, nsteps * cap)
 
     def fold(r, carry):
         run_d, run_i = carry
-        lists = probe[:, r]  # (q,)
-        # residual of each query to its r-th probed coarse center
-        resid = queries - jnp.take(centers, lists, axis=0)  # (q, d)
+        lists = expanded[:, r]  # (q,) sub-list ids, may be -1
+        safe = jnp.maximum(lists, 0)
+        # residual of each query to the step's probed PARENT center
+        resid = queries - jnp.take(centers, parents[:, r], axis=0)  # (q, d)
         resid_sub = resid.reshape(qn, M, dsub)
         # lookup tables: ||r_m - c_{m,j}||^2 for each subspace code j
         dot = jnp.einsum(
@@ -282,15 +350,16 @@ def search_ivfpq(
         )
         r2 = (resid_sub * resid_sub).sum(axis=2, keepdims=True)  # (q, M, 1)
         luts = r2 + cb2[None] - 2.0 * dot  # (q, M, ksub)
-        cand_codes = jnp.take(codes, lists, axis=0).astype(jnp.int32)
+        cand_codes = jnp.take(codes, safe, axis=0).astype(jnp.int32)
         # ADC: sum the per-subspace table entries selected by each code
         d2 = jnp.take_along_axis(
             luts[:, None, :, :],  # (q, 1, M, ksub)
-            cand_codes[..., None],  # (q, mb, M, 1)
+            cand_codes[..., None],  # (q, cap, M, 1)
             axis=3,
-        ).squeeze(3).sum(axis=2)  # (q, mb)
-        cv = jnp.take(bucket_valid, lists, axis=0)
-        cid = jnp.take(bucket_ids, lists, axis=0)
+        ).squeeze(3).sum(axis=2)  # (q, cap)
+        cv = jnp.take(bucket_valid, safe, axis=0)
+        cv = cv * (lists >= 0)[:, None]
+        cid = jnp.take(bucket_ids, safe, axis=0)
         d2 = jnp.where(cv > 0, jnp.maximum(d2, 0.0), jnp.inf)
         cat_d = jnp.concatenate([run_d, d2], axis=1)
         cat_i = jnp.concatenate([run_i, cid], axis=1)
@@ -299,7 +368,7 @@ def search_ivfpq(
 
     run_d = jnp.full((qn, kk), jnp.inf, queries.dtype)
     run_i = jnp.full((qn, kk), -1, bucket_ids.dtype)
-    dist, ids = jax.lax.fori_loop(0, nprobe, fold, (run_d, run_i))
+    dist, ids = jax.lax.fori_loop(0, n_live, fold, (run_d, run_i))
     if kk < k:
         pad = k - kk
         dist = jnp.concatenate(
