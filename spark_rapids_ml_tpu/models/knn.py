@@ -600,7 +600,10 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
         }
         if algo == "cagra":
             from ..ops.cagra import build_cagra_graph
-            from ..parallel.mesh import _chunked_device_put
+            from ..parallel.mesh import (
+                _chunked_device_get,
+                _chunked_device_put,
+            )
 
             deg = int(ap.get("graph_degree", 32))
             deg = max(1, min(deg, n - 1))
@@ -616,7 +619,9 @@ class ApproximateNearestNeighbors(_ANNClass, _TpuEstimator, _ANNParams):
                 rounds=max(rounds, 1),
                 sample=None if sample is None else int(sample),
             )
-            attrs.update(cagra_graph=np.asarray(graph))
+            # bounded-slice fetch: a one-shot 1.28 GB graph download
+            # crashed the worker after a fully successful 10M build
+            attrs.update(cagra_graph=_chunked_device_get(graph))
         elif algo == "ivfflat":
             index = ivf_ops.build_ivfflat(X, nlist=nlist)
             attrs.update(

@@ -156,6 +156,33 @@ def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None):
     return buf
 
 
+def _chunked_device_get(arr) -> np.ndarray:
+    """Mirror of `_chunked_device_put` for device->host fetches: a
+    single oversized transfer fails the tunnel transfer-RPC deadline
+    and CRASHES the TPU worker (observed live: fetching the 10M x 32
+    CAGRA graph — 1.28 GB — killed the worker after a fully successful
+    build).  Rows fetch in bounded slices instead."""
+    nbytes = arr.size * arr.dtype.itemsize
+    if nbytes <= _MAX_PUT_BYTES or arr.ndim == 0 or arr.shape[0] <= 1:
+        if nbytes > _MAX_PUT_BYTES:
+            # unsplittable on the row axis: same attribution warning as
+            # the put-side mirror
+            from ..utils import get_logger
+
+            get_logger("mesh").warning(
+                f"one-shot device fetch of {nbytes/2**20:.0f} MiB (single "
+                "row over the transfer ceiling) — may exceed the tunnel "
+                "transfer-RPC deadline"
+            )
+        return np.asarray(arr)
+    row_bytes = max(nbytes // arr.shape[0], 1)
+    rows = max(1, int(_MAX_PUT_BYTES // row_bytes))
+    out = np.empty(arr.shape, arr.dtype)
+    for lo in range(0, arr.shape[0], rows):
+        out[lo : lo + rows] = np.asarray(arr[lo : lo + rows])
+    return out
+
+
 def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
     """device_put for arrays beyond _MAX_PUT_BYTES: bounded row pieces
     assembled on device instead of one transfer.  sharding=None targets

@@ -115,6 +115,13 @@ def test_chunked_device_put_matches_oneshot(monkeypatch):
     st = RowStager(1003, m, bucketing=False)
     staged = st.stage(Xu)
     np.testing.assert_array_equal(np.asarray(staged)[: st.n_valid], Xu)
+    # the fetch mirror: bounded-slice device->host must equal one-shot
+    np.testing.assert_array_equal(
+        mesh_mod._chunked_device_get(staged), np.asarray(staged)
+    )
+    np.testing.assert_array_equal(
+        mesh_mod._chunked_device_get(mesh_mod._chunked_device_put(y)), y
+    )
 
 
 def test_param_mapping_and_defaults():
