@@ -194,6 +194,14 @@ def build_cagra_graph(
             nb,
             sample,
         )
+        # drain the round before dispatching the next: the tunneled dev
+        # chip's transfer RPC deadline (~60 s) is measured against ALL
+        # queued device work, and block_until_ready returns early under
+        # axon — so letting rounds pile up makes the eventual graph
+        # fetch fail and CRASH the worker (observed at 10M: 8 x 15 s
+        # rounds queued behind the fetch).  A scalar fetch is the
+        # reliable drain; one round stays well under the deadline.
+        jax.device_get(graph[0, 0])
     return graph
 
 
