@@ -46,6 +46,7 @@ mods = [
     "spark_rapids_ml_tpu.tuning", "spark_rapids_ml_tpu.pipeline",
     "spark_rapids_ml_tpu.sklearn_api", "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.streaming", "spark_rapids_ml_tpu.metrics",
+    "spark_rapids_ml_tpu.resilience",
     "benchmark.benchmark_runner", "benchmark.gen_data",
     "benchmark.gen_data_distributed",
 ]
@@ -96,7 +97,8 @@ run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
 run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
-    tests/test_no_import_change.py tests/test_pyspark_interop.py \
+    tests/test_resilience.py tests/test_no_import_change.py \
+    tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py "$@"
 # guard against a new test file silently missing from the batches: only
 # run_batch lines count as "listed" (not the --fast tier or comments),
@@ -114,6 +116,14 @@ for root, _dirs, files in os.walk("tests"):
 missing = actual - listed
 assert not missing, f"test files not in any ci batch: {sorted(missing)}"
 PYEOF
+
+echo "== fault-injection smoke: every recovery path on the CPU mesh =="
+# tier-1 marker-safe: exercises guarded dispatch, the retry policy's
+# OOM/timeout/preemption actions, and checkpoint resume on every PR.
+# Intentionally ALSO in a tier-1 batch above (the batch-completeness
+# guard requires it there): this dedicated step keeps the recovery gate
+# visible and runnable in isolation even if the batches are resharded
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
 
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \

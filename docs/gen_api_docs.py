@@ -46,6 +46,7 @@ MODULES = [
     "spark_rapids_ml_tpu.sklearn_api",
     "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.parallel",
+    "spark_rapids_ml_tpu.resilience",
 ]
 
 

@@ -80,6 +80,34 @@ _DEFAULTS: Dict[str, Any] = {
     # write their full optimizer state here after every iteration and
     # RESUME the identical trajectory after a preemption/crash.
     "streaming_checkpoint_dir": "",
+    # Estimator-wide checkpoint directory (resilience/checkpoint.py): every
+    # iterative fit — in-memory KMeans Lloyd, host-dispatched L-BFGS, the
+    # FISTA elastic-net solve, AND the epoch-streaming fits — saves its
+    # solver state here per iteration and resumes after a crash/preemption.
+    # Supersedes `streaming_checkpoint_dir` (kept as a fallback alias for
+    # streaming fits only; it never affects in-memory fits).
+    "checkpoint_dir": "",
+    # Watchdog deadline (seconds) for blocking device work — dispatches,
+    # `block_until_ready`, host fetches (resilience/guard.py `guarded`).
+    # A hang past the deadline raises a typed DispatchTimeout instead of
+    # blocking the controller forever (the axon-tunnel hang class in
+    # TPU_STATUS_r05.md).  0 disables the watchdog (no worker thread).
+    "dispatch_deadline_s": 0.0,
+    # Declarative retry policy for guarded fit/transform dispatch
+    # (resilience/retry.py RetryPolicy.from_config): total attempts, then
+    # exponential backoff base/multiplier and jitter fraction for
+    # transient (RPC/DEADLINE/timeout) errors.
+    "retry_max_attempts": 3,
+    "retry_backoff_s": 0.5,
+    "retry_backoff_mult": 2.0,
+    "retry_jitter": 0.25,
+    # Deterministic fault injection (resilience/faults.py):
+    # "site:kind[:times[:skip]]" comma list, e.g.
+    # "fit_kernel:oom:1,transform_dispatch:timeout:1:2".  Kinds: oom,
+    # timeout, preemption, hang.  Empty disables.  Tests use the
+    # `fault_inject` context manager instead; this conf arms sites for
+    # whole-process runs (CI smoke, bench rehearsals).
+    "fault_inject_spec": "",
     # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
     # fusedL2Knn analog, ops/pallas_knn.py): "off" (default) keeps the XLA
     # materialize-then-top_k kernels, "auto" enables it on real TPU

@@ -45,10 +45,32 @@ class FitInput:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
-def _is_oom(e: BaseException) -> bool:
-    """Whether an exception is an XLA device-memory exhaustion."""
-    s = str(e)
-    return "RESOURCE_EXHAUSTED" in s or "Out of memory" in s or "out of memory" in s
+# error classification now lives in the resilience layer (one classifier
+# set for every dispatch site); re-exported here for back-compat
+from .resilience import is_oom as _is_oom  # noqa: F401
+
+
+def _fit_fingerprint(fit_input: FitInput) -> str:
+    """Cheap content fingerprint binding an in-memory checkpoint tag to
+    the DATA, not just its shape: two scalar device reductions over the
+    staged arrays (plus the weighted label sum when present).  Without
+    this, a crashed fit's checkpoint would be silently resumed by a
+    same-shaped, same-hyperparameter fit on DIFFERENT data — skipping
+    most of its iterations (the in-file tag check in
+    resilience/checkpoint.py can only refuse what the tag encodes).
+    Streaming fits bind the dataset path instead."""
+    import jax
+    import jax.numpy as jnp
+
+    sx = jax.device_get(jnp.sum(fit_input.X, dtype=jnp.float32))
+    sw = jax.device_get(jnp.sum(fit_input.w, dtype=jnp.float32))
+    parts = [f"sx={float(sx):.9g}", f"swt={float(sw):.9g}"]
+    if fit_input.y is not None:
+        sy = jax.device_get(
+            jnp.sum(fit_input.y.astype(jnp.float32) * fit_input.w)
+        )
+        parts.append(f"sy={float(sy):.9g}")
+    return "|".join(parts)
 
 
 def _resolve_feature_params(inst: Params) -> Tuple[Optional[str], Sequence[str]]:
@@ -481,6 +503,28 @@ class _TpuEstimator(Estimator, _TpuCaller):
 
     # -- fit orchestration ---------------------------------------------------
 
+    def _run_fit_kernel(self, fit_input: FitInput) -> Dict[str, Any]:
+        """Dispatch the distributed fit kernel through the resilience
+        layer (resilience/): the `fit_kernel` fault-injection site, the
+        `guarded` watchdog (`dispatch_deadline_s` — a hang raises a typed
+        DispatchTimeout instead of blocking the controller), and the
+        configured RetryPolicy: transient RPC/DEADLINE errors back off and
+        re-dispatch, OOM drops the failed dispatch's temporaries and
+        re-dispatches, a preemption re-inits `jax.distributed` first —
+        and iterative solvers with `checkpoint_dir` set then resume from
+        their per-iteration checkpoint rather than iteration 0."""
+        from .resilience import guarded, maybe_inject, retry_call
+
+        def _kernel() -> Dict[str, Any]:
+            maybe_inject("fit_kernel")
+            return self._fit_array(fit_input)
+
+        return retry_call(
+            lambda: guarded(_kernel, label="fit_kernel", log=self.logger),
+            label="fit_kernel",
+            log=self.logger,
+        )
+
     def _extract(self, dataset: DatasetLike) -> _ArrayBatch:
         features_col, features_cols = _resolve_feature_params(self)
         label_col = (
@@ -561,6 +605,9 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 return self._fit_streaming(path)
         ds_dev = fit_input = None
         try:
+            from .resilience import maybe_inject
+
+            maybe_inject("stage_parquet")
             ds_dev = stage_parquet(
                 path,
                 features_col=fcol,
@@ -573,7 +620,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 chunk_rows=None,
             )
             fit_input = self._stage_from_device(ds_dev)
-            return self._fit_array(fit_input)
+            return self._run_fit_kernel(fit_input)
         except Exception as e:
             # drop the staged buffers BEFORE any retry — keeping them alive
             # would hold the very HBM whose exhaustion we are recovering from
@@ -640,7 +687,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     with trace("stage_from_device", self.logger):
                         fit_input = self._stage_from_device(dataset)
                     with trace("fit_kernel", self.logger):
-                        attrs = self._fit_array(fit_input)
+                        attrs = self._run_fit_kernel(fit_input)
                 else:
                     from .config import get_config
                     from .streaming import is_parquet_path
@@ -657,7 +704,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
                         with trace("stage", self.logger):
                             fit_input = self._stage_fit_input(batch)
                         with trace("fit_kernel", self.logger):
-                            attrs = self._fit_array(fit_input)
+                            attrs = self._run_fit_kernel(fit_input)
         finally:
             if exchange_cleanup:
                 import shutil
@@ -710,7 +757,7 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 fi = FitInput(
                     **{**fit_input.__dict__, "params": dict(est_i._tpu_params)}
                 )
-                attrs = est_i._fit_array(fi)
+                attrs = est_i._run_fit_kernel(fi)
                 model = est_i._create_model(attrs)
                 est_i._copyValues(model, paramMaps[index])
                 return index, model
@@ -861,6 +908,9 @@ class _TpuModel(Model, _TpuCaller):
         def _dispatch(lo: int):
             """Stage one chunk and launch its device program (ASYNC — jax
             dispatch returns with the transfer/compute in flight)."""
+            from .resilience import maybe_inject
+
+            maybe_inject("transform_dispatch")
             hi = min(lo + chunk, n)
             with trace(f"dispatch_chunk[{lo}:{hi}]", self.logger):
                 if sparse_in:
@@ -898,6 +948,15 @@ class _TpuModel(Model, _TpuCaller):
         # budget (same peak device footprint as the serial loop), re-floored
         # to the bucket grid
         chunk = _floor_chunk(chunk // 2)
+        # recovery is policy-driven (resilience/retry.py): OOM halves the
+        # chunk (the policy's shrink-batch action, bounded by the n_dev
+        # floor) while transient/preemption errors back off and re-dispatch
+        # the SAME chunk size, bounded by max_attempts since the last
+        # successfully published chunk
+        from .resilience import RetryPolicy
+
+        policy = RetryPolicy.from_config()
+        transient_attempts = 0
         pending = None
         while lo < n or pending is not None:
             current = None  # a dispatch failure must not reuse last round's
@@ -907,22 +966,40 @@ class _TpuModel(Model, _TpuCaller):
                     lo = current[1]
                 if pending is not None:
                     _collect(pending)
+                    transient_attempts = 0  # progress resets the budget
                 pending = current
             except Exception as e:
-                # OOM backoff: halve the chunk and RESUME at the first
-                # unpublished row — async errors surface at the fetch, so
-                # both in-flight chunks are discarded and re-run
-                # (completed chunks are kept — the analog of the
-                # reference's reserved-memory OOM loop, utils.py:403-522)
-                if not _is_oom(e) or chunk <= n_dev:
+                # async errors surface at the fetch, so both in-flight
+                # chunks are discarded and re-run from the first
+                # unpublished row (completed chunks are kept — the analog
+                # of the reference's reserved-memory OOM loop,
+                # utils.py:403-522)
+                action = policy.classify(e)
+                if action == "fatal" or (action == "oom" and chunk <= n_dev):
                     raise
+                if action != "oom":
+                    transient_attempts += 1
+                    if transient_attempts >= policy.max_attempts:
+                        raise
                 resume_at = pending[0] if pending is not None else (
                     current[0] if current is not None else lo
                 )
-                # drain the discarded in-flight programs BEFORE the retry:
-                # dropping the refs only queues deletion, and an immediate
-                # re-dispatch would contend with their unfreed buffers
-                for inflight in (pending, current):
+                to_drain, pending, current = (pending, current), None, None
+            else:
+                continue
+            # the recovery runs OUTSIDE the except block (same
+            # poisoned-buffer rule as _stage_or_stream: the exception
+            # state pins the failed dispatch's frames, and its locals
+            # reference the very device buffers being recovered).
+            # Drain the discarded in-flight programs BEFORE the retry:
+            # dropping the refs only queues deletion, and an immediate
+            # re-dispatch would contend with their unfreed buffers.
+            # OOM ONLY: after a preemption the backing runtime is gone and
+            # after a watchdog timeout the program is by definition still
+            # hung — block_until_ready on either can block forever, which
+            # is the very hang class this layer removes
+            if action == "oom":
+                for inflight in to_drain:
                     if inflight is None:
                         continue
                     for v in inflight[3].values():
@@ -931,13 +1008,38 @@ class _TpuModel(Model, _TpuCaller):
                                 v.block_until_ready()
                             except Exception:
                                 pass  # the original error already surfaced
-                pending = current = None
-                lo = resume_at
+            lo = resume_at
+            from .tracing import event
+
+            event(
+                "retry[transform_dispatch]",
+                detail=f"action={action} resume_row={lo}",
+                log=self.logger,
+            )
+            if action == "oom":
                 chunk = _floor_chunk(chunk // 2)
                 self.logger.warning(
                     f"Transform chunk exhausted device memory; resuming at "
                     f"row {lo} with chunk={chunk} rows"
                 )
+            elif action == "preemption":
+                from .resilience.retry import _default_preemption_hook
+
+                # the fit path's repair hook: reinit_distributed guarded so
+                # a failed re-bootstrap still lets the retry run
+                _default_preemption_hook()
+                self.logger.warning(
+                    f"Transform dispatch preempted; resuming at row {lo}"
+                )
+            else:  # transient
+                delay = policy.backoff(transient_attempts)
+                self.logger.warning(
+                    f"Transform dispatch failed transiently; retrying row "
+                    f"{lo} in {delay:.2f}s "
+                    f"({transient_attempts}/{policy.max_attempts - 1} "
+                    "retries since last progress)"
+                )
+                time.sleep(delay)
         if all(len(v) == 1 for v in outs.values()):
             return {c: v[0] for c, v in outs.items()}
         return {c: np.concatenate(v, axis=0) for c, v in outs.items()}

@@ -323,9 +323,9 @@ class LogisticRegression(
             self.getOrDefault("elasticNetParam")
         )
         fit_intercept = bool(p["fit_intercept"])
-        from ..config import get_config
+        from ..resilience.checkpoint import resolve_checkpoint_dir
 
-        ckpt_dir = str(get_config("streaming_checkpoint_dir") or "")
+        ckpt_dir = resolve_checkpoint_dir(streaming=True)
         res = logreg_streaming_fit(
             path, fcol, fcols, label_col, weight_col,
             family=str(self.getOrDefault("family")),
@@ -441,6 +441,33 @@ class LogisticRegression(
 
         w = fit_input.w
         sparse = "ell_cols" in fit_input.extra
+        # estimator-wide checkpoint/resume: `checkpoint_dir` set -> the
+        # host-dispatched (checkpointable) solver runs regardless of the
+        # FLOP gate — the fused while_loop is one opaque device program
+        # with no iteration boundary to persist at
+        from ..resilience.checkpoint import (
+            checkpoint_file_for,
+            resolve_checkpoint_dir,
+        )
+
+        ckpt_dir = resolve_checkpoint_dir()
+        ckpt_path = None
+        ckpt_tag = ""
+        if ckpt_dir:
+            from ..core import _fit_fingerprint
+
+            # m (lbfgs_memory) is shape-critical: the checkpointed S/Y
+            # history buffers are (m, n), so a resume under a different m
+            # must tag-mismatch and start fresh, not broadcast-fail
+            ckpt_tag = (
+                f"logreg-mem|n={int(fit_input.X.shape[0])}"
+                f"|d={fit_input.pdesc.n}|C={n_classes}|l2={l2}|l1={l1}"
+                f"|int={fit_intercept}|std={standardization}|mi={max_iter}"
+                f"|m={int(p.get('lbfgs_memory', 10))}"
+                f"|ls={int(p.get('linesearch_max_iter', 20))}"
+                f"|{_fit_fingerprint(fit_input)}"
+            )
+            ckpt_path = checkpoint_file_for(ckpt_dir, ckpt_tag)
         kwargs = dict(
             l2=l2,
             l1=l1,
@@ -472,14 +499,15 @@ class LogisticRegression(
             C_eff = 1 if binomial else n_classes
             per_eval = 4.0 * vals.shape[0] * vals.shape[1] * C_eff
             budget = float(get_config("dispatch_flops_limit"))
-            if per_eval * max_iter * 2.0 > budget:
+            if per_eval * max_iter * 2.0 > budget or ckpt_path:
                 from ..ops.logistic import logreg_fit_host_dispatch
                 from ..ops.sparse import ell_matmat, ell_matvec
 
                 self.logger.info(
                     "LogisticRegression: host-dispatched L-BFGS (sparse; "
-                    f"{per_eval * max_iter * 2.0:.2e} fused FLOPs > "
-                    f"budget {budget:.0e})"
+                    f"{per_eval * max_iter * 2.0:.2e} fused FLOPs vs "
+                    f"budget {budget:.0e}, checkpointing "
+                    f"{'on' if ckpt_path else 'off'})"
                 )
                 coef, b, loss, n_iter, hist = logreg_fit_host_dispatch(
                     vals, w, fit_input.y, n_classes=n_classes,
@@ -487,6 +515,8 @@ class LogisticRegression(
                     data=(vals, cols),
                     margin_fn=lambda dat, beta: ell_matvec(*dat, beta),
                     logits_fn=lambda dat, Wm: ell_matmat(*dat, Wm),
+                    checkpoint_path=ckpt_path,
+                    checkpoint_tag=ckpt_tag,
                     **kwargs,
                 )
             elif binomial:
@@ -529,17 +559,19 @@ class LogisticRegression(
             per_eval = 4.0 * X.shape[0] * X.shape[1] * C_eff
             fused_flops = per_eval * max_iter * 2.0  # ~2 evals/iter
             budget = float(get_config("dispatch_flops_limit"))
-            if fused_flops > budget:
+            if fused_flops > budget or ckpt_path:
                 from ..ops.logistic import logreg_fit_host_dispatch
 
                 self.logger.info(
                     f"LogisticRegression: host-dispatched L-BFGS "
-                    f"({fused_flops:.2e} fused FLOPs > budget "
-                    f"{budget:.0e})"
+                    f"({fused_flops:.2e} fused FLOPs vs budget "
+                    f"{budget:.0e}, checkpointing "
+                    f"{'on' if ckpt_path else 'off'})"
                 )
                 coef, b, loss, n_iter, hist = logreg_fit_host_dispatch(
                     X, w, fit_input.y, n_classes=n_classes,
-                    binomial=binomial, **kwargs
+                    binomial=binomial, checkpoint_path=ckpt_path,
+                    checkpoint_tag=ckpt_tag, **kwargs
                 )
             elif binomial:
                 coef, b, loss, n_iter, hist = logreg_fit_binary(

@@ -183,13 +183,13 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         reference's cluster-memory-scaled ingest, utils.py:403-522)."""
         from ..streaming import kmeans_streaming_fit
 
-        from ..config import get_config
-
         fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
+        from ..resilience.checkpoint import resolve_checkpoint_dir
+
         p = self._tpu_params
         seed = p.get("random_state")
         seed = int(seed) if seed is not None else int(self.getOrDefault("seed"))
-        ckpt_dir = str(get_config("streaming_checkpoint_dir") or "")
+        ckpt_dir = resolve_checkpoint_dir(streaming=True)
         res = kmeans_streaming_fit(
             path, fcol, fcols, weight_col,
             k=int(p["n_clusters"]),
@@ -224,6 +224,25 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         # (45 s dispatch rule); then host-dispatched per-block
         # iterations.  The gate itself lives in ops/kmeans.py
         # kmeans_fit_auto, shared with the IVF quantizer training.
+        # `checkpoint_dir` set -> the stepwise (checkpointable) solver
+        # runs regardless of size and the fit resumes after a crash.
+        from ..resilience.checkpoint import (
+            checkpoint_file_for,
+            resolve_checkpoint_dir,
+        )
+
+        ckpt_dir = resolve_checkpoint_dir()
+        ckpt_path = None
+        ckpt_tag = ""
+        if ckpt_dir:
+            from ..core import _fit_fingerprint
+
+            ckpt_tag = (
+                f"kmeans-mem|n={int(fit_input.X.shape[0])}"
+                f"|d={fit_input.pdesc.n}|k={k}|seed={seed}"
+                f"|mi={max_iter}|tol={p['tol']}|{_fit_fingerprint(fit_input)}"
+            )
+            ckpt_path = checkpoint_file_for(ckpt_dir, ckpt_tag)
         centers, cost, n_iter, stepwise = kmeans_fit_auto(
             fit_input.X,
             fit_input.w,
@@ -234,6 +253,8 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
             init=str(p["init"]),
             init_steps=int(p.get("init_steps") or 2),
             oversample=float(p.get("oversampling_factor") or 2.0),
+            checkpoint_path=ckpt_path,
+            checkpoint_tag=ckpt_tag,
         )
         if stepwise:
             self.logger.info("KMeans: stepwise host-dispatched Lloyd")

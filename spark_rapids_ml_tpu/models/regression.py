@@ -191,6 +191,30 @@ class LinearRegression(
         super().__init__()
         self._set_params(**kwargs)
 
+    def _fista_checkpoint(self, gram: np.ndarray, sxy: np.ndarray, sw: float):
+        """(checkpoint_path, tag) for the FISTA elastic-net loop when the
+        `checkpoint_dir` conf is set (the estimator-wide resume contract,
+        resilience/checkpoint.py).  The tag binds the problem CONTENT —
+        Gram/cross-moment checksums, not just shapes — so a same-shaped
+        fit on different data can never resume this one's state."""
+        from ..resilience.checkpoint import (
+            checkpoint_file_for,
+            resolve_checkpoint_dir,
+        )
+
+        ckpt_dir = resolve_checkpoint_dir()
+        if not ckpt_dir:
+            return None, ""
+        p = self._tpu_params
+        tag = (
+            f"linreg-fista|d={int(gram.shape[0])}|sw={sw}"
+            f"|gs={float(np.float64(gram).sum()):.12g}"
+            f"|xs={float(np.float64(sxy).sum()):.12g}"
+            f"|a={p['alpha']}|l1r={p['l1_ratio']}|int={p['fit_intercept']}"
+            f"|std={p.get('standardization', True)}|mi={p['max_iter']}"
+        )
+        return checkpoint_file_for(ckpt_dir, tag), tag
+
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
         from ..ops.linear import linreg_sufficient_stats, solve_linear_host
 
@@ -198,9 +222,11 @@ class LinearRegression(
         gram, sxy, s1, sw, sy, syy = linreg_sufficient_stats(
             fit_input.X, fit_input.w, fit_input.y
         )
+        gram_h, sxy_h = np.asarray(gram), np.asarray(sxy)
+        ckpt_path, ckpt_tag = self._fista_checkpoint(gram_h, sxy_h, float(sw))
         coef, intercept, diag = solve_linear_host(
-            np.asarray(gram),
-            np.asarray(sxy),
+            gram_h,
+            sxy_h,
             np.asarray(s1),
             float(sw),
             float(sy),
@@ -211,6 +237,8 @@ class LinearRegression(
             standardization=bool(p.get("standardization", True)),
             tol=float(p["tol"]),
             max_iter=int(p["max_iter"]),
+            checkpoint_path=ckpt_path,
+            checkpoint_tag=ckpt_tag,
         )
         # summary metrics via a cancellation-free residual pass over the
         # still-staged data (the one-pass SSE expansion loses ~eps·Σwy²)
@@ -282,6 +310,9 @@ class LinearRegression(
         from ..ops.linear import solve_linear_host
 
         p = self._tpu_params
+        ckpt_path, ckpt_tag = self._fista_checkpoint(
+            np.asarray(st["gram"]), np.asarray(st["sxy"]), float(st["sw"])
+        )
         coef, intercept, diag = solve_linear_host(
             np.asarray(st["gram"]),
             np.asarray(st["sxy"]),
@@ -295,6 +326,8 @@ class LinearRegression(
             standardization=bool(p.get("standardization", True)),
             tol=float(p["tol"]),
             max_iter=int(p["max_iter"]),
+            checkpoint_path=ckpt_path,
+            checkpoint_tag=ckpt_tag,
         )
         dtype = np.dtype(dtype)
         return {

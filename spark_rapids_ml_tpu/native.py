@@ -29,7 +29,19 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
+_BUILD_TIMEOUT_S = 300
+
+
+class NativeBuildTimeout(RuntimeError):
+    """The native staging build's compiler hung past the timeout.  Unlike
+    a missing g++ (an expected environment, silently falls back to numpy),
+    a HUNG compiler is a real fault worth surfacing loudly — and the bare
+    `TimeoutExpired` loses the command line and any partial stderr, which
+    is exactly what's needed to debug it."""
+
+
 def _build() -> bool:
+    global _load_failed
     os.makedirs(_BUILD_DIR, exist_ok=True)
     # compile to a process-unique temp path and rename into place so
     # concurrent builders never dlopen a half-written library
@@ -39,7 +51,22 @@ def _build() -> bool:
         "-std=c++17", _SRC, "-o", tmp_path,
     ]
     try:
-        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=_BUILD_TIMEOUT_S
+        )
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        # latch the failure like every other build/load path: without
+        # this, each subsequent staging call re-runs the full hung
+        # compile and pays the timeout again
+        _load_failed = True
+        raise NativeBuildTimeout(
+            f"native staging build timed out after {_BUILD_TIMEOUT_S}s: "
+            f"`{' '.join(cmd)}`"
+            + (f"; partial stderr: {stderr[-500:]}" if stderr else "")
+        ) from e
     except Exception as e:  # g++ missing etc.
         get_logger("spark_rapids_ml_tpu.native").warning(
             f"native staging build unavailable ({e}); using numpy fallback"
@@ -250,4 +277,7 @@ def densify_csr(csr, n_pad: int, dtype: np.dtype) -> np.ndarray:
     return out
 
 
-__all__ = ["available", "pad_cast", "pack_rows", "densify_csr"]
+__all__ = [
+    "NativeBuildTimeout", "available", "pad_cast", "pack_rows",
+    "densify_csr",
+]

@@ -253,6 +253,8 @@ def kmeans_fit_auto(
     init_steps: int = 2,
     oversample: float = 2.0,
     budget: float = None,
+    checkpoint_path: str = None,
+    checkpoint_tag: str = "",
 ):
     """The ONE fused-vs-stepwise gate (dispatch rule): the fused
     single-program solver while `2·n·d·k·max_iter + n·init_per_row`
@@ -260,6 +262,10 @@ def kmeans_fit_auto(
     `budget` is None), else the host-dispatched stepwise Lloyd.  Shared
     by the KMeans model (models/clustering.py) and the IVF quantizer/
     codebook training (ops/ivf.py) so the cost model cannot diverge.
+    `checkpoint_path` forces the stepwise solver regardless of size: the
+    fused while_loop is one opaque device program with no iteration
+    boundary to checkpoint at, while the stepwise loop persists centers
+    per iteration and RESUMES after a crash (resilience/checkpoint.py).
     Returns (centers, cost, n_iter, used_stepwise)."""
     if budget is None:
         from ..config import get_config
@@ -272,11 +278,12 @@ def kmeans_fit_auto(
     fused_flops = 2.0 * n * d * k * max(max_iter, 1) + n * init_per_row
     kwargs = dict(k=k, seed=seed, max_iter=max_iter, tol=tol, init=init,
                   init_steps=init_steps, oversample=oversample)
-    if fused_flops <= budget:
+    if fused_flops <= budget and not checkpoint_path:
         centers, cost, n_iter = kmeans_fit(X, w, **kwargs)
         return centers, cost, n_iter, False
     centers, cost, n_iter = kmeans_fit_stepwise(
-        X, w, flops_budget=budget, **kwargs
+        X, w, flops_budget=budget, checkpoint_path=checkpoint_path,
+        checkpoint_tag=checkpoint_tag, **kwargs
     )
     return centers, cost, n_iter, True
 
@@ -293,6 +300,8 @@ def kmeans_fit_stepwise(
     oversample: float = 2.0,
     flops_budget: float = 2e12,
     init_rows: int = 262_144,
+    checkpoint_path: str = None,
+    checkpoint_tag: str = "",
 ):
     """Lloyd with HOST-dispatched iterations for device-resident data.
 
@@ -307,8 +316,20 @@ def kmeans_fit_stepwise(
     the init's D2 passes would themselves exceed the budget, seeding runs
     on a strided subsample (the `kmeans_streaming_fit` contract).  Same
     update math as `kmeans_fit`; trajectories match up to f32 reduction
-    order when seeded identically."""
+    order when seeded identically.
+
+    `checkpoint_path`/`checkpoint_tag`: per-iteration center checkpoint
+    via the shared contract (resilience/checkpoint.py) — a crashed or
+    preempted fit resumes at its last completed Lloyd iteration instead
+    of re-seeding and restarting at iteration 0."""
     import numpy as np
+
+    from ..resilience import maybe_inject
+    from ..resilience.checkpoint import (
+        clear_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
 
     n, d = X.shape
     # ---- seeding ----
@@ -326,7 +347,20 @@ def kmeans_fit_stepwise(
         Xs, ws = X[::stride], w[::stride]
     else:
         Xs, ws = X, w
-    if init in ("scalable-k-means++", "k-means||"):
+    start_it = 0
+    resumed = (
+        load_checkpoint(checkpoint_path, checkpoint_tag)
+        if checkpoint_path
+        else None
+    )
+    if resumed is not None:
+        # centers persist in f64 (host truth); the device consumes X.dtype
+        C = jnp.asarray(np.asarray(resumed["centers"]), X.dtype)
+        start_it = int(resumed["it"])
+        from ..tracing import event
+
+        event("kmeans_resume", detail=f"it={start_it}")
+    elif init in ("scalable-k-means++", "k-means||"):
         m = min(m, int(Xs.shape[0]))
         C = kmeans_parallel_init(Xs, ws, k, seed, rounds=rounds, m=m)
     else:
@@ -353,13 +387,22 @@ def kmeans_fit_stepwise(
             )
         return acc
 
-    n_iter = 0
-    for n_iter in range(1, max_iter + 1):
+    n_iter = start_it
+    for n_iter in range(start_it + 1, max_iter + 1):
+        maybe_inject("kmeans_lloyd")
         sums, counts, _ = one_pass(C)
         C, shift2 = _lloyd_center_update(C, sums, counts)
-        if float(np.asarray(shift2)) <= tol * tol:  # scalar fetch = sync
+        shift2 = float(np.asarray(shift2))  # scalar fetch = sync
+        if checkpoint_path:
+            save_checkpoint(
+                checkpoint_path, checkpoint_tag,
+                {"centers": np.asarray(C, np.float64), "it": n_iter},
+            )
+        if shift2 <= tol * tol:
             break
     _, _, cost = one_pass(C)
+    if checkpoint_path:
+        clear_checkpoint(checkpoint_path)
     return C, cost, n_iter
 
 

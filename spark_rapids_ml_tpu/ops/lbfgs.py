@@ -219,18 +219,26 @@ def lbfgs_minimize_host(
     cluster-memory ingest (reference utils.py:403-522): dataset size here
     is bounded by DISK, not HBM x chips.
 
-    `checkpoint_path`: epoch-streaming fits can run for hours; when set,
-    the full optimizer state is written (atomically) after every accepted
-    iteration and a later call with the same path RESUMES the identical
-    trajectory — the beyond-HBM analog of a training-job preemption
-    recovery.  The file is removed on successful completion.
+    `checkpoint_path`: long-running fits (epoch-streaming over hours, or
+    the host-dispatched in-memory solver with `checkpoint_dir` set) write
+    the full optimizer state after every accepted iteration via the
+    shared checkpoint contract (resilience/checkpoint.py: atomic tmp +
+    os.replace, rank-0 writer, in-file tag check) and a later call with
+    the same path RESUMES the identical trajectory — the beyond-HBM
+    analog of a training-job preemption recovery.  The file is removed on
+    successful completion.
 
     Returns (w, n_iter, converged, history) with history the full
     (penalty-inclusive) objective per accepted iterate, entry 0 = initial.
     """
-    import os
-
     import numpy as np
+
+    from ..resilience import maybe_inject
+    from ..resilience.checkpoint import (
+        clear_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
 
     n = w0.shape[0]
     m = history
@@ -255,38 +263,14 @@ def lbfgs_minimize_host(
     rho = np.zeros((m,))
     k = 0
 
-    def _is_writer() -> bool:
-        # multi-process pods run this loop in lockstep on every process
-        # (the oracle all-reduces); only rank 0 writes the shared file to
-        # avoid concurrent savez/replace races
-        try:
-            import jax
-
-            return jax.process_index() == 0
-        except Exception:
-            return True
-
-    def save_checkpoint(state: dict) -> None:
-        if not _is_writer():
-            return
-        tmp = checkpoint_path + ".tmp.npz"
-        np.savez(tmp, tag=np.asarray(checkpoint_tag), **state)
-        os.replace(tmp, checkpoint_path)
-
-    resumed = None
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        with np.load(checkpoint_path, allow_pickle=False) as z:
-            resumed = {kk: z[kk] for kk in z.files}
-        # a checkpoint is only trusted for the SAME problem: the tag binds
-        # it to (data, params, shapes); anything else starts fresh
-        if str(resumed.get("tag", "")) != checkpoint_tag:
-            import warnings
-
-            warnings.warn(
-                f"Ignoring checkpoint {checkpoint_path}: it belongs to a "
-                "different fit (tag mismatch)"
-            )
-            resumed = None
+    # a checkpoint is only trusted for the SAME problem: the tag binds it
+    # to (data, params, shapes); anything else starts fresh (the tag check
+    # lives in resilience/checkpoint.py load_checkpoint)
+    resumed = (
+        load_checkpoint(checkpoint_path, checkpoint_tag)
+        if checkpoint_path
+        else None
+    )
 
     def direction(pg):
         q = pg.astype(np.float64).copy()
@@ -312,9 +296,9 @@ def lbfgs_minimize_host(
         return -r
 
     if resumed is not None:
-        w = resumed["w"]
+        w = np.asarray(resumed["w"])
         f = float(resumed["f"])
-        g = resumed["g"]
+        g = np.asarray(resumed["g"])
         S[:] = resumed["S"]
         Y[:] = resumed["Y"]
         rho[:] = resumed["rho"]
@@ -322,6 +306,9 @@ def lbfgs_minimize_host(
         it = int(resumed["it"])
         hist = [float(v) for v in resumed["hist"]]
         converged = bool(resumed["converged"])
+        from ..tracing import event
+
+        event("lbfgs_resume", detail=f"it={it}")
     else:
         w = np.asarray(w0, np.float64).copy()
         f, g = value_and_grad(w)
@@ -329,6 +316,7 @@ def lbfgs_minimize_host(
         converged = False
         it = 0
     while it < max_iter and not converged:
+        maybe_inject("lbfgs_iteration")
         pg = pseudo_grad(w, g)
         p = direction(pg)
         if l1 > 0:
@@ -370,11 +358,11 @@ def lbfgs_minimize_host(
         hist.append(new_full)
         it += 1
         if checkpoint_path:
-            save_checkpoint({
-                "w": w, "f": np.asarray(f), "g": g, "S": S, "Y": Y,
-                "rho": rho, "k": np.asarray(k), "it": np.asarray(it),
-                "hist": np.asarray(hist), "converged": np.asarray(converged),
+            save_checkpoint(checkpoint_path, checkpoint_tag, {
+                "w": w, "f": f, "g": g, "S": S, "Y": Y,
+                "rho": rho, "k": k, "it": it,
+                "hist": np.asarray(hist), "converged": converged,
             })
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        os.remove(checkpoint_path)
+    if checkpoint_path:
+        clear_checkpoint(checkpoint_path)
     return w, it, converged, hist

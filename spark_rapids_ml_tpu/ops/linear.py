@@ -64,8 +64,15 @@ def solve_linear_host(
     standardization: bool,
     tol: float,
     max_iter: int,
+    checkpoint_path: str = None,
+    checkpoint_tag: str = "",
 ) -> Tuple[np.ndarray, float, Dict[str, float]]:
     """Solve from sufficient statistics on the host in float64.
+
+    `checkpoint_path`/`checkpoint_tag`: the FISTA elastic-net loop (the
+    only iterative branch) persists its state per iteration via the
+    shared contract (resilience/checkpoint.py) and resumes an
+    interrupted solve; the closed-form branches have nothing to resume.
 
     Returns (coefficients (d,), intercept, diagnostics).
     """
@@ -106,6 +113,13 @@ def solve_linear_host(
         coef_s = np.linalg.solve(gram_s + sw * l2 * np.eye(d), sxy_s)
     else:
         # FISTA on f(β)=1/(2n)(βᵀGβ - 2bᵀβ) + λ₂/2‖β‖², prox for λ₁‖β‖₁
+        from ..resilience import maybe_inject
+        from ..resilience.checkpoint import (
+            clear_checkpoint,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
         G = gram_s / sw
         b = sxy_s / sw
         L = float(np.linalg.eigvalsh(G)[-1]) + l2
@@ -113,7 +127,26 @@ def solve_linear_host(
         beta = np.zeros(d)
         z = beta.copy()
         t_mom = 1.0
-        for it in range(max_iter):
+        start_it = 0
+        resumed = (
+            load_checkpoint(checkpoint_path, checkpoint_tag)
+            if checkpoint_path
+            else None
+        )
+        if resumed is not None:
+            beta = np.asarray(resumed["beta"])
+            z = np.asarray(resumed["z"])
+            t_mom = float(resumed["t_mom"])
+            start_it = int(resumed["it"])
+            # a checkpoint saved at it==max_iter (crash between the final
+            # save and clear) skips the loop entirely — the diag count
+            # must still report the iterations already run
+            n_iter = start_it
+            from ..tracing import event
+
+            event("fista_resume", detail=f"it={start_it}")
+        for it in range(start_it, max_iter):
+            maybe_inject("linreg_fista")
             grad = G @ z - b + l2 * z
             beta_new = _soft_threshold(z - grad / L, l1 / L)
             t_new = 0.5 * (1.0 + np.sqrt(1.0 + 4.0 * t_mom * t_mom))
@@ -122,8 +155,15 @@ def solve_linear_host(
             beta = beta_new
             t_mom = t_new
             n_iter = it + 1
+            if checkpoint_path:
+                save_checkpoint(
+                    checkpoint_path, checkpoint_tag,
+                    {"beta": beta, "z": z, "t_mom": t_mom, "it": n_iter},
+                )
             if delta <= tol * max(1.0, float(np.max(np.abs(beta)))):
                 break
+        if checkpoint_path:
+            clear_checkpoint(checkpoint_path)
         coef_s = beta
 
     coef = coef_s / scale

@@ -294,6 +294,8 @@ def logreg_fit_host_dispatch(
     logits_fn: Callable = None,
     d: int = None,
     data=None,
+    checkpoint_path: str = None,
+    checkpoint_tag: str = "",
 ):
     """HOST-driven L-BFGS over device-RESIDENT data: one dispatched
     value+grad program per evaluation instead of the whole solve in one
@@ -317,6 +319,10 @@ def logreg_fit_host_dispatch(
     executable (jax's "large amount of constants were captured" warning);
     as arguments they stay device-resident buffers referenced per
     dispatch.
+
+    `checkpoint_path`/`checkpoint_tag` flow to `lbfgs_minimize_host`:
+    the optimizer state persists per accepted iteration and an
+    interrupted fit resumes its trajectory (resilience/checkpoint.py).
 
     Returns (W (C,d) | coef (d,), b, loss, n_iter, history) matching the
     fused kernels' shapes for the same `binomial` flag.
@@ -367,6 +373,8 @@ def logreg_fit_host_dispatch(
         l1=l1,
         l1_mask=np.asarray(l1_mask, np.float64),
         ls_max=ls_max,
+        checkpoint_path=checkpoint_path,
+        checkpoint_tag=checkpoint_tag,
     )
     coef, b = unpack(jnp.asarray(theta, dtype))
     # hist already carries the FULL (penalty-inclusive) objective per

@@ -16,4 +16,9 @@ from .mesh import (  # noqa: F401
     data_pspec,
     replicated_pspec,
 )
-from .context import TpuContext, init_distributed  # noqa: F401
+from .context import (  # noqa: F401
+    TpuContext,
+    init_distributed,
+    reinit_distributed,
+    shutdown_distributed,
+)

@@ -27,6 +27,17 @@ from .mesh import get_mesh
 _distributed_initialized = False
 
 
+def _runtime_initialized() -> bool:
+    """Whether the jax distributed runtime is live, across jax versions:
+    `jax.distributed.is_initialized()` where it exists, else the
+    `global_state.client` probe older releases expose."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    state = getattr(jax.distributed, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -50,7 +61,7 @@ def init_distributed(
     global _distributed_initialized
     # NB: do not touch jax.process_count()/jax.devices() here — they
     # initialize the XLA backend, after which distributed init is rejected
-    if _distributed_initialized or jax.distributed.is_initialized():
+    if _distributed_initialized or _runtime_initialized():
         _distributed_initialized = True
         return True
     coord = coordinator_address or get_config("coordinator_address")
@@ -92,6 +103,43 @@ def init_distributed(
         return False
     _distributed_initialized = True
     return True
+
+
+def shutdown_distributed() -> bool:
+    """Tear down `jax.distributed` so a later `init_distributed` can
+    bootstrap fresh — the analog of the reference's NCCL comm
+    destroy/abort (cuml_context.py:163-180), which the fire-once module
+    global above otherwise makes impossible.  Idempotent: returns True
+    when a live runtime was shut down, False when there was nothing to
+    tear down (single-host mode, or already shut down)."""
+    global _distributed_initialized
+    was_live = False
+    if _runtime_initialized():
+        jax.distributed.shutdown()
+        was_live = True
+    _distributed_initialized = False
+    return was_live
+
+
+def reinit_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Re-bootstrap `jax.distributed` after a preemption: the preempted
+    worker's coordinator channel is dead, so `init_distributed`'s
+    idempotence (correct in the steady state) would silently hand back the
+    STALE runtime.  Shutdown first, then the normal resolution order.
+    Returns True when distributed mode came (back) up, False in
+    single-host mode.  The resilience layer's preemption hook
+    (resilience/retry.py) calls this before re-dispatching; iterative
+    solvers then resume from their checkpoint."""
+    shutdown_distributed()
+    return init_distributed(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 class TpuContext:

@@ -1,0 +1,63 @@
+#
+# resilience/ — the unified failure-handling layer every fit/transform
+# path routes through.  The reference stack survives executor loss because
+# Spark re-schedules barrier tasks (reference core.py:742-1013); this
+# single-controller JAX runtime has no scheduler above it, so the same
+# guarantees live here, in four pieces:
+#
+#   guard.py       guarded(fn, deadline, label): blocking device work under
+#                  a watchdog thread — a hang raises a typed
+#                  DispatchTimeout instead of blocking the controller
+#                  forever (the axon-tunnel hang class, TPU_STATUS_r05.md).
+#   retry.py       RetryPolicy: declarative max-attempts / exponential
+#                  backoff + jitter / error classifier.  One classifier
+#                  set subsumes the hand-rolled special cases: OOM ->
+#                  shrink batch (site-provided hook), transient
+#                  RPC/DEADLINE -> backoff + retry, preemption -> re-init
+#                  jax.distributed then resume.
+#   faults.py      deterministic fault injection at named dispatch sites,
+#                  so every recovery path is exercisable on CPU in CI.
+#   checkpoint.py  the estimator-wide checkpoint contract (content-tag
+#                  naming, atomic tmp + os.replace, rank-0 writer) lifted
+#                  out of streaming.py and shared by every iterative
+#                  solver loop.
+#
+# The layer imports neither jax nor numpy at module scope: arming faults
+# or reading a policy must not pay the multi-second jax import.
+#
+from .checkpoint import (  # noqa: F401
+    checkpoint_file_for,
+    clear_checkpoint,
+    load_checkpoint,
+    resolve_checkpoint_dir,
+    save_checkpoint,
+)
+from .faults import SimulatedPreemption, fault_inject, maybe_inject  # noqa: F401
+from .guard import DispatchTimeout, guarded  # noqa: F401
+from .retry import (  # noqa: F401
+    RetryPolicy,
+    classify_error,
+    is_oom,
+    is_preemption,
+    is_transient,
+    retry_call,
+)
+
+__all__ = [
+    "DispatchTimeout",
+    "RetryPolicy",
+    "SimulatedPreemption",
+    "checkpoint_file_for",
+    "classify_error",
+    "clear_checkpoint",
+    "fault_inject",
+    "guarded",
+    "is_oom",
+    "is_preemption",
+    "is_transient",
+    "load_checkpoint",
+    "maybe_inject",
+    "resolve_checkpoint_dir",
+    "retry_call",
+    "save_checkpoint",
+]

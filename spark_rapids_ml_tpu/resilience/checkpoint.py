@@ -1,0 +1,112 @@
+#
+# Estimator-wide checkpoint/resume — the contract lifted out of
+# streaming.py (which grew it for epoch-streaming fits) so EVERY iterative
+# solver loop shares it: content-tag filenames, atomic tmp + os.replace
+# writes, a rank-0-only writer, and an in-file tag check that refuses a
+# checkpoint belonging to a different fit.  Solvers wired today: the
+# host-dispatched KMeans Lloyd (ops/kmeans.py), host L-BFGS/OWL-QN
+# (ops/lbfgs.py — in-memory host-dispatch AND epoch-streaming), the FISTA
+# elastic-net loop (ops/linear.py), and the epoch-streaming Lloyd
+# (streaming.py).  Any estimator with the `checkpoint_dir` conf set
+# resumes after a crash instead of restarting at iteration 0.
+#
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from ..config import get_config
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+
+def resolve_checkpoint_dir(streaming: bool = False) -> str:
+    """The effective checkpoint directory; empty string = off.
+
+    The older `streaming_checkpoint_dir` alias applies ONLY to streaming
+    fits (`streaming=True`) — its documented scope.  In-memory fits read
+    just the estimator-wide `checkpoint_dir`: honoring the alias there
+    would silently reroute every small fit of an existing
+    streaming-checkpoint user onto the slower per-iteration host-dispatched
+    solvers (`checkpoint_dir` forces stepwise, see ops/kmeans.py
+    `kmeans_fit_auto`)."""
+    d = get_config("checkpoint_dir")
+    if not d and streaming:
+        d = get_config("streaming_checkpoint_dir")
+    return str(d or "")
+
+
+def checkpoint_file_for(ckpt_dir: str, tag: str) -> str:
+    """Deterministic checkpoint filename from the solver's content tag
+    (dataset identity, shape, hyperparams).  A preempted process RESTARTS
+    with fresh Python state, so the name must not depend on anything
+    per-process (estimator uid counters made a restarted fit silently
+    miss its checkpoint); the tag is identical across restarts of the
+    same fit by construction, and the in-file tag check still guards
+    against hash collisions/config drift."""
+    import hashlib
+
+    h = hashlib.sha1(tag.encode()).hexdigest()[:16]
+    kind = tag.split("|", 1)[0]
+    return os.path.join(ckpt_dir, f"{kind}-{h}.npz")
+
+
+def _is_writer() -> bool:
+    # multi-process pods run solver loops in lockstep on every process
+    # (the oracle all-reduces); only rank 0 writes the shared file to
+    # avoid concurrent savez/replace races
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def save_checkpoint(path: str, tag: str, state: Dict[str, object]) -> None:
+    """Atomically persist `state` ({name: array-like}) under `tag`.
+    Non-writer ranks no-op; the tmp + `os.replace` pair guarantees a
+    reader never observes a torn file."""
+    if not path or not _is_writer():
+        return
+    import numpy as np
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, tag=np.asarray(tag), **{k: np.asarray(v) for k, v in state.items()})
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str, tag: str) -> Optional[Dict[str, object]]:
+    """Load a checkpoint IF it exists and belongs to this fit.  A tag
+    mismatch (different dataset/hyperparams hashed to the same name, or
+    config drift) warns and returns None — the fit starts fresh rather
+    than resuming someone else's trajectory."""
+    if not path or not os.path.exists(path):
+        return None
+    import numpy as np
+
+    with np.load(path, allow_pickle=False) as z:
+        state = {k: z[k] for k in z.files}
+    if str(state.pop("tag", "")) != tag:
+        import warnings
+
+        warnings.warn(
+            f"Ignoring checkpoint {path}: it belongs to a different fit "
+            "(tag mismatch)"
+        )
+        return None
+    return state
+
+
+def clear_checkpoint(path: str) -> None:
+    """Remove a completed fit's checkpoint (writer rank only).  Missing
+    files are fine — a resumed fit that never re-saved has nothing to
+    clear."""
+    if not path or not _is_writer():
+        return
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass

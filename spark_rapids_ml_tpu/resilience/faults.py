@@ -1,0 +1,156 @@
+#
+# Deterministic fault injection — the test harness for every recovery
+# path.  Real OOM / tunnel-timeout / TPU-preemption faults only occur on
+# hardware under load; CI runs on a CPU mesh, so recovery code would
+# otherwise ship unexercised (the reference has the same gap: its barrier
+# re-schedule path is only exercised by live executor loss).  Dispatch
+# sites call `maybe_inject("<site>")`; tests (or the `fault_inject_spec`
+# conf for whole-process runs) arm a site with a fault kind and exact
+# occurrence counts, so each injected failure is reproducible down to the
+# iteration it fires on.
+#
+# Sites instrumented today: fit_kernel, transform_dispatch, stage_parquet,
+# kmeans_lloyd, lbfgs_iteration, linreg_fista.
+#
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Iterator, List
+
+from ..config import get_config
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+_lock = threading.Lock()
+
+
+class SimulatedPreemption(RuntimeError):
+    """An injected TPU-worker preemption (the str carries 'preempted' so
+    the retry classifier routes it like the real coordinator error)."""
+
+    def __init__(self, site: str) -> None:
+        super().__init__(
+            f"injected fault: TPU worker preempted at dispatch site '{site}'"
+        )
+        self.site = site
+
+
+class _Fault:
+    __slots__ = ("kind", "times", "skip", "seconds")
+
+    def __init__(self, kind: str, times: int, skip: int, seconds: float) -> None:
+        if kind not in ("oom", "timeout", "preemption", "hang"):
+            raise ValueError(f"unknown fault kind: {kind!r}")
+        self.kind = kind
+        self.times = int(times)
+        self.skip = int(skip)
+        self.seconds = float(seconds)
+
+
+# context-manager-armed faults (tests) and conf-armed faults
+# (`fault_inject_spec`, whole-process runs) are tracked separately so a
+# config re-parse never clobbers an active `fault_inject` block
+_armed: Dict[str, List[_Fault]] = {}
+_armed_conf: Dict[str, List[_Fault]] = {}
+_conf_spec_seen: str = ""
+
+
+@contextlib.contextmanager
+def fault_inject(
+    site: str,
+    kind: str,
+    times: int = 1,
+    skip: int = 0,
+    seconds: float = 5.0,
+) -> Iterator[None]:
+    """Arm `site` to fail deterministically while the block runs.
+
+    `skip` occurrences pass through first (inject mid-fit, e.g. after
+    three Lloyd iterations), then the next `times` occurrences fire.
+    Kinds: `oom` (a RESOURCE_EXHAUSTED RuntimeError), `timeout` (a typed
+    DispatchTimeout), `preemption` (SimulatedPreemption), `hang` (sleeps
+    `seconds` so the `guarded` watchdog fires — the only kind that needs
+    a positive `dispatch_deadline_s` to become an error).
+    """
+    f = _Fault(kind, times, skip, seconds)
+    with _lock:
+        _armed.setdefault(site, []).append(f)
+    try:
+        yield
+    finally:
+        with _lock:
+            faults = _armed.get(site, [])
+            if f in faults:
+                faults.remove(f)
+            if not faults:
+                _armed.pop(site, None)
+
+
+def _parse_spec(spec: str) -> Dict[str, List[_Fault]]:
+    """`"site:kind[:times[:skip]]"` comma list -> armed-fault table."""
+    out: Dict[str, List[_Fault]] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"fault_inject_spec entry {entry!r} is not "
+                "'site:kind[:times[:skip]]'"
+            )
+        site, kind = parts[0], parts[1]
+        times = int(parts[2]) if len(parts) > 2 else 1
+        skip = int(parts[3]) if len(parts) > 3 else 0
+        out.setdefault(site, []).append(_Fault(kind, times, skip, 5.0))
+    return out
+
+
+def _sync_conf_locked() -> None:
+    global _conf_spec_seen, _armed_conf
+    spec = str(get_config("fault_inject_spec") or "")
+    if spec == _conf_spec_seen:
+        return
+    _armed_conf = _parse_spec(spec)
+    _conf_spec_seen = spec
+
+
+def maybe_inject(site: str) -> None:
+    """Fire the armed fault for `site`, if any.  Called at every named
+    dispatch site; unarmed sites cost one dict lookup."""
+    with _lock:
+        _sync_conf_locked()
+        # one occurrence counts ONCE against every armed fault's skip
+        # window, and the first fault that is ready (skip drained, times
+        # left) fires — a fault still skipping must not suppress another
+        # fault armed at the same site
+        fault = None
+        for table in (_armed, _armed_conf):
+            for f in table.get(site, []):
+                if f.skip > 0:
+                    f.skip -= 1
+                elif fault is None and f.times > 0:
+                    f.times -= 1
+                    fault = f
+    if fault is None:
+        return
+    from ..tracing import event
+
+    event(f"fault_injected[{site}]", detail=fault.kind, log=logger)
+    if fault.kind == "oom":
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected OOM fault at dispatch site "
+            f"'{site}'"
+        )
+    if fault.kind == "timeout":
+        from .guard import DispatchTimeout
+
+        raise DispatchTimeout(site, fault.seconds)
+    if fault.kind == "preemption":
+        raise SimulatedPreemption(site)
+    # "hang": park inside the dispatch so the guarded watchdog fires; on
+    # its own (no deadline armed) this is just a stall, never an error
+    time.sleep(fault.seconds)

@@ -1,0 +1,208 @@
+#
+# Retry policies — declarative recovery for dispatch failures.  One
+# classifier set replaces the scattered hand-rolled handlers (the inline
+# `_is_oom` special case in core.py, the per-site halving loops): every
+# failure maps to an ACTION, and the action — not the call site — decides
+# the recovery:
+#
+#   oom         drop the poisoned buffers (site hook: shrink the batch /
+#               gc the staged arrays) and re-dispatch
+#   transient   RPC/DEADLINE/tunnel errors: exponential backoff + jitter,
+#               then re-dispatch
+#   preemption  a TPU worker went away: re-init `jax.distributed`
+#               (parallel/context.py `reinit_distributed`) and resume —
+#               iterative solvers pick their checkpoint back up
+#               (resilience/checkpoint.py)
+#   fatal       everything else propagates unchanged on the FIRST raise
+#
+from __future__ import annotations
+
+import gc
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple
+
+from ..config import get_config
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+
+def is_oom(e: BaseException) -> bool:
+    """XLA device-memory exhaustion (moved from core.py `_is_oom`)."""
+    s = str(e)
+    return (
+        "RESOURCE_EXHAUSTED" in s
+        or "Out of memory" in s
+        or "out of memory" in s
+    )
+
+
+def is_preemption(e: BaseException) -> bool:
+    """A TPU worker/coordinator went away mid-fit (maintenance event,
+    spot reclaim): the runtime must re-bootstrap before any retry."""
+    from .faults import SimulatedPreemption
+
+    if isinstance(e, SimulatedPreemption):
+        return True
+    s = str(e)
+    return (
+        "preempted" in s
+        or "PREEMPTED" in s
+        or "coordinator disconnected" in s
+        or "worker has been restarted" in s
+    )
+
+
+def is_transient(e: BaseException) -> bool:
+    """Retryable without state repair: tunnel/RPC deadline and
+    availability errors, including the guard's typed DispatchTimeout."""
+    from .guard import DispatchTimeout
+
+    if isinstance(e, DispatchTimeout):
+        return True
+    s = str(e)
+    return (
+        "DEADLINE_EXCEEDED" in s
+        or "UNAVAILABLE" in s
+        or "Socket closed" in s
+        or "RPC failed" in s
+        or "Connection reset" in s
+    )
+
+
+def classify_error(e: BaseException) -> str:
+    """Map an exception to its recovery action:
+    'preemption' | 'oom' | 'transient' | 'fatal'."""
+    if is_preemption(e):
+        return "preemption"
+    if is_oom(e):
+        return "oom"
+    if is_transient(e):
+        return "transient"
+    return "fatal"
+
+
+def _default_oom_hook() -> None:
+    # free the failed dispatch's temporaries before re-dispatching; the
+    # caller's staged inputs (deliberately still referenced) survive
+    gc.collect()
+
+
+def _default_preemption_hook() -> None:
+    # best-effort: on a single-controller process whose XLA backend is
+    # already live, re-bootstrapping jax.distributed may itself fail (the
+    # runtime only accepts distributed init before backend init on some
+    # versions).  The retry must then still run — a failed repair must
+    # surface the ORIGINAL preemption on the next attempt, not a
+    # confusing bootstrap error from inside the hook.
+    from ..parallel.context import reinit_distributed
+
+    try:
+        reinit_distributed()
+    except Exception as e:
+        logger.warning(
+            f"jax.distributed re-init after preemption failed ({e}); "
+            "retrying on the existing runtime"
+        )
+
+
+@dataclass
+class RetryPolicy:
+    """Declarative retry: total attempts, exponential backoff + jitter,
+    and the retryable-action set.  `classify` maps an exception to an
+    action name; actions outside `retryable` (and 'fatal') propagate."""
+
+    max_attempts: int = 3
+    backoff_s: float = 0.5
+    backoff_mult: float = 2.0
+    jitter: float = 0.25
+    classify: Callable[[BaseException], str] = classify_error
+    retryable: Tuple[str, ...] = ("oom", "transient", "preemption")
+    # OOM gets a TIGHTER budget than max_attempts: one gc'd re-dispatch
+    # recovers fragmentation/injected faults, but a dataset that genuinely
+    # exceeds HBM fails every attempt after minutes of device work each —
+    # the caller's fallback (e.g. _stage_or_stream's streamed-statistics
+    # path) must engage after a single repair attempt, not attempt N
+    oom_attempts: int = 1
+
+    @classmethod
+    def from_config(cls) -> "RetryPolicy":
+        return cls(
+            max_attempts=int(get_config("retry_max_attempts")),
+            backoff_s=float(get_config("retry_backoff_s")),
+            backoff_mult=float(get_config("retry_backoff_mult")),
+            jitter=float(get_config("retry_jitter")),
+        )
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before retry number `attempt` (1-based)."""
+        delay = self.backoff_s * self.backoff_mult ** (attempt - 1)
+        return delay * (1.0 + random.uniform(0.0, self.jitter))
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    label: str = "dispatch",
+    policy: Optional[RetryPolicy] = None,
+    log: Optional[object] = None,
+    on_oom: Optional[Callable[[], None]] = None,
+    on_preemption: Optional[Callable[[], None]] = None,
+) -> Any:
+    """Run `fn` under `policy` (default: `RetryPolicy.from_config()`).
+
+    Each recovery is surfaced as a `retry[label]` trace event.  `on_oom` /
+    `on_preemption` override the default repair hooks (gc-collect /
+    `reinit_distributed`).  Callers whose recovery mutates loop state the
+    policy cannot see (the transform chunk loop in core.py: chunk halving,
+    resume-row tracking across a pipelined pending dispatch) apply the
+    SAME policy — `RetryPolicy.from_config()`, `classify`, `backoff`, and
+    `_default_preemption_hook` — inline instead of through this wrapper,
+    so classification and attempt semantics never diverge.
+    """
+    if policy is None:
+        policy = RetryPolicy.from_config()
+    lg = log or logger
+    attempt = 1
+    oom_count = 0
+    while True:
+        action = None
+        err_desc = ""
+        try:
+            return fn()
+        except Exception as e:
+            action = policy.classify(e)
+            if (
+                action == "fatal"
+                or action not in policy.retryable
+                or attempt >= policy.max_attempts
+                or (action == "oom" and oom_count >= policy.oom_attempts)
+            ):
+                raise
+            err_desc = f"{type(e).__name__}: {e}"
+        # the retry runs OUTSIDE the except block: while handling, the
+        # interpreter's exception state pins the failed dispatch's frames
+        # via the traceback, whose locals reference the device buffers we
+        # are trying to free (the poisoned-buffer lesson recorded at
+        # core.py _stage_or_stream / BENCH_r05) — leaving the block pops
+        # the exception and releases them before the repair hook runs
+        from ..tracing import event
+
+        event(
+            f"retry[{label}]",
+            detail=f"attempt={attempt} action={action}",
+            log=lg,
+        )
+        lg.warning(
+            f"Dispatch '{label}' failed ({err_desc}); recovery={action}, "
+            f"attempt {attempt + 1}/{policy.max_attempts}"
+        )
+        if action == "oom":
+            oom_count += 1
+            (on_oom or _default_oom_hook)()
+        elif action == "preemption":
+            (on_preemption or _default_preemption_hook)()
+        else:  # transient
+            time.sleep(policy.backoff(attempt))
+        attempt += 1

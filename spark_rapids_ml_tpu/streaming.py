@@ -866,19 +866,10 @@ def _label_moments_scan(
     }
 
 
-def checkpoint_file_for(ckpt_dir: str, tag: str) -> str:
-    """Deterministic checkpoint filename from the solver's content tag
-    (dataset path, shape, hyperparams).  A preempted process RESTARTS
-    with fresh Python state, so the name must not depend on anything
-    per-process (estimator uid counters made a restarted fit silently
-    miss its checkpoint); the tag is identical across restarts of the
-    same fit by construction, and the in-file tag check still guards
-    against hash collisions/config drift."""
-    import hashlib
-
-    h = hashlib.sha1(tag.encode()).hexdigest()[:16]
-    kind = tag.split("|", 1)[0]
-    return os.path.join(ckpt_dir, f"{kind}-{h}.npz")
+# the checkpoint contract (content-tag naming, atomic tmp + os.replace,
+# rank-0 writer, in-file tag check) moved to resilience/checkpoint.py so
+# every iterative solver shares it; re-exported here for back-compat
+from .resilience.checkpoint import checkpoint_file_for  # noqa: F401, E402
 
 
 def logreg_streaming_fit(
@@ -1004,9 +995,12 @@ def logreg_streaming_fit(
         grad = np.asarray(agg["g"], np.float64) / wsum + l2 * beta
         return f, grad
 
+    # m (history) is shape-critical: the checkpointed S/Y buffers are
+    # (m, n), so a resume under a different memory size must tag-mismatch
     ckpt_tag = (
         f"logreg|{path}|n={scan['n_total']}|d={d}|C={n_classes}|"
-        f"l2={l2}|l1={l1}|int={fit_intercept}|std={standardization}"
+        f"l2={l2}|l1={l1}|int={fit_intercept}|std={standardization}|"
+        f"m={int(history)}|ls={int(ls_max)}"
     )
     if checkpoint_path is None and checkpoint_dir:
         checkpoint_path = checkpoint_file_for(checkpoint_dir, ckpt_tag)
@@ -1183,30 +1177,35 @@ def kmeans_streaming_fit(
         )
         return agg["sums"], agg["counts"], float(agg["cost"])
 
+    from .resilience import maybe_inject
+    from .resilience.checkpoint import (
+        clear_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
     ckpt_tag = f"kmeans|{path}|n={n_total}|d={d}|k={k}|seed={seed}"
     if checkpoint_path is None and checkpoint_dir:
         checkpoint_path = checkpoint_file_for(checkpoint_dir, ckpt_tag)
 
-    def save_ckpt(C_host, it) -> None:
-        if checkpoint_path and jax.process_index() == 0:
-            tmp = checkpoint_path + ".tmp.npz"
-            np.savez(tmp, tag=np.asarray(ckpt_tag), centers=C_host,
-                     it=np.asarray(it))
-            os.replace(tmp, checkpoint_path)
-
     C_host = np.asarray(jax.device_get(centers), np.float64)
     start_it = 0
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        with np.load(checkpoint_path, allow_pickle=False) as z:
-            if str(z["tag"]) == ckpt_tag:
-                C_host = np.asarray(z["centers"], np.float64)
-                start_it = int(z["it"])
-                logger.info(
-                    f"Resuming epoch-streaming kmeans at iteration {start_it}"
-                )
+    resumed = (
+        load_checkpoint(checkpoint_path, ckpt_tag) if checkpoint_path else None
+    )
+    if resumed is not None:
+        C_host = np.asarray(resumed["centers"], np.float64)
+        start_it = int(resumed["it"])
+        from .tracing import event
+
+        event("kmeans_resume", detail=f"it={start_it}", log=logger)
+        logger.info(
+            f"Resuming epoch-streaming kmeans at iteration {start_it}"
+        )
     n_iter = start_it
     cost = 0.0
     for n_iter in range(start_it + 1, max_iter + 1):
+        maybe_inject("kmeans_lloyd")
         sums, counts, cost = one_pass(C_host)
         new_C = np.where(
             counts[:, None] > 0,
@@ -1215,13 +1214,16 @@ def kmeans_streaming_fit(
         )
         shift2 = float(((new_C - C_host) ** 2).sum(axis=1).max())
         C_host = new_C
-        save_ckpt(C_host, n_iter)
+        if checkpoint_path:
+            save_checkpoint(
+                checkpoint_path, ckpt_tag, {"centers": C_host, "it": n_iter}
+            )
         if shift2 <= tol * tol:
             break
     # final cost under the final centers
     _, _, cost = one_pass(C_host)
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        os.remove(checkpoint_path)
+    if checkpoint_path:
+        clear_checkpoint(checkpoint_path)
     logger.info(
         f"Epoch-streaming kmeans: {n_iter} Lloyd passes over {n_total} rows"
     )

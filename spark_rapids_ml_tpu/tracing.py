@@ -19,7 +19,7 @@ import contextlib
 import threading
 import time
 from dataclasses import dataclass
-from typing import Iterator, List, Optional
+from typing import Callable, Iterator, List, Optional
 
 from .config import get_config
 from .utils import get_logger
@@ -38,6 +38,9 @@ class TraceEvent:
     name: str
     seconds: float
     depth: int
+    # instantaneous events (retries, injected faults, dispatch timeouts —
+    # resilience/) carry their context here; timed stages leave it empty
+    detail: str = ""
 
 
 def _records() -> List[TraceEvent]:
@@ -59,6 +62,24 @@ def get_trace_events() -> List[TraceEvent]:
     return list(_records())
 
 
+def adopt_trace_context() -> Callable[[], None]:
+    """Capture this thread's trace buffer and depth for adoption by a
+    worker thread (resilience/guard.py): the returned thunk, called on the
+    worker, makes its trace()/event() calls land in the CALLER's record
+    list.  Without this the watchdog thread's thread-local storage
+    swallows every event recorded inside a guarded dispatch.  list.append
+    is atomic under the GIL, so a caller reading while an abandoned worker
+    still appends is safe."""
+    rec = _records()
+    depth = getattr(_tls, "depth", 0)
+
+    def _adopt() -> None:
+        _tls.records = rec
+        _tls.depth = depth
+
+    return _adopt
+
+
 def reset_trace() -> None:
     _records().clear()
 
@@ -66,9 +87,23 @@ def reset_trace() -> None:
 def summarize() -> str:
     """Indented per-stage timing table for the recorded events."""
     lines = [
-        f"{'  ' * e.depth}{e.name}: {e.seconds:.4f}s" for e in _records()
+        f"{'  ' * e.depth}{e.name}: {e.seconds:.4f}s"
+        + (f" [{e.detail}]" if e.detail else "")
+        for e in _records()
     ]
     return "\n".join(lines)
+
+
+def event(name: str, detail: str = "", log: Optional[object] = None) -> None:
+    """Record an INSTANTANEOUS event (zero-duration TraceEvent) — failure/
+    recovery markers from the resilience layer: retries, injected faults,
+    dispatch timeouts, checkpoint resumes.  Always logged at `verbose >= 1`
+    like timed stages."""
+    depth = getattr(_tls, "depth", 0)
+    _append(TraceEvent(name, 0.0, depth, detail))
+    if int(get_config("verbose") or 0) >= 1:
+        suffix = f" [{detail}]" if detail else ""
+        (log or logger).info(f"[trace] {'  ' * depth}{name}{suffix}")
 
 
 @contextlib.contextmanager
