@@ -93,7 +93,8 @@ run_batch tests/test_common_estimator.py tests/test_metrics.py \
 run_batch tests/test_logistic_regression.py tests/test_sparse_logreg.py \
     tests/test_f32_and_weights.py tests/test_random_forest.py "$@"
 run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
-    tests/test_pallas_knn.py tests/test_sparse_fit.py "$@"
+    tests/test_pallas_knn.py tests/test_sparse_fit.py \
+    tests/test_staging_pipeline.py "$@"
 run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
@@ -124,6 +125,16 @@ echo "== fault-injection smoke: every recovery path on the CPU mesh =="
 # guard requires it there): this dedicated step keeps the recovery gate
 # visible and runnable in isolation even if the batches are resharded
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="
+# tier-1 marker-safe: byte-exact parity of the pipelined per-device
+# staging engine against the serial path on the 8-device CPU mesh, with
+# the producer thread ACTIVE (depth=2 pinned via the env override so a
+# changed default can never silently turn this into a serial-only run).
+# Also in a tier-1 batch above (the completeness guard requires it); this
+# dedicated step keeps the staging gate runnable in isolation.
+JAX_PLATFORMS=cpu SPARK_RAPIDS_ML_TPU_STAGING_PIPELINE_DEPTH=2 \
+    python -m pytest tests/test_staging_pipeline.py -q
 
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \

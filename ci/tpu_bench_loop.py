@@ -123,9 +123,17 @@ def run_bench(have_on_chip: bool) -> bool:
     result must then be discarded, never clobber it."""
     env = dict(os.environ)
     env["BENCH_WORKLOADS"] = WORKLOADS
+    # bench.py's own section budgeter: finish (skipping what doesn't fit)
+    # and emit complete JSON with rc=0 BEFORE the external killer fires —
+    # r05 lost the tail of the matrix to the rc=124 SIGTERM path
+    env.setdefault("BENCH_TOTAL_BUDGET", str(int(BENCH_TIMEOUT * 0.95)))
     if env.get("JAX_PLATFORMS") == "cpu":
         del env["JAX_PLATFORMS"]  # let bench probe the real backend
     out_path = os.path.join(REPO, f".bench_out_{int(time.time())}.txt")
+    # run-scoped salvage file next to the raw output: a SIGKILL past the
+    # budgeter still leaves every completed section's numbers on disk,
+    # and concurrent runs never clobber each other's
+    env.setdefault("BENCH_PARTIAL_PATH", out_path + ".partial.json")
     log(f"bench: starting full matrix (workloads={WORKLOADS}, "
         f"timeout={BENCH_TIMEOUT:.0f}s)")
     with open(out_path, "wb") as outf:

@@ -83,6 +83,48 @@ void pad_cast_f32_f64(const float* src, int64_t n, int64_t d, int64_t n_pad,
   }
 }
 
+// ---- strided row gather + cast -------------------------------------------
+// The fused interleave-permutation slice of the pipelined staging engine
+// (mesh.RowStager round-robin layout): device shard rows are src rows
+// start, start+step, ... — gathered and cast in one pass so the full-array
+// host permutation copy (`_to_layout`) is never materialized.
+
+void gather_strided_f64_f32(const double* src, int64_t start, int64_t step,
+                            int64_t count, int64_t d, float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < count; ++i) {
+    const double* in = src + (start + i * step) * d;
+    float* out = dst + i * d;
+    for (int64_t j = 0; j < d; ++j) out[j] = static_cast<float>(in[j]);
+  }
+}
+
+void gather_strided_f32_f32(const float* src, int64_t start, int64_t step,
+                            int64_t count, int64_t d, float* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < count; ++i)
+    std::memcpy(dst + i * d, src + (start + i * step) * d,
+                sizeof(float) * d);
+}
+
+void gather_strided_f64_f64(const double* src, int64_t start, int64_t step,
+                            int64_t count, int64_t d, double* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < count; ++i)
+    std::memcpy(dst + i * d, src + (start + i * step) * d,
+                sizeof(double) * d);
+}
+
+void gather_strided_f32_f64(const float* src, int64_t start, int64_t step,
+                            int64_t count, int64_t d, double* dst) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < count; ++i) {
+    const float* in = src + (start + i * step) * d;
+    double* out = dst + i * d;
+    for (int64_t j = 0; j < d; ++j) out[j] = static_cast<double>(in[j]);
+  }
+}
+
 // ---- object-column row packing -------------------------------------------
 // srcs: array of n row pointers (each a contiguous vector of length d).
 

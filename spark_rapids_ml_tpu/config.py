@@ -76,6 +76,20 @@ _DEFAULTS: Dict[str, Any] = {
     # device consumes the current one (streaming.iter_chunks_prefetch);
     # costs one extra chunk of host memory.
     "streaming_prefetch": True,
+    # Pipelined per-device staging engine (parallel/mesh.py): host rows
+    # are sliced per DEVICE SHARD and assembled with
+    # jax.make_array_from_single_device_arrays, so each byte travels to
+    # exactly one device (the serial chunked path's jitted global update
+    # let GSPMD replicate every chunk to all devices — n_dev x the
+    # minimal traffic).  `staging_chunk_bytes` bounds one host piece
+    # (also the unit of pipeline overlap); it is additionally clamped to
+    # the transfer-RPC ceiling (mesh._MAX_PUT_BYTES).
+    "staging_chunk_bytes": 256 * 1024 * 1024,
+    # How many prepared host pieces the staging pipeline may run ahead of
+    # the device transfers (pad/cast/densify on a host thread overlaps
+    # the in-flight device_put).  1 = serial fallback (no thread); each
+    # extra level of depth costs one staged chunk of host memory.
+    "staging_pipeline_depth": 2,
     # When set, epoch-streaming fits (hours-long at beyond-HBM scale)
     # write their full optimizer state here after every iteration and
     # RESUME the identical trajectory after a preemption/crash.
@@ -109,16 +123,18 @@ _DEFAULTS: Dict[str, Any] = {
     # whole-process runs (CI smoke, bench rehearsals).
     "fault_inject_spec": "",
     # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
-    # fusedL2Knn analog, ops/pallas_knn.py): "off" (default) keeps the XLA
-    # materialize-then-top_k kernels, "auto" enables it on real TPU
-    # backends, "on" forces it everywhere (CPU runs the Pallas
-    # interpreter — slow, for tests).  Default is "off" on measurement:
-    # on a v5e chip at 100k items x 10k queries x k=32 the VPU selection
-    # loop runs 3.5x slower than XLA's matmul+top_k pipeline (the
-    # hypothesis that the (q, n) HBM round-trip dominates was wrong —
-    # XLA's top-k sort is the actual bottleneck, and it beats a k-round
-    # VPU sweep).  BENCH_r03 records both numbers.
-    "pallas_knn": "off",
+    # fusedL2Knn analog, ops/pallas_knn.py): "auto" (default) MEASURES
+    # both kernels once per shape bucket on TPU backends and commits to
+    # the faster (ops/knn.py knn_topk_single — the same probe discipline
+    # as umap_kernel=auto; ties break to XLA, the platform prior), "off"
+    # forces the XLA materialize-then-top_k kernels, "on" forces the
+    # fused kernel everywhere (CPU runs the Pallas interpreter — slow,
+    # for tests).  Why measured, not assumed: on a v5e chip at 100k
+    # items x 10k queries x k=32 the fused kernel's VPU selection loop
+    # ran 3.5x SLOWER than XLA's matmul+top_k pipeline (BENCH_r03;
+    # knn_pallas_speedup 0.38x re-confirmed in BENCH_r05), so a
+    # blanket-on auto would pin every default fit to the slower kernel.
+    "pallas_knn": "auto",
     # MXU matmul precision for rank/threshold-critical distance kernels
     # (kNN/ANN/DBSCAN; ops/precision.py).  "highest" = exact f32 (cuML
     # parity; TPU default bf16 passes mis-rank near-tied neighbors —

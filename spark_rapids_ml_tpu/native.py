@@ -114,6 +114,10 @@ def _load() -> Optional[ctypes.CDLL]:
             "pack_rows_f64_f32": [pp, i64, i64, i64, f32p],
             "pack_rows_f32_f32": [pp, i64, i64, i64, f32p],
             "pack_rows_f64_f64": [pp, i64, i64, i64, f64p],
+            "gather_strided_f64_f32": [f64p, i64, i64, i64, i64, f32p],
+            "gather_strided_f32_f32": [f32p, i64, i64, i64, i64, f32p],
+            "gather_strided_f64_f64": [f64p, i64, i64, i64, i64, f64p],
+            "gather_strided_f32_f64": [f32p, i64, i64, i64, i64, f64p],
             "csr_densify_f32": [ctypes.POINTER(i64),
                                 ctypes.POINTER(ctypes.c_int32), f32p, i64,
                                 i64, i64, f32p],
@@ -185,6 +189,49 @@ def pad_cast(arr: np.ndarray, n_pad: int, dtype: np.dtype) -> np.ndarray:
     out = np.zeros((n_pad, d), dtype)
     out[:n] = arr
     return out
+
+
+def gather_rows_strided(
+    arr: np.ndarray, start: int, step: int, count: int, dtype: np.dtype
+) -> np.ndarray:
+    """Contiguous, dtype-cast copy of rows `arr[start + i*step]` for
+    i in [0, count) — the fused interleave-permutation slice of the
+    pipelined staging engine (mesh.RowStager round-robin layout),
+    parallelized when large.  `step=1` is the plain contiguous chunk
+    slice (still fusing the cast), so the engine has ONE producer
+    primitive for both layouts."""
+    dtype = np.dtype(dtype)
+    d = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim > 1 else 1
+    out_bytes = count * d * dtype.itemsize
+    lib = (
+        _parallel_lib()
+        if (out_bytes >= _MIN_NATIVE_BYTES or _FORCE_NATIVE)
+        else None
+    )
+    if (
+        lib is not None and arr.ndim == 2 and arr.flags.c_contiguous
+        and count > 0
+    ):
+        name = {
+            ("float64", "float32"): "gather_strided_f64_f32",
+            ("float32", "float32"): "gather_strided_f32_f32",
+            ("float64", "float64"): "gather_strided_f64_f64",
+            ("float32", "float64"): "gather_strided_f32_f64",
+        }.get((str(arr.dtype), str(dtype)))
+        if name is not None:
+            src_ct = (
+                ctypes.c_double if arr.dtype == np.float64 else ctypes.c_float
+            )
+            dst_ct = (
+                ctypes.c_float if dtype == np.float32 else ctypes.c_double
+            )
+            out = np.empty((count, d), dtype)
+            getattr(lib, name)(
+                _ptr(arr, src_ct), start, step, count, d, _ptr(out, dst_ct)
+            )
+            return out
+    stop = start + count * step
+    return np.ascontiguousarray(arr[start:stop:step], dtype=dtype)
 
 
 def pack_rows(rows: np.ndarray, n_pad: int, dtype: np.dtype) -> np.ndarray:
@@ -279,5 +326,5 @@ def densify_csr(csr, n_pad: int, dtype: np.dtype) -> np.ndarray:
 
 __all__ = [
     "NativeBuildTimeout", "available", "pad_cast", "pack_rows",
-    "densify_csr",
+    "densify_csr", "gather_rows_strided",
 ]

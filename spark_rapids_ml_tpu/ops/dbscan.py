@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
+from ..utils import pcast_compat, shard_map_compat
 
 # default per-device distance working-set BYTE budget (the models layer
 # overrides it from `max_mbytes_per_batch`); bounds the column-tile width
@@ -91,7 +92,7 @@ def _reduce_kernel(Xl, Xf, vf, labf, eps2, SENT, block):
         )
         return deg, cand
 
-    carry0 = jax.lax.pcast(
+    carry0 = pcast_compat(
         (jnp.zeros((m,), jnp.int32), jnp.full((m,), SENT, jnp.int32)),
         (DATA_AXIS,),
         to="varying",
@@ -128,7 +129,7 @@ def _dbscan_prep(X_sharded, Xf, vf, valid_sharded, min_samples, eps,
         labels0_l = jnp.where(core_l, local_idx, SENT)
         return labels0_l, core_l
 
-    shard = jax.shard_map(
+    shard = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P(DATA_AXIS)),
@@ -178,7 +179,7 @@ def _dbscan_sweep(
         row0 = jax.lax.axis_index(DATA_AXIS) * Xl.shape[0]
         return jax.lax.dynamic_slice(new, (row0,), (Xl.shape[0],)), changed
 
-    shard = jax.shard_map(
+    shard = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS),

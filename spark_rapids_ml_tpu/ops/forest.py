@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
+from ..utils import pcast_compat, shard_map_compat
 
 GINI, ENTROPY, VARIANCE = 0, 1, 2  # split criteria
 
@@ -141,7 +142,7 @@ def _grow_one_tree(
     kb, kf = jax.random.split(key)
     # pcast marks the rate as device-varying to match the varying key inside
     # jax.random's internal control flow under shard_map
-    rate = jax.lax.pcast(
+    rate = pcast_compat(
         jnp.asarray(subsample, jnp.float32), (DATA_AXIS,), to="varying"
     )
     if bootstrap:
@@ -330,7 +331,7 @@ def _forest_prep(X, y, valid, n_bins: int, criterion: int, n_classes: int,
         Xb = digitize(Xl, edges)
         return Xb, edges, statsl
 
-    shard = jax.shard_map(
+    shard = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
@@ -390,7 +391,7 @@ def _forest_fit_chunk(
         )
         return jax.vmap(lambda k: grow(k))(keys)
 
-    shard = jax.shard_map(
+    shard = shard_map_compat(
         kernel,
         mesh=mesh,
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),

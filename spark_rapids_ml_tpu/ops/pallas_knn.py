@@ -129,7 +129,7 @@ def fused_topk_sqdist(
         raise RuntimeError(
             "jax.experimental.pallas.tpu is unavailable in this JAX build; "
             "use the XLA kernels (config pallas_knn='off', or dispatch via "
-            "ops.knn.knn_topk_single which checks pallas_knn_enabled)"
+            "ops.knn.knn_topk_single which degrades to them automatically)"
         )
     q, d = queries.shape
     n = items.shape[0]
@@ -175,27 +175,15 @@ def fused_topk_sqdist(
     return d2[:q], outi[:q]
 
 
-def pallas_knn_enabled(d: int, dtype=None) -> bool:
-    """Dispatch predicate for the fused kernel: config `pallas_knn` is
-    "off" (default — XLA measured faster on chip), "auto" (TPU backends
-    only), or "on" (everywhere — CPU runs the
-    interpreter, for tests).  Very wide rows fall back (the
-    (bq + bn) x d tiles must fit VMEM next to the selection temps), and so
-    do non-f32 inputs: the kernel computes in f32, which would silently
-    change the f64 results the XLA path preserves under
-    float32_inputs=False."""
-    from ..config import get_config
-
-    mode = str(get_config("pallas_knn", "off")).lower()
-    if mode == "off" or not _HAS_PLTPU:
+def pallas_knn_eligible(d: int, dtype=None) -> bool:
+    """SHAPE/DTYPE eligibility for the fused kernel, independent of the
+    config mode: very wide rows fall back (the (bq + bn) x d tiles must
+    fit VMEM next to the selection temps), and so do non-f32 inputs — the
+    kernel computes in f32, which would silently change the f64 results
+    the XLA path preserves under float32_inputs=False."""
+    if not _HAS_PLTPU or d > 4096:
         return False
-    if d > 4096:
-        return False
-    if dtype is not None and jnp.dtype(dtype) != jnp.float32:
-        return False
-    if mode == "on":
-        return True
-    return jax.default_backend() == "tpu"
+    return dtype is None or jnp.dtype(dtype) == jnp.float32
 
 
 def knn_topk_fused(items, item_valid, item_ids, queries, k: int):

@@ -9,7 +9,8 @@
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple, Union
+import time
+from typing import Iterable, Iterator, Optional, Tuple, Union
 
 import jax
 import numpy as np
@@ -130,21 +131,18 @@ def _chunked_upload_fns(shape, dtype, out_shardings):
     return mk, upd
 
 
-def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None):
-    """The shared bounded-upload assembly loop: a zero device buffer of
+def assemble_rows_serial(shape, dtype, pieces, out_shardings=None):
+    """LEGACY bounded-upload assembly loop: a zero device buffer of
     `shape` (optionally sharded) receives host row-pieces via donated
     in-place dynamic_update_slice writes — compiles are cached per
     (shape, dtype, sharding).  `pieces` yields (row_offset, np_chunk).
-    Used by `_chunked_device_put` here and `data.assemble_dense_chunks`
-    (the CSR densify path), so the donation/out_shardings subtleties
-    live in exactly one place.
 
-    Note for future multi-device tunneled setups: each host piece enters
-    the jitted update unsharded, so GSPMD replicates it to every device
-    of a row-sharded target — n_dev x the minimal traffic.  On the
-    current targets (one real chip; local CPU meshes) the factor is 1 /
-    free; per-device slicing + make_array_from_single_device_arrays is
-    the upgrade path if a real multi-chip tunnel appears."""
+    Each host piece enters the jitted update unsharded, so GSPMD
+    replicates it to every device of a row-sharded target — n_dev x the
+    minimal traffic (the factor the pipelined per-device engine below
+    removes).  Kept as the fallback for shardings the per-device writer
+    cannot decompose, and as the parity/benchmark reference for the
+    engine (tests/test_staging_pipeline.py, bench.py `staging`)."""
     import jax.numpy as jnp
 
     dtype = np.dtype(dtype)
@@ -154,6 +152,287 @@ def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None):
     for lo, piece in pieces:
         buf = upd(buf, piece, jnp.asarray(lo, jnp.int32))
     return buf
+
+
+def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None,
+                          label: str = "assemble"):
+    """The shared bounded-upload assembly entry point (used by
+    `data.assemble_dense_chunks` — the CSR densify path): host row-pieces
+    land in a device buffer of `shape` (optionally sharded).  `pieces` yields (row_offset, np_chunk); the
+    chunk PREPARATION (densify/cast/slice) is expected to happen lazily
+    inside the iterator, because on the pipelined path the iterator runs
+    on a background host thread, overlapped with the device transfers
+    (`staging_pipeline_depth`).
+
+    Row-shardable targets at engine-worthy sizes route through the
+    per-device staging engine (`ShardedRowWriter`): each piece is split
+    at shard boundaries and transferred to exactly ONE device,
+    eliminating the GSPMD replication factor of the legacy jitted global
+    update (`assemble_rows_serial`).  Below `_PIPELINED_MIN_BYTES` the
+    per-device buffers + producer thread cost more than they save (the
+    same gate `RowStager.stage` applies), so small assemblies stay
+    serial."""
+    dtype = np.dtype(dtype)
+    ensure_x64(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if (
+        (_FORCE_PIPELINED or nbytes >= _PIPELINED_MIN_BYTES)
+        and _writer_devices(out_shardings, tuple(shape)) is not None
+    ):
+        writer = ShardedRowWriter(shape, dtype, out_shardings)
+        return run_staging_pipeline(
+            writer, ((None, lo, piece) for lo, piece in pieces), label=label
+        )
+    return assemble_rows_serial(shape, dtype, pieces,
+                                out_shardings=out_shardings)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined per-device staging engine
+# ---------------------------------------------------------------------------
+#
+# The serial staging path paid three avoidable costs on the hot
+# host->device edge (BENCH_r05: stage_mb_per_s 56.2; 220 s of the 413 s
+# refconfig PCA fit was staging):
+#
+#   1. `pad_cast` materialized a FULL padded host copy, then `_to_layout`
+#      materialized a SECOND full copy for the interleave permutation;
+#   2. `_chunked_device_put`'s jitted global dynamic_update_slice let
+#      GSPMD replicate every host chunk to ALL devices of a row-sharded
+#      target — n_dev x the minimal traffic;
+#   3. host prep (pad/cast/densify/decode) and the device transfer ran
+#      strictly serially.
+#
+# The engine below removes all three: host rows are sliced PER DEVICE
+# SHARD straight from the caller's array (the interleave permutation is
+# fused into a strided gather — no full-array copy ever exists), each
+# piece is `device_put` to exactly one device and written into a
+# per-device zeros buffer by a donated single-device update program, the
+# global array assembles via `jax.make_array_from_single_device_arrays`,
+# and a bounded background thread (`staging_pipeline_depth`) prepares the
+# next piece while the current one rides the wire.  Padding rows are
+# never transferred at all — the zeros buffers already hold them.
+
+# last staging-engine run: bytes, seconds, mb_per_s, host_prep_s,
+# device_put_s, overlap_ratio, pieces, depth, label (read by bench.py's
+# `staging` workload and the parity tests)
+STAGE_METRICS: dict = {}
+
+# tests: route even tiny arrays through the engine
+_FORCE_PIPELINED = False
+
+# below this, one plain device_put beats per-device assembly overheads
+_PIPELINED_MIN_BYTES = 4 * 1024 * 1024
+
+
+def _staging_chunk_rows(row_bytes: int) -> int:
+    """Rows per prepared host piece from the `staging_chunk_bytes` budget,
+    clamped to the transfer-RPC ceiling."""
+    from ..config import get_config
+
+    budget = min(int(get_config("staging_chunk_bytes")), _MAX_PUT_BYTES)
+    return max(1, budget // max(int(row_bytes), 1))
+
+
+def _staging_depth() -> int:
+    from ..config import get_config
+
+    return max(1, int(get_config("staging_pipeline_depth")))
+
+
+def _writer_devices(sharding, shape) -> Optional[list]:
+    """Device list, ordered by owned row range, for a target the
+    per-device writer can assemble: a single-process, row-sharded (or
+    unsharded) placement whose equal shards tile axis 0.  None means the
+    caller must use the serial path."""
+    if jax.process_count() != 1 or not shape or shape[0] <= 0:
+        return None
+    if sharding is None:
+        return [jax.devices()[0]]
+    try:
+        imap = sharding.devices_indices_map(tuple(shape))
+    except Exception:
+        return None
+    starts = {}
+    for dev, idx in imap.items():
+        # only axis-0 sharding: every other axis must be the full slice
+        for ax, sl in enumerate(idx[1:], start=1):
+            if (sl.start or 0) != 0 or (
+                sl.stop is not None and sl.stop != shape[ax]
+            ):
+                return None
+        lo = idx[0].start or 0
+        if lo in starts:  # replication over the row axis
+            return None
+        starts[lo] = dev
+    n_dev = len(starts)
+    if shape[0] % n_dev != 0:
+        return None
+    s = shape[0] // n_dev
+    if sorted(starts) != [i * s for i in range(n_dev)]:
+        return None
+    return [starts[i * s] for i in range(n_dev)]
+
+
+@functools.lru_cache(maxsize=256)
+def _shard_update_fns(shape, dtype_str, device):
+    """Jitted (zeros-maker, donated updater) pair committed to ONE
+    device: single-device programs see no GSPMD, so a host piece is
+    transferred to its target device and nowhere else."""
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    sds = SingleDeviceSharding(device)
+    dtype = np.dtype(dtype_str)
+    mk = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sds)
+    upd = jax.jit(_dus_rows, donate_argnums=0, out_shardings=sds)
+    return mk, upd
+
+
+class ShardedRowWriter:
+    """Per-device row staging: one zeros buffer per device shard receives
+    host pieces via donated single-device dynamic_update_slice programs;
+    `finish` assembles the global array with
+    `jax.make_array_from_single_device_arrays`.  Rows the caller never
+    writes stay zero (padding is not transferred).  Single-process only
+    (`_writer_devices` decides eligibility)."""
+
+    def __init__(self, shape, dtype, sharding=None) -> None:
+        self.shape = tuple(int(x) for x in shape)
+        self.dtype = np.dtype(dtype)
+        ensure_x64(self.dtype)
+        self.sharding = sharding
+        devices = _writer_devices(sharding, self.shape)
+        if devices is None:
+            raise ValueError(
+                "ShardedRowWriter requires a single-process row-sharded "
+                f"(or unsharded) target; got {sharding} for {self.shape}"
+            )
+        self._devices = devices
+        self._n_dev = len(devices)
+        self._s = self.shape[0] // self._n_dev
+        shard_shape = (self._s,) + self.shape[1:]
+        self._bufs = []
+        for dev in devices:
+            mk, _ = _shard_update_fns(shard_shape, self.dtype.str, dev)
+            self._bufs.append(mk())
+        self.bytes_written = 0
+        self.put_seconds = 0.0  # dispatch-side time (transfers are async)
+        self.pieces = 0
+
+    @property
+    def shard_rows(self) -> int:
+        return self._s
+
+    @property
+    def n_dev(self) -> int:
+        return self._n_dev
+
+    def write(self, lo: int, rows: np.ndarray) -> None:
+        """Write host `rows` at GLOBAL row offset `lo`, splitting at
+        device-shard boundaries (each split lands on exactly one
+        device)."""
+        n = int(rows.shape[0])
+        pos = 0
+        while pos < n:
+            g = lo + pos
+            d = g // self._s
+            take = min(n - pos, (d + 1) * self._s - g)
+            self.write_shard(d, g - d * self._s, rows[pos : pos + take])
+            pos += take
+
+    def write_shard(self, d: int, lo: int, rows: np.ndarray) -> None:
+        """Write host `rows` at offset `lo` WITHIN device `d`'s shard."""
+        import jax.numpy as jnp
+
+        dev = self._devices[d]
+        t0 = time.perf_counter()
+        piece = np.ascontiguousarray(rows, dtype=self.dtype)
+        pj = jax.device_put(piece, dev)
+        off = jax.device_put(np.asarray(lo, np.int32), dev)
+        _, upd = _shard_update_fns(
+            (self._s,) + self.shape[1:], self.dtype.str, dev
+        )
+        self._bufs[d] = upd(self._bufs[d], pj, off)
+        self.put_seconds += time.perf_counter() - t0
+        self.bytes_written += piece.nbytes
+        self.pieces += 1
+
+    def finish(self) -> "jax.Array":
+        if self.sharding is None:
+            out = self._bufs[0]
+        else:
+            out = jax.make_array_from_single_device_arrays(
+                self.shape, self.sharding, list(self._bufs)
+            )
+        self._bufs = []  # the writer must not pin the shard buffers
+        return out
+
+
+def run_staging_pipeline(
+    writer: ShardedRowWriter, producer: Iterable, label: str = "stage"
+) -> "jax.Array":
+    """Drive `producer` — an iterator of `(dev_or_None, lo, host_rows)`
+    whose per-item PREP work (slice/cast/densify) happens inside its
+    `__next__` — through `writer`, with the prep running `depth` items
+    ahead on a background thread (`staging_pipeline_depth`; depth 1 =
+    serial, no thread).  All jax calls stay on the calling thread.
+    Records throughput + overlap in `STAGE_METRICS` and as a trace
+    event."""
+    depth = _staging_depth()
+    t0 = time.perf_counter()
+    prep = {"s": 0.0}
+
+    def timed() -> Iterator:
+        it = iter(producer)
+        while True:
+            t = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            prep["s"] += time.perf_counter() - t
+            yield item
+
+    from ..utils import prefetch_iter
+
+    for dev, lo, rows in prefetch_iter(timed(), depth):
+        if dev is None:
+            writer.write(int(lo), rows)
+        else:
+            writer.write_shard(int(dev), int(lo), rows)
+    out = writer.finish()
+    wall = time.perf_counter() - t0
+    mb = writer.bytes_written / 1e6
+    busy = prep["s"] + writer.put_seconds
+    overlap = 0.0
+    if depth > 1 and min(prep["s"], writer.put_seconds) > 1e-9:
+        overlap = max(0.0, min(
+            (busy - wall) / min(prep["s"], writer.put_seconds), 1.0
+        ))
+    STAGE_METRICS.clear()
+    STAGE_METRICS.update(
+        label=label,
+        bytes=writer.bytes_written,
+        seconds=round(wall, 4),
+        mb_per_s=round(mb / max(wall, 1e-9), 1),
+        host_prep_s=round(prep["s"], 4),
+        device_put_s=round(writer.put_seconds, 4),
+        overlap_ratio=round(overlap, 4),
+        pieces=writer.pieces,
+        depth=depth,
+        n_dev=writer.n_dev,
+    )
+    from ..tracing import event
+
+    event(
+        f"stage_pipeline[{label}]",
+        detail=(
+            f"{mb:.1f}MB {STAGE_METRICS['mb_per_s']}MB/s "
+            f"overlap={overlap:.2f} pieces={writer.pieces} depth={depth}"
+        ),
+    )
+    return out
 
 
 def _chunked_device_get(arr) -> np.ndarray:
@@ -186,7 +465,14 @@ def _chunked_device_get(arr) -> np.ndarray:
 def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
     """device_put for arrays beyond _MAX_PUT_BYTES: bounded row pieces
     assembled on device instead of one transfer.  sharding=None targets
-    the default device."""
+    the default device.  Deliberately uses the LEGACY global-update loop
+    (`assemble_rows_serial`), never the per-device engine:
+    `RowStager._stage_serial` is the byte-parity/benchmark reference the
+    engine is measured against (routing it through the engine at large
+    sizes would make the 'serial' side of that comparison the engine
+    racing itself), and the other callers (ops/ivf.py, models/knn.py
+    index uploads) are unsharded default-device puts the per-device
+    writer could not improve."""
     ensure_x64(arr.dtype)
     if arr.nbytes <= _MAX_PUT_BYTES or arr.ndim == 0 or arr.shape[0] <= 1:
         if arr.nbytes > _MAX_PUT_BYTES:
@@ -207,8 +493,8 @@ def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
         (lo, np.ascontiguousarray(arr[lo : lo + chunk]))
         for lo in range(0, arr.shape[0], chunk)
     )
-    return assemble_rows_chunked(arr.shape, arr.dtype, pieces,
-                                 out_shardings=sharding)
+    return assemble_rows_serial(arr.shape, arr.dtype, pieces,
+                                out_shardings=sharding)
 
 
 class RowStager:
@@ -385,27 +671,87 @@ class RowStager:
             raise ValueError(
                 f"array has {arr.shape[0]} rows, stager expects {self.n_local}"
             )
-        if arr.shape[0] != self.local_padded or arr.dtype != dtype:
-            if arr.ndim == 2:
-                # single host copy fusing the dtype cast and the
-                # zero-padding; OpenMP-parallel via the native staging
-                # library when large
-                from ..native import pad_cast
-
-                padded = pad_cast(arr, self.local_padded, dtype)
-            else:
-                padded = np.zeros(
-                    (self.local_padded,) + arr.shape[1:], dtype
-                )
-                padded[: arr.shape[0]] = arr
-        else:
-            padded = arr
-        sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
+        sharding = NamedSharding(self.mesh, data_pspec(arr.ndim))
         if self.n_proc == 1:
-            return _chunked_device_put(self._to_layout(padded), sharding)
+            if (
+                _FORCE_PIPELINED or arr.nbytes >= _PIPELINED_MIN_BYTES
+            ) and _writer_devices(
+                sharding, (self.local_padded,) + arr.shape[1:]
+            ) is not None:
+                return self._stage_pipelined(arr, dtype, sharding)
+            return self._stage_serial(arr, dtype)
+        padded = self._pad_host(arr, dtype)
         return jax.make_array_from_process_local_data(
             sharding, padded, (self.n_padded,) + padded.shape[1:]
         )
+
+    def _pad_host(self, arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
+        """Zero-padded dtype-cast host copy in the ORIGINAL row order (the
+        serial path's first copy; also the multi-process block layout)."""
+        if arr.shape[0] == self.local_padded and arr.dtype == dtype:
+            return arr
+        if arr.ndim == 2:
+            # single host copy fusing the dtype cast and the zero-padding;
+            # OpenMP-parallel via the native staging library when large
+            from ..native import pad_cast
+
+            return pad_cast(arr, self.local_padded, dtype)
+        padded = np.zeros((self.local_padded,) + arr.shape[1:], dtype)
+        padded[: arr.shape[0]] = arr
+        return padded
+
+    def _stage_serial(self, arr: np.ndarray, dtype: np.dtype) -> jax.Array:
+        """LEGACY single-process staging: full padded host copy ->
+        interleave permutation copy -> (chunked) device_put.  Kept for
+        small arrays (one plain device_put beats per-device assembly
+        overheads), as the byte-parity reference for the pipelined
+        engine, and as the serial side of bench.py's `staging`
+        microbenchmark."""
+        padded = self._pad_host(arr, dtype)
+        sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
+        return _chunked_device_put(self._to_layout(padded), sharding)
+
+    def _stage_pipelined(
+        self, arr: np.ndarray, dtype: np.dtype, sharding
+    ) -> jax.Array:
+        """Pipelined per-device staging: each device shard's rows are
+        gathered straight from `arr` (the interleave permutation fused
+        into a strided slice — no full-array host copy), cast, and
+        written to exactly ONE device, with the next piece prepared on a
+        background thread while the current one transfers.  Padding rows
+        are never transferred (the shard buffers start zero).
+        Byte-identical to `_stage_serial` for every layout."""
+        from ..native import gather_rows_strided
+
+        writer = ShardedRowWriter(
+            (self.local_padded,) + arr.shape[1:], dtype, sharding
+        )
+        s = writer.shard_rows
+        n_dev = writer.n_dev
+        row_bytes = int(
+            np.prod(arr.shape[1:], dtype=np.int64)
+        ) * np.dtype(dtype).itemsize if arr.ndim > 1 else np.dtype(dtype).itemsize
+        chunk = _staging_chunk_rows(row_bytes)
+        interleave = self._interleave
+        n_local = self.n_local
+
+        def producer() -> Iterator:
+            for d_i in range(n_dev):
+                if interleave:
+                    # laid-out shard row p holds original row p*n_dev + d_i
+                    start, step = d_i, n_dev
+                    total = max(0, -(-(n_local - d_i) // n_dev))
+                else:
+                    start, step = d_i * s, 1
+                    total = min(max(n_local - d_i * s, 0), s)
+                for lo in range(0, total, chunk):
+                    cnt = min(chunk, total - lo)
+                    piece = gather_rows_strided(
+                        arr, start + lo * step, step, cnt, dtype
+                    )
+                    yield d_i, lo, piece
+
+        return run_staging_pipeline(writer, producer(), label="stage")
 
     def stage_sparse(
         self,

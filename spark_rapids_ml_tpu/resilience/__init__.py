@@ -39,6 +39,7 @@ from .retry import (  # noqa: F401
     classify_error,
     is_oom,
     is_preemption,
+    is_remote_compile_flake,
     is_transient,
     retry_call,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "guarded",
     "is_oom",
     "is_preemption",
+    "is_remote_compile_flake",
     "is_transient",
     "load_checkpoint",
     "maybe_inject",

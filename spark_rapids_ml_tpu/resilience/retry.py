@@ -55,12 +55,41 @@ def is_preemption(e: BaseException) -> bool:
     )
 
 
+def is_remote_compile_flake(e: BaseException) -> bool:
+    """Transient failure of the tunneled compile service itself: the
+    remote_compile RPC answering HTTP 5xx / resetting mid-flight
+    (`JaxRuntimeError: INTERNAL: ... remote_compile: HTTP 500` killed the
+    r05 UMAP bench on the FIRST dispatch of a fresh program).  These are
+    server-side flakes — the same program compiles fine seconds later —
+    so they classify as 'transient' (backoff + re-dispatch), NOT fatal.
+    A remote_compile failure that is the compiler rejecting the program
+    (HTTP 4xx, lowering errors) stays fatal: retrying a genuinely
+    uncompilable program would just burn the backoff budget.  Note the
+    match is on the flake MARKERS, never on the bare 'INTERNAL:' status
+    prefix — JaxRuntimeError stamps that prefix on deterministic
+    rejections too ('INTERNAL: ... remote_compile: HTTP 400'), which must
+    stay fatal."""
+    s = str(e)
+    if "remote_compile" not in s and "remote compile" not in s:
+        return False
+    return (
+        "HTTP 5" in s
+        or "UNAVAILABLE" in s
+        or "Connection reset" in s
+        or "Socket closed" in s
+        or "timed out" in s
+    )
+
+
 def is_transient(e: BaseException) -> bool:
     """Retryable without state repair: tunnel/RPC deadline and
-    availability errors, including the guard's typed DispatchTimeout."""
+    availability errors, including the guard's typed DispatchTimeout and
+    remote-compile service flakes."""
     from .guard import DispatchTimeout
 
     if isinstance(e, DispatchTimeout):
+        return True
+    if is_remote_compile_flake(e):
         return True
     s = str(e)
     return (

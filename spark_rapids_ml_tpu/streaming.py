@@ -319,48 +319,15 @@ def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
     owned chunks, so the queue holds up to two chunks of extra host
     memory and no copy is needed.  Disable via the `streaming_prefetch`
     conf."""
+    from .utils import prefetch_iter
+
     if not get_config("streaming_prefetch"):
         yield from iter_chunks(*args, **kwargs)
         return
-    import queue
-    import threading
-
-    q: "queue.Queue" = queue.Queue(maxsize=2)
-    _DONE = object()
-    stop = threading.Event()
-
-    def _put(item) -> bool:
-        # bounded puts so an abandoned consumer (exception/GC closes the
-        # generator) cannot pin the producer thread + chunk copies forever
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer() -> None:
-        try:
-            for item in iter_chunks(*args, **kwargs):
-                if not _put(item):
-                    return
-            _put(_DONE)
-        except BaseException as e:  # surface reader errors on the consumer
-            _put(e)
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _DONE:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
+    # depth=3: bounded queue of 2 owned chunks + the one in the reader's
+    # hand — the same extra-host-memory budget as before the shared
+    # helper (utils.prefetch_iter) absorbed this machinery
+    yield from prefetch_iter(iter_chunks(*args, **kwargs), depth=3)
 
 
 
@@ -450,7 +417,9 @@ def stage_parquet(
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
 
-    from .parallel.mesh import DATA_AXIS, ensure_x64
+    from .parallel.mesh import (
+        DATA_AXIS, ShardedRowWriter, _writer_devices, ensure_x64,
+    )
 
     ensure_x64(dtype)
     mesh = get_mesh(num_workers)
@@ -464,36 +433,61 @@ def stage_parquet(
     chunk_rows = -(-chunk_rows // n_dev) * n_dev
     n_padded = -(-n_total // chunk_rows) * chunk_rows
     ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
+    if label_col:
+        ensure_x64(ldt)
 
     row_spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
     mat_spec = NamedSharding(mesh, PartitionSpec(DATA_AXIS, None))
 
-    def _alloc():
-        return (
-            jnp.zeros((n_padded, d), dtype),
-            jnp.zeros((n_padded,), ldt) if label_col else None,
-            jnp.zeros((n_padded,), dtype),
+    # per-device staging engine (parallel/mesh.py): each decoded chunk is
+    # split at device-shard boundaries and transferred to exactly ONE
+    # device — the legacy jitted global fill let GSPMD replicate every
+    # chunk to all devices (n_dev x the minimal ingest traffic).  The
+    # parquet decode already runs one chunk ahead on the prefetch thread
+    # (iter_chunks_prefetch), so host prep overlaps the transfers here
+    # the same way the staging pipeline's producer thread does.
+    use_writer = _writer_devices(mat_spec, (n_padded, d)) is not None
+    if use_writer:
+        wX = ShardedRowWriter((n_padded, d), dtype, mat_spec)
+        wy = (
+            ShardedRowWriter((n_padded,), ldt, row_spec)
+            if label_col else None
         )
+        ww = ShardedRowWriter((n_padded,), dtype, row_spec)
+    else:  # legacy global-update path (non-decomposable placements)
+        def _alloc():
+            return (
+                jnp.zeros((n_padded, d), dtype),
+                jnp.zeros((n_padded,), ldt) if label_col else None,
+                jnp.zeros((n_padded,), dtype),
+            )
 
-    bufX, bufy, bufw = jax.jit(
-        _alloc,
-        out_shardings=(mat_spec, row_spec if label_col else None, row_spec),
-    )()
+        bufX, bufy, bufw = jax.jit(
+            _alloc,
+            out_shardings=(
+                mat_spec, row_spec if label_col else None, row_spec
+            ),
+        )()
 
-    def _fill(bX, bY, bW, cX, cY, cW, off):
-        # explicit int32 zero: a Python literal would trace as int64 when a
-        # prior fit enabled x64, and dus requires uniform index types
-        bX = jax.lax.dynamic_update_slice(bX, cX, (off, jnp.zeros((), jnp.int32)))
-        if bY is not None:
-            bY = jax.lax.dynamic_update_slice(bY, cY, (off,))
-        bW = jax.lax.dynamic_update_slice(bW, cW, (off,))
-        return bX, bY, bW
+        def _fill(bX, bY, bW, cX, cY, cW, off):
+            # explicit int32 zero: a Python literal would trace as int64
+            # when a prior fit enabled x64, and dus requires uniform
+            # index types
+            bX = jax.lax.dynamic_update_slice(
+                bX, cX, (off, jnp.zeros((), jnp.int32))
+            )
+            if bY is not None:
+                bY = jax.lax.dynamic_update_slice(bY, cY, (off,))
+            bW = jax.lax.dynamic_update_slice(bW, cW, (off,))
+            return bX, bY, bW
 
-    fill = jax.jit(
-        _fill,
-        donate_argnums=(0, 1, 2),
-        out_shardings=(mat_spec, row_spec if label_col else None, row_spec),
-    )
+        fill = jax.jit(
+            _fill,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(
+                mat_spec, row_spec if label_col else None, row_spec
+            ),
+        )
 
     off = 0
     n_chunks = 0
@@ -501,17 +495,34 @@ def stage_parquet(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype,
     ):
-        w_host = _weights_host(cw, n_c, chunk_rows, dtype)
-        cY = (
-            jnp.asarray(np.asarray(cy, ldt)) if label_col else None
-        )
-        bufX, bufy, bufw = fill(
-            bufX, bufy, bufw,
-            jnp.asarray(cX), cY, jnp.asarray(w_host),
-            jnp.asarray(off, jnp.int32),
-        )
+        if use_writer:
+            # only the valid rows travel: chunk tail padding (and the
+            # buffer tail) stays in the zeros the shard buffers started
+            # with, so a short final chunk transfers no padding bytes
+            wX.write(off, np.asarray(cX[:n_c], dtype))
+            if wy is not None:
+                wy.write(off, np.asarray(np.asarray(cy)[:n_c], ldt))
+            # sliced to the valid rows so tail padding never travels; the
+            # chunk_rows arg keeps _ONES_CACHE keyed to the one full-chunk
+            # size (a per-tail-size key would grow the cache unboundedly
+            # across fits)
+            ww.write(off, _weights_host(cw, n_c, chunk_rows, dtype)[:n_c])
+        else:
+            w_host = _weights_host(cw, n_c, chunk_rows, dtype)
+            cY = (
+                jnp.asarray(np.asarray(cy, ldt)) if label_col else None
+            )
+            bufX, bufy, bufw = fill(
+                bufX, bufy, bufw,
+                jnp.asarray(cX), cY, jnp.asarray(w_host),
+                jnp.asarray(off, jnp.int32),
+            )
         off += chunk_rows
         n_chunks += 1
+    if use_writer:
+        bufX = wX.finish()
+        bufy = wy.finish() if wy is not None else None
+        bufw = ww.finish()
     # block so the recorded staging time covers the actual host->device
     # transfer, not just async dispatch (on a tunneled chip these differ
     # by minutes)
@@ -521,8 +532,26 @@ def stage_parquet(
     LAST_STAGE.clear()
     LAST_STAGE.update(
         {"seconds": round(el, 2), "mb": round(mb, 1),
-         "mb_per_s": round(mb / max(el, 1e-9), 1)}
+         "mb_per_s": round(mb / max(el, 1e-9), 1),
+         "engine": "per-device" if use_writer else "global-update"}
     )
+    if use_writer:
+        # engine observability (mirrors mesh.STAGE_METRICS): actual bytes
+        # transferred (padding never travels) + dispatch-side put time
+        LAST_STAGE.update(
+            {"bytes_transferred": int(
+                wX.bytes_written + ww.bytes_written
+                + (wy.bytes_written if wy is not None else 0)
+             ),
+             "pieces": int(
+                wX.pieces + ww.pieces
+                + (wy.pieces if wy is not None else 0)
+             ),
+             "device_put_s": round(
+                wX.put_seconds + ww.put_seconds
+                + (wy.put_seconds if wy is not None else 0.0), 4
+             )}
+        )
     logger.info(
         f"Streamed {n_total} rows x {d} cols from {path} in {n_chunks} "
         f"chunks of {chunk_rows} rows onto {mesh} "

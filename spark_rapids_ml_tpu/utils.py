@@ -125,6 +125,95 @@ class _ArrayBatch:
     row_id: Optional[np.ndarray] = None
 
 
+def prefetch_iter(it, depth: int):
+    """Run iterator `it` on a daemon thread up to `depth` items ahead of
+    the consumer (bounded queue of depth-1 + the one in the producer's
+    hand) — the shared overlap primitive behind
+    `streaming.iter_chunks_prefetch` (parquet decode ahead of the device)
+    and the staging pipeline's producer (`mesh.run_staging_pipeline`).
+    Bounded puts so an abandoned consumer (exception/GC closes the
+    generator) cannot pin the producer thread + item copies forever;
+    producer exceptions re-raise on the consumer.  depth <= 1: plain
+    iteration, no thread."""
+    if depth <= 1:
+        yield from it
+        return
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth - 1)
+    _DONE = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+            _put(_DONE)
+        except BaseException as e:  # surface producer errors on the consumer
+            _put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def shard_map_compat(*args: Any, **kwargs: Any):
+    """`jax.shard_map`, version-tolerant: the API moved from
+    `jax.experimental.shard_map.shard_map` to the top level (jax >= 0.6);
+    older runtimes (0.4.x pins of the tunnel image) only have the
+    experimental path.  One accessor so every shard_map kernel runs on
+    both.
+
+    The experimental fallback gets `check_rep=False`: our kernels are
+    written for the NEW typed-varying discipline (explicit `pcast` where
+    a carry becomes device-varying — `pcast_compat`), which the old
+    checker cannot see; it also has no replication rule at all for
+    control-flow primitives the kernels rely on (`jax.random` internals
+    under while_loop).  The check is a static safety lint, not part of
+    the computation — out_specs still shape the outputs identically."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn  # type: ignore
+
+        kwargs.setdefault("check_rep", False)
+    return fn(*args, **kwargs)
+
+
+def pcast_compat(x: Any, axes: Any, to: str = "varying") -> Any:
+    """`jax.lax.pcast`, version-tolerant: the replicated->varying cast
+    exists only on runtimes with typed shard_map (jax >= 0.6 / the tunnel
+    image).  Older shard_map (0.4.x) has no varying-type checking, so the
+    cast is semantically a no-op there — return the operand unchanged."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)
+
+
 def host_load_metadata() -> dict:
     """Self-describing-artifact host metadata (bench/rehearsal/ANN JSON):
     loadavg, cpu count, and a `contended` flag meaning FOREIGN load —

@@ -105,7 +105,7 @@ def test_gen_data_distributed_kinds(tmp_path):
         assert t.num_rows == 300, kind
 
 
-def test_pod_launcher_two_process(tmp_path):
+def test_pod_launcher_two_process(tmp_path, require_multiprocess_cpu):
     # the pod benchmark launcher (benchmark/pod/launch.py) must run a
     # registered workload across 2 jax.distributed processes and write
     # rank 0's CSV report
@@ -187,7 +187,40 @@ def test_bench_isolated_supervisor(tmp_path):
     assert result["value"] > 0  # the logreg child's headline merged
 
 
-def test_rehearsal_pod_phase_smoke(tmp_path):
+def test_bench_total_budget_skips_and_exits_zero(tmp_path):
+    """BENCH_r05 overran its external budget (rc=124, half the matrix
+    lost): with BENCH_TOTAL_BUDGET set, bench.py must skip sections that
+    no longer fit, still emit ONE valid JSON line recording every skip,
+    exit 0, and leave the partial-JSON flush file behind."""
+    import json
+    import subprocess
+    import sys
+
+    partial = str(tmp_path / "partial.json")
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_WORKLOADS="pca,kmeans",
+        BENCH_ROWS="5000", BENCH_COLS="16",
+        BENCH_TOTAL_BUDGET="5",  # < one section: everything skips
+        BENCH_PARTIAL_PATH=partial,
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines() if ln.strip()][-1]
+    result = json.loads(line)
+    extra = result["extra"]
+    assert extra.get("total_budget_s") == 5.0
+    for name in ("pca", "kmeans", "logreg"):
+        assert "budget exhausted" in extra.get(f"{name}_error", ""), name
+    with open(partial) as f:
+        flushed = json.load(f)
+    assert "pca_error" in flushed["extra"]
+
+
+def test_rehearsal_pod_phase_smoke(tmp_path, require_multiprocess_cpu):
     """benchmark/rehearsal_100m.py's 2-process pod phase at toy scale
     (VERDICT r4 item 4): 2-process streaming fit must match the
     1-process run over the same device count, survive a whole-pod

@@ -210,7 +210,9 @@ def _run_workers(nproc: int, tmp_path, timeout: int = 900) -> dict:
         return json.load(f)
 
 
-def test_two_process_fit_matches_single_process(tmp_path):
+def test_two_process_fit_matches_single_process(
+    tmp_path, require_multiprocess_cpu
+):
     """2 processes x 2 devices vs 1 process x 4 devices: same 4-way mesh,
     same global data split per-process -> same LogReg/KMeans/PCA models."""
     single = _run_workers(1, tmp_path)

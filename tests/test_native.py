@@ -40,6 +40,29 @@ def test_pad_cast_matches_numpy(force_native, rng):
         np.testing.assert_array_equal(got, want)
 
 
+def test_gather_rows_strided_matches_numpy(force_native, rng):
+    """The fused interleave-permutation slice of the staging engine: the
+    native kernel must match the numpy strided slice + cast exactly, for
+    both the round-robin (step=n_dev) and contiguous (step=1) layouts.
+    (A missing `d` argument in the ctypes call shipped once — caught only
+    at >= _MIN_NATIVE_BYTES piece sizes, which is why this runs forced.)"""
+    for src_dt, dst_dt in [
+        (np.float64, np.float32), (np.float32, np.float32),
+        (np.float64, np.float64), (np.float32, np.float64),
+    ]:
+        arr = rng.normal(size=(101, 7)).astype(src_dt)
+        for start, step, count in [(3, 8, 12), (0, 1, 101), (40, 1, 30),
+                                   (6, 8, 0)]:
+            got = native.gather_rows_strided(
+                arr, start, step, count, np.dtype(dst_dt)
+            )
+            want = np.ascontiguousarray(
+                arr[start : start + count * step : step], dtype=dst_dt
+            )
+            assert got.dtype == np.dtype(dst_dt)
+            np.testing.assert_array_equal(got, want)
+
+
 def test_pack_rows_matches_stack(force_native, rng):
     for src_dt, dst_dt in [
         (np.float64, np.float32), (np.float32, np.float32),

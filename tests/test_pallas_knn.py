@@ -15,7 +15,7 @@ from spark_rapids_ml_tpu.ops.knn import knn_topk_blocked
 from spark_rapids_ml_tpu.ops.pallas_knn import (
     fused_topk_sqdist,
     knn_topk_fused,
-    pallas_knn_enabled,
+    pallas_knn_eligible,
 )
 
 
@@ -84,20 +84,135 @@ def test_fused_global_id_mapping():
     np.testing.assert_allclose(np.asarray(d2)[:, 0], 0.0, atol=1e-4)
 
 
-def test_dispatch_flag():
-    # default "off": XLA measured faster on the chip (BENCH_r03)
-    assert not pallas_knn_enabled(64)
+def test_eligibility_guards():
+    """Shape/dtype guards the dispatch (knn_topk_single) applies before
+    any mode/probe logic: the fused kernel may never see rows too wide
+    for VMEM or f64 inputs (it computes in f32, which would silently
+    change the results the XLA path preserves)."""
+    assert pallas_knn_eligible(64)
+    assert not pallas_knn_eligible(8192)  # VMEM guard
+    assert pallas_knn_eligible(64, np.float32)
+    assert not pallas_knn_eligible(64, np.float64)
+
+
+def test_measured_auto_decision(monkeypatch):
+    """pallas_knn=auto on a probe backend measures both kernels once per
+    shape bucket, commits to the faster (the 0.38x BENCH_r05 regression
+    class: auto must never pin a fit to the slower kernel), and reuses
+    the cached verdict without re-probing."""
+    from spark_rapids_ml_tpu.ops import knn as knn_mod
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_single
+
+    monkeypatch.setattr(knn_mod, "_AUTO_PROBE_BACKENDS",
+                        (jax.default_backend(),))
+    knn_mod._KERNEL_DECISION_CACHE.clear()
     set_config(pallas_knn="auto")
-    assert pallas_knn_enabled(64) == (jax.default_backend() == "tpu")
-    set_config(pallas_knn="on")
-    assert pallas_knn_enabled(64)
-    assert not pallas_knn_enabled(8192)  # VMEM guard regardless of mode
-    # f64 inputs (float32_inputs=False) must keep the XLA path: the fused
-    # kernel computes in f32 and would silently change results
-    assert pallas_knn_enabled(64, np.float32)
-    assert not pallas_knn_enabled(64, np.float64)
-    set_config(pallas_knn="off")
-    assert not pallas_knn_enabled(64)
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(96, 8)).astype(np.float32)
+    Q = rng.normal(size=(16, 8)).astype(np.float32)
+    valid = np.ones(96, np.float32)
+    ids = np.arange(96, dtype=np.int32)
+    args = (jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+            jnp.asarray(Q))
+    d2, i = knn_topk_single(*args, k=4)
+    dec = dict(knn_mod.LAST_KERNEL_DECISION)
+    assert dec["decided_by"] in (
+        "measured", "measured-tie-platform-prior", "pallas-error"
+    )
+    assert dec["kernel"] in ("xla", "pallas")
+    assert dec["warm_sec_xla"] is not None
+    # probe results are REAL results: exact match with the XLA kernel
+    d2r, ir = knn_topk_blocked(*args, k=4)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(d2r), atol=1e-4)
+    assert (np.asarray(i) == np.asarray(ir)).mean() > 0.99
+    # second call at the same shape bucket: cached verdict, no re-probe
+    knn_topk_single(*args, k=4)
+    assert knn_mod.LAST_KERNEL_DECISION["decided_by"] == "measured-cached"
+
+
+def test_measured_auto_decision_sliced_probe(monkeypatch):
+    """Query sets past the probe bound measure on a `_QUERY_BLOCK` slice
+    (bounded probe cost), then dispatch the winner over the FULL query
+    set — results must match the straight XLA kernel exactly."""
+    from spark_rapids_ml_tpu.ops import knn as knn_mod
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_single
+
+    monkeypatch.setattr(knn_mod, "_AUTO_PROBE_BACKENDS",
+                        (jax.default_backend(),))
+    monkeypatch.setattr(knn_mod, "_QUERY_BLOCK", 8)
+    knn_mod._KERNEL_DECISION_CACHE.clear()
+    set_config(pallas_knn="auto")
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(80, 8)).astype(np.float32)
+    Q = rng.normal(size=(32, 8)).astype(np.float32)  # > the probe bound
+    valid = np.ones(80, np.float32)
+    ids = np.arange(80, dtype=np.int32)
+    args = (jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+            jnp.asarray(Q))
+    d2, i = knn_topk_single(*args, k=4)
+    assert d2.shape == (32, 4)  # full queries answered, not the slice
+    dec = dict(knn_mod.LAST_KERNEL_DECISION)
+    assert dec["decided_by"] in (
+        "measured", "measured-tie-platform-prior", "pallas-error"
+    )
+    d2r, ir = knn_topk_blocked(*args, k=4)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(d2r), atol=1e-4)
+    assert (np.asarray(i) == np.asarray(ir)).mean() > 0.99
+
+
+def test_fused_runtime_failure_invalidates_cached_verdict(monkeypatch):
+    """A cached use_pallas=True verdict (won on the bounded probe slice)
+    must be overwritten when the full-shape fused dispatch fails — else
+    every later call in the bucket re-pays the failed Mosaic compile
+    before falling back."""
+    from spark_rapids_ml_tpu.ops import knn as knn_mod
+    from spark_rapids_ml_tpu.ops import pallas_knn as pk
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_single
+
+    monkeypatch.setattr(knn_mod, "_AUTO_PROBE_BACKENDS",
+                        (jax.default_backend(),))
+    knn_mod._KERNEL_DECISION_CACHE.clear()
+    set_config(pallas_knn="auto")
+    rng = np.random.default_rng(14)
+    X = rng.normal(size=(64, 8)).astype(np.float32)
+    Q = rng.normal(size=(16, 8)).astype(np.float32)
+    valid = np.ones(64, np.float32)
+    ids = np.arange(64, dtype=np.int32)
+    key = knn_mod._decision_key(X, Q, 3)
+    knn_mod._KERNEL_DECISION_CACHE[key] = True  # probe said pallas
+
+    def boom(*a, **kw):
+        raise RuntimeError("Mosaic lowering failed at the full shape")
+
+    monkeypatch.setattr(pk, "knn_topk_fused", boom)
+    args = (jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+            jnp.asarray(Q))
+    d2, i = knn_topk_single(*args, k=3)  # must not raise
+    assert knn_mod._KERNEL_DECISION_CACHE[key] is False
+    assert knn_mod.LAST_KERNEL_DECISION["decided_by"] == "pallas-fallback"
+    d2r, ir = knn_topk_blocked(*args, k=3)
+    np.testing.assert_allclose(np.asarray(d2), np.asarray(d2r), atol=1e-5)
+    assert np.array_equal(np.asarray(i), np.asarray(ir))
+
+
+def test_auto_off_probe_backend_keeps_xla(monkeypatch):
+    """auto on a NON-probe backend (the CPU default) never runs the
+    interpreter probe — the XLA kernel dispatches outright."""
+    from spark_rapids_ml_tpu.ops import knn as knn_mod
+    from spark_rapids_ml_tpu.ops.knn import knn_topk_single
+
+    monkeypatch.setattr(knn_mod, "_AUTO_PROBE_BACKENDS", ())
+    knn_mod._KERNEL_DECISION_CACHE.clear()
+    set_config(pallas_knn="auto")
+    rng = np.random.default_rng(12)
+    X = rng.normal(size=(64, 6)).astype(np.float32)
+    valid = np.ones(64, np.float32)
+    ids = np.arange(64, dtype=np.int32)
+    knn_topk_single(jnp.asarray(X), jnp.asarray(valid), jnp.asarray(ids),
+                    jnp.asarray(X[:8]), k=3)
+    assert knn_mod.LAST_KERNEL_DECISION["kernel"] == "xla"
+    assert knn_mod.LAST_KERNEL_DECISION["decided_by"] == "config"
+    assert not knn_mod._KERNEL_DECISION_CACHE
 
 
 def test_exact_knn_end_to_end_parity():
