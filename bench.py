@@ -46,19 +46,20 @@ WORKLOADS = [
     w.strip()
     for w in os.environ.get(
         "BENCH_WORKLOADS",
-        "logreg,pca,kmeans,ann,knn,umap,dbscan,staging,streaming,"
-        "refconfig,rf",
+        "logreg,pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
+        "streaming,refconfig,rf",
     ).split(",")
 ]
 
-# the staging microbenchmark compares per-device pipelined staging against
-# the serial path ACROSS devices — on a CPU-pinned run give it the 8-way
-# virtual mesh the test suite uses.  Only when staging is the sole
-# workload in this process (the supervisor's per-workload child, or an
-# explicit BENCH_WORKLOADS=staging run): forcing virtual devices under
-# every other cpu workload would change their numbers.
+# the staging and cv_cached microbenchmarks compare against work spread
+# ACROSS devices — on a CPU-pinned run give them the 8-way virtual mesh
+# the test suite uses.  Only when they are the sole workloads in this
+# process (the supervisor's per-workload child, or an explicit
+# BENCH_WORKLOADS= run): forcing virtual devices under every other cpu
+# workload would change their numbers.
 if (
-    WORKLOADS == ["staging"]
+    WORKLOADS
+    and all(w in ("staging", "cv_cached") for w in WORKLOADS)
     and os.environ.get("JAX_PLATFORMS", "") == "cpu"
     and "xla_force_host_platform_device_count"
     not in os.environ.get("XLA_FLAGS", "")
@@ -776,6 +777,106 @@ def bench_staging(extra: dict):
     # (`staging_pipelined_mb_per_s`), a different quantity
 
 
+def bench_cv_cached(extra: dict):
+    """Device-resident dataset cache (parallel/device_cache.py): a
+    k-fold CrossValidator run on the stage-once cached driver vs the
+    legacy per-fold host-slicing path.  The headline numbers are
+    host->device dataset stagings per CV run (2k+1-class -> 1 with
+    `device_cache=on`) and the wall-clock win; a warm (cache-hit) run
+    shows the repeated-tuning case paying ZERO stagings."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.config import get_config, set_config
+    from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+    from spark_rapids_ml_tpu.parallel.device_cache import (
+        CACHE_METRICS,
+        clear_device_cache,
+    )
+    from spark_rapids_ml_tpu.parallel.mesh import STAGE_COUNTS
+    from spark_rapids_ml_tpu.regression import LinearRegression
+    from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+    import jax
+
+    n = int(os.environ.get("BENCH_CV_ROWS", 400_000))
+    if jax.default_backend() == "cpu" and "BENCH_CV_ROWS" not in os.environ:
+        n = 150_000
+    d, k = 64, 3
+    rng = _rng(17)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    y = (X @ rng.standard_normal((d,)).astype(np.float32)
+         + 0.1 * rng.standard_normal(n).astype(np.float32))
+    df = pd.DataFrame({"features": list(X), "label": y})
+    extra["cv_cached_config"] = f"{n}x{d} f32, k={k}, LinearRegression grid=3"
+
+    def build_cv():
+        lr = LinearRegression()
+        grid = (
+            ParamGridBuilder()
+            .addGrid(lr.regParam, [0.0, 0.1, 1.0])
+            .build()
+        )
+        return CrossValidator(
+            estimator=lr, estimatorParamMaps=grid,
+            evaluator=RegressionEvaluator(metricName="rmse"),
+            numFolds=k, seed=11,
+        )
+
+    def timed_run():
+        cv = build_cv()
+        s0 = STAGE_COUNTS["dataset_stagings"]
+        t0 = time.perf_counter()
+        model = cv.fit(df)
+        return (
+            time.perf_counter() - t0,
+            STAGE_COUNTS["dataset_stagings"] - s0,
+            cv._last_fit_used_cache,
+            model,
+        )
+
+    # CV fold shapes are exactly what shape bucketing exists for (bench
+    # main pins it off for solver-timing honesty); both paths run with it
+    prev_bucketing = get_config("shape_bucketing")
+    prev_cache = get_config("device_cache")
+    try:
+        set_config(shape_bucketing=True)
+
+        set_config(device_cache="off")
+        timed_run()  # compile warmup for the legacy shapes
+        legacy_sec, legacy_stagings, used, m_legacy = timed_run()
+        assert not used
+        extra["cv_legacy_fit_sec"] = round(legacy_sec, 3)
+        extra["cv_legacy_stagings_per_run"] = int(legacy_stagings)
+
+        set_config(device_cache="on")
+        clear_device_cache()
+        h0 = CACHE_METRICS["hits"]
+        cold_sec, cold_stagings, used, m_cached = timed_run()
+        assert used, "cached CV driver did not engage"
+        extra["cv_cached_cold_fit_sec"] = round(cold_sec, 3)
+        extra["cv_cached_stagings_per_run"] = int(cold_stagings)
+        warm_sec, warm_stagings, _, _ = timed_run()  # cache hit
+        extra["cv_cached_warm_fit_sec"] = round(warm_sec, 3)
+        extra["cv_cached_warm_stagings_per_run"] = int(warm_stagings)
+        extra["cv_cached_hits"] = int(CACHE_METRICS["hits"] - h0)
+        extra["cv_cached_speedup_x"] = round(
+            legacy_sec / max(cold_sec, 1e-9), 2
+        )
+        extra["cv_cached_warm_speedup_x"] = round(
+            legacy_sec / max(warm_sec, 1e-9), 2
+        )
+        extra["cv_cached_metric_parity"] = bool(
+            np.allclose(m_legacy.avgMetrics, m_cached.avgMetrics, rtol=1e-3)
+            and m_legacy.bestIndex == m_cached.bestIndex
+        )
+    finally:
+        # in the finally: a failed run must not leave ~37 MiB resident,
+        # inflating every later section's _over_device_budget estimates
+        clear_device_cache()
+        set_config(shape_bucketing=prev_bucketing, device_cache=prev_cache)
+
+
 _state = {"rows_per_sec": 0.0, "vs_baseline": 0.0, "extra": {}, "printed": False}
 
 # total wall budget (BENCH_TOTAL_BUDGET seconds; 0 = unlimited): sections
@@ -944,6 +1045,16 @@ def _is_cpu_label(platform: str) -> bool:
     return platform.split(" ")[0].startswith("cpu")
 
 
+def _on_chip_label(platform: str) -> bool:
+    """POSITIVE evidence of an on-chip backend in an artifact platform
+    label: probed (not '(unprobed)') and not a cpu label.  Used for the
+    initial probe-derived verdict AND re-derived after every child merge
+    — an '(unprobed)' supervisor that adopts a child's tpu label must
+    start discarding later cpu-fallback children instead of merging
+    their numbers into a tpu-labeled artifact."""
+    return "(unprobed)" not in platform and not _is_cpu_label(platform)
+
+
 def _merge_child_line(
     extra: dict, out_path: str, name: str, on_chip_verified: bool
 ) -> bool:
@@ -1009,9 +1120,7 @@ def _run_isolated(order, platform_label: str, probe_mbps, on_cpu: bool):
     # discard-on-fallback needs POSITIVE evidence of a chip: an
     # "(unprobed)" axon label must not discard children's honest cpu
     # results (they relabel the artifact via the merge instead)
-    on_chip_verified = (
-        "(unprobed)" not in platform_label and not _is_cpu_label(platform_label)
-    )
+    on_chip_verified = _on_chip_label(platform_label)
     inflight = {"p": None, "out": None, "name": None}
 
     def _reap(p, term_grace: float):
@@ -1100,6 +1209,14 @@ def _run_isolated(order, platform_label: str, probe_mbps, on_cpu: bool):
                 _reap(p, term_grace=30)
             inflight.update(p=None, out=None, name=None)
         merged = _merge_child_line(extra, out_path, name, on_chip_verified)
+        if merged:
+            # an "(unprobed)" supervisor may have just adopted this
+            # child's real platform label; re-derive the verdict so a
+            # LATER cpu-fallback child is discarded instead of merging
+            # its numbers into a now tpu-labeled artifact
+            on_chip_verified = _on_chip_label(
+                str(extra.get("platform", ""))
+            )
         try:
             os.unlink(out_path)
         except OSError:
@@ -1274,6 +1391,7 @@ def main() -> None:
         "knn": bench_knn,
         "umap": bench_umap,
         "staging": bench_staging,
+        "cv_cached": bench_cv_cached,
         "streaming": bench_streaming,
         "refconfig": bench_refconfig,
         "rf": bench_rf,

@@ -88,7 +88,8 @@ fi
 # memory state; coverage is identical (every tests/test_*.py listed).
 run_batch () { python -m pytest -q "$@"; }
 run_batch tests/test_common_estimator.py tests/test_metrics.py \
-    tests/test_tuning_pipeline.py tests/test_pca.py tests/test_kmeans.py \
+    tests/test_tuning_pipeline.py tests/test_device_cache.py \
+    tests/test_pca.py tests/test_kmeans.py \
     tests/test_linear_regression.py "$@"
 run_batch tests/test_logistic_regression.py tests/test_sparse_logreg.py \
     tests/test_f32_and_weights.py tests/test_random_forest.py "$@"
@@ -135,6 +136,50 @@ echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="
 # dedicated step keeps the staging gate runnable in isolation.
 JAX_PLATFORMS=cpu SPARK_RAPIDS_ML_TPU_STAGING_PIPELINE_DEPTH=2 \
     python -m pytest tests/test_staging_pipeline.py -q
+
+echo "== device-cache parity smoke: stage-once CV == legacy CV =="
+# tier-1 marker-safe: a tiny CV grid fit on the device-resident cache
+# path (1 staging, cache hit on the repeat fit) must produce the same
+# avgMetrics/bestIndex as the legacy per-fold host-slicing path.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import numpy as np, pandas as pd
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.evaluation import RegressionEvaluator
+from spark_rapids_ml_tpu.parallel.device_cache import CACHE_METRICS
+from spark_rapids_ml_tpu.parallel.mesh import STAGE_COUNTS
+from spark_rapids_ml_tpu.regression import LinearRegression
+from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6))
+y = X @ rng.normal(size=6) + rng.normal(scale=0.1, size=400)
+df = pd.DataFrame({"features": list(X), "label": y})
+
+def run():
+    lr = LinearRegression()
+    grid = ParamGridBuilder().addGrid(lr.regParam, [0.0, 50.0]).build()
+    cv = CrossValidator(estimator=lr, estimatorParamMaps=grid,
+                        evaluator=RegressionEvaluator(metricName="rmse"),
+                        numFolds=3, seed=5)
+    s0 = STAGE_COUNTS["dataset_stagings"]
+    m = cv.fit(df)
+    return m, STAGE_COUNTS["dataset_stagings"] - s0, cv._last_fit_used_cache
+
+set_config(device_cache="on")
+m1, stagings, used = run()
+assert used and stagings == 1, (used, stagings)
+m1b, restagings, _ = run()  # repeat: served from the cache
+assert restagings == 0 and CACHE_METRICS["hits"] >= 1, (
+    restagings, CACHE_METRICS)
+set_config(device_cache="off")
+m2, legacy_stagings, used = run()
+assert not used and legacy_stagings > 1, (used, legacy_stagings)
+assert m1.bestIndex == m2.bestIndex
+np.testing.assert_allclose(m1.avgMetrics, m2.avgMetrics, rtol=1e-4)
+print(f"device-cache parity OK: stagings {legacy_stagings} -> {stagings} "
+      f"per CV run, {CACHE_METRICS['hits']} cache hit(s)")
+EOF
 
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \

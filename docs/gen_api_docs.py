@@ -12,6 +12,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import os
+import re
 import shutil
 import sys
 
@@ -63,9 +64,13 @@ def _clean_doc(doc: str | None, indent: str = "") -> str:
 
 def _signature(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # default values whose repr embeds a memory address ("<function f at
+    # 0x7f...>") change every process — the CI drift gate must compare
+    # content, not ASLR
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _param_table(cls) -> str:

@@ -166,6 +166,19 @@ _DEFAULTS: Dict[str, Any] = {
     # rows process-local and only the global id vector replicates (the
     # analog of the reference's distributed block exchange, knn.py:688-779).
     "knn_replicate_max_bytes": 1024 * 1024 * 1024,
+    # Device-resident dataset cache (parallel/device_cache.py): "on"
+    # stages a dataset onto the mesh ONCE and serves every subsequent
+    # fit/evaluate of the same data (CrossValidator folds, fitMultiple
+    # grids, the best-model refit) from views of the resident sharded
+    # arrays — a k-fold CV run drops from 2k+1 host->device stagings to
+    # 1.  "off" restores the legacy per-fold host-slicing path.
+    "device_cache": "on",
+    # Byte budget for resident cache entries (LRU-evicted beyond it).
+    # 0 -> derive from the device-memory model the staging decisions
+    # already use: hbm_bytes * mem_ratio_for_data * n_devices.  An entry
+    # that cannot fit even after evicting everything is NOT cached (the
+    # fit degrades gracefully to the uncached path).
+    "device_cache_bytes": 0,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

@@ -309,19 +309,38 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
     def _over_device_budget(self, need_bytes: float) -> bool:
         """Whether a staged dataset estimate exceeds the device-memory
         budget (or force_streaming_stats is set) — ONE formula for the
-        parquet and sparse streamed-stats decisions."""
-        import jax
-
+        parquet and sparse streamed-stats decisions AND the device-cache
+        residency accounting (parallel/device_cache.py shares it via
+        `device_data_budget_bytes`).  Bytes the cache holds RESIDENT
+        count against the estimate — but residency is re-creatable, so
+        entries are LRU-evicted first rather than pushing this fit onto
+        the much slower streamed-statistics path while droppable data
+        holds the room."""
         from .config import get_config
+        from .parallel.device_cache import (
+            cache_resident_bytes,
+            device_data_budget_bytes,
+            evict_to_fit,
+        )
 
-        budget = (
-            float(get_config("hbm_bytes"))
-            * float(get_config("mem_ratio_for_data"))
-            * len(jax.devices())
-        )
-        return need_bytes > budget or bool(
-            get_config("force_streaming_stats")
-        )
+        if bool(get_config("force_streaming_stats")):
+            # the answer is True regardless — do not evict a warm cache
+            # for a decision the force flag already made
+            return True
+        budget = device_data_budget_bytes()
+        if need_bytes + cache_resident_bytes() > budget:
+            evict_to_fit(need_bytes, budget)
+        return need_bytes + cache_resident_bytes() > budget
+
+    def _supports_fold_weights(self) -> bool:
+        """Whether this estimator's kernels honor the zero-weight-row
+        contract (ops SUPPORTS_ZERO_WEIGHT_ROWS) AND its fit trajectory
+        is row-count insensitive, so a CV fold may be selected by weight
+        MASK over the resident full dataset instead of a gather view
+        (parallel/device_cache.py).  Weight-capable deterministic solvers
+        (LinearRegression, LogisticRegression, PCA) override to True;
+        the default (gather/compaction fallback) is always correct."""
+        return False
 
     def _sparse_over_budget(self, batch: _ArrayBatch) -> bool:
         """Whether a sparse batch's DENSE form exceeds the device budget
@@ -646,6 +665,12 @@ class _TpuEstimator(Estimator, _TpuCaller):
         # capture).  Leaving the block pops the exception and frees them.
         import gc
 
+        # resident cache entries are re-creatable; they must not starve
+        # an OOM recovery (the registry's claim is dropped — in-flight
+        # consumers of an entry keep their views alive)
+        from .parallel.device_cache import clear_device_cache
+
+        clear_device_cache()
         gc.collect()
         self.logger.warning(
             "Device staging exhausted HBM; retrying as a "
@@ -768,6 +793,57 @@ class _TpuEstimator(Estimator, _TpuCaller):
                 return index, estimator.fit(dataset, paramMaps[index])
 
         return _FitMultipleIterator(fit_single, len(paramMaps))
+
+    def _cached_fit_entry(self, dataset: DatasetLike):
+        """Resident-cache entry for `dataset` (parallel/device_cache.py):
+        extract + validate the host batch, fingerprint it, and return the
+        cached staged arrays — staging ONCE on a miss.  Returns None (the
+        caller keeps the legacy host-slicing path) when the cache is off,
+        the run is multi-process, a CPU fallback/sparse kernel is
+        selected, or the entry exceeds the residency budget."""
+        from .parallel.device_cache import cache_enabled, get_or_stage
+
+        if not cache_enabled():
+            return None
+        import jax
+
+        if jax.process_count() > 1:
+            # fold views index the GLOBAL staged layout; the per-process
+            # block layout is not derivable host-side — legacy path
+            return None
+        if self._use_cpu_fallback():
+            return None
+        if not self._enable_fit_multiple_in_single_pass():
+            return None
+        from .data import _is_sparse
+
+        batch = self._extract(dataset)
+        if _is_sparse(batch.X) or self._use_sparse_kernel(batch):
+            return None  # dense resident views only (ELL staging differs)
+        self._validate_input(batch)
+        X = _ensure_dense(batch.X)
+        dtype = self._out_dtype(X)
+        ldt = self._fit_label_dtype() if self._is_supervised() else None
+        from .parallel.mesh import get_mesh
+
+        # EVERY cached CV run gathers at least its eval rows per fold
+        # (and gather-path estimators their train views too), and the
+        # cross-shard take lowers to an XLA all-gather that transiently
+        # replicates the full resident array on every device (~n_dev x
+        # cluster-wide) plus the compacted view itself; reserve that
+        # headroom up front — mask path included — or the per-fold
+        # gather OOMs after the budget check said yes
+        factor = float(get_mesh(self.num_workers).devices.size + 2)
+        return get_or_stage(
+            np.asarray(X, dtype=X.dtype),
+            batch.y,
+            batch.weight,
+            dtype=dtype,
+            label_dtype=ldt,
+            num_workers=self.num_workers,
+            logger=self.logger,
+            working_factor=factor,
+        )
 
 
 class _FitMultipleIterator:
@@ -1017,6 +1093,11 @@ class _TpuModel(Model, _TpuCaller):
                 log=self.logger,
             )
             if action == "oom":
+                # drop re-creatable cache residency before shrinking the
+                # chunk — the resident entries may BE the pressure
+                from .parallel.device_cache import clear_device_cache
+
+                clear_device_cache()
                 chunk = _floor_chunk(chunk // 2)
                 self.logger.warning(
                     f"Transform chunk exhausted device memory; resuming at "
@@ -1114,7 +1195,12 @@ class _TpuModel(Model, _TpuCaller):
 
     def _transformEvaluate(self, dataset: DatasetLike, evaluator: Any) -> List[float]:
         """Transform + metric in one logical pass (reference
-        `_transformEvaluate` core.py:1725-1748)."""
+        `_transformEvaluate` core.py:1725-1748).  A `CachedEvalView`
+        scores against the RESIDENT device rows — no eval restaging."""
+        from .parallel.device_cache import CachedEvalView
+
+        if isinstance(dataset, CachedEvalView):
+            return dataset.evaluate([self], evaluator)
         return [evaluator.evaluate(self.transform(dataset))]
 
     def cpu(self):
@@ -1168,6 +1254,12 @@ class _CombinedModel:
         self.models = list(models)
 
     def _transformEvaluate(self, dataset: DatasetLike, evaluator: Any) -> List[float]:
+        from .parallel.device_cache import CachedEvalView
+
+        if isinstance(dataset, CachedEvalView):
+            # every member model scores the RESIDENT sharded rows; only
+            # the fold's output columns come back to host
+            return dataset.evaluate(self.models, evaluator)
         import pandas as pd
 
         if not isinstance(dataset, pd.DataFrame):

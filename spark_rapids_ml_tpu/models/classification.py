@@ -304,6 +304,14 @@ class LogisticRegression(
         # parquet chunks through a donated loss+gradient accumulator
         return True
 
+    def _supports_fold_weights(self) -> bool:
+        # convex w-weighted objective, deterministic zero init
+        # (ops/logistic.py SUPPORTS_ZERO_WEIGHT_ROWS): a CV fold mask is
+        # exactly a zero weight and the optimum is row-count free
+        from ..ops import logistic as _logistic_ops
+
+        return bool(_logistic_ops.SUPPORTS_ZERO_WEIGHT_ROWS)
+
     def _fit_streaming(self, path: str) -> Dict[str, Any]:
         """Beyond-HBM fit: host-driven L-BFGS/OWL-QN whose oracle streams
         the dataset per evaluation — the reachability answer to the 1B-row

@@ -118,6 +118,13 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
     def _supports_streaming_stats(self) -> bool:
         return True
 
+    def _supports_fold_weights(self) -> bool:
+        # weighted mean/covariance + deterministic eigh (ops/pca.py
+        # SUPPORTS_ZERO_WEIGHT_ROWS): fold masks are plain zero weights
+        from ..ops import pca as _pca_ops
+
+        return bool(_pca_ops.SUPPORTS_ZERO_WEIGHT_ROWS)
+
     def _fit_streaming(self, path: str) -> Dict[str, Any]:
         """Beyond-HBM fit from multi-pass streamed second moments
         (streaming.py `pca_streaming_stats`): the dataset never resides in

@@ -765,10 +765,14 @@ class ApproximateNearestNeighborsModel(_ANNClass, _NNModelBase, _ANNParams):
             # peak is a single (mb, d) gather + distances, not nprobe x
             mb = int(self._attrs["ivf_buckets"].shape[1])
             width = mb
-        else:  # ivfpq: one (mb, M) code gather + (M, ksub) LUT per step
+        else:  # ivfpq: one (mb, M) code gather per step + the per-parent
+            # ADC LUT block (nprobe, M, ksub) precomputed up front and
+            # live across the whole fold loop (ops/ivf.py search_ivfpq)
             mb = int(self._attrs["pq_codes"].shape[1])
             M = int(self._attrs.get("pq_M", 8))
-            return mb * (M * 4 + 8) * 4
+            ksub = int(self._attrs["pq_codebooks"].shape[1])
+            nprobe = max(1, min(int(ap.get("nprobe", 20)), self.nlist_))
+            return (mb * (M * 4 + 8) + nprobe * M * ksub) * 4
         # distances + gathered vectors + dedup/sort keys, ~2x slack
         return width * (d + 4) * 4 * 2
 

@@ -279,6 +279,14 @@ class LinearRegression(
     def _supports_streaming_stats(self) -> bool:
         return True
 
+    def _supports_fold_weights(self) -> bool:
+        # closed-form/FISTA solve over w-weighted sufficient statistics
+        # (ops/linear.py SUPPORTS_ZERO_WEIGHT_ROWS): a CV fold mask is
+        # exactly a zero weight, and the solution is row-count free
+        from ..ops import linear as _linear_ops
+
+        return bool(_linear_ops.SUPPORTS_ZERO_WEIGHT_ROWS)
+
     def _fit_streaming(self, path: str) -> Dict[str, Any]:
         """Beyond-HBM fit from multi-pass streamed sufficient statistics
         (streaming.py `linreg_streaming_stats`); the host solve is the same

@@ -314,8 +314,15 @@ def search_ivfpq(
     the residual codebooks, summed across subspaces per candidate code.
     Probes parent cells and folds ONE sub-list per step (same rationale
     and structure as `search_ivfflat`): peak memory one (q, cap, M)
-    code gather + a (q, M, ksub) table instead of the
-    nprobe-times-larger all-at-once forms."""
+    code gather + the precomputed (q, nprobe, M, ksub) LUT block instead
+    of the nprobe-times-larger all-at-once candidate forms.
+
+    The ADC LUT depends only on the (query, probed PARENT) pair, and a
+    parent contributes up to `max_sub` fold steps — so the LUTs are
+    computed ONCE per probed parent up front and each step just indexes
+    its parent's slice by the parent's probe RANK (carried through the
+    front-packing permutation), instead of re-running the
+    (q, M, dsub) x (M, ksub, dsub) einsum every step."""
     M, ksub, dsub = codebooks.shape
     qn, d = queries.shape
     max_sub = sub_table.shape[1]
@@ -323,33 +330,45 @@ def search_ivfpq(
     dc = sqdist(queries, centers, q2=q2)  # (q, nlist)
     _, probe = jax.lax.top_k(-dc, nprobe)  # (q, nprobe) parent ids
     expanded = jnp.take(sub_table, probe, axis=0).reshape(qn, -1)
-    parents = jnp.repeat(probe, max_sub, axis=1)  # (q, nprobe*max_sub)
+    # each step needs its parent's LUT slice: the parent probe RANK
+    # (0..nprobe-1), aligned with `expanded` before the permutation
+    ranks = jnp.broadcast_to(
+        jnp.repeat(jnp.arange(nprobe, dtype=jnp.int32), max_sub)[None, :],
+        (qn, nprobe * max_sub),
+    )
     nsteps = nprobe * max_sub
     # front-pack real sub-lists (same rationale as search_ivfflat),
-    # carrying the aligned parent ids through the same permutation
+    # carrying the aligned parent ranks through the same permutation
     ordr = jnp.argsort(-expanded, axis=1)
     expanded = jnp.take_along_axis(expanded, ordr, axis=1)
-    parents = jnp.take_along_axis(parents, ordr, axis=1)
+    ranks = jnp.take_along_axis(ranks, ordr, axis=1)
     n_live = jnp.max(jnp.sum(expanded >= 0, axis=1))
 
     cb2 = (codebooks * codebooks).sum(axis=2)  # (M, ksub)
     cap = codes.shape[1]
     kk = min(k, nsteps * cap)
 
+    # per-parent residuals and LUTs, once for the whole fold loop:
+    # ||r_m - c_{m,j}||^2 for each probed parent and subspace code j
+    resid_all = (
+        queries[:, None, :] - jnp.take(centers, probe, axis=0)
+    )  # (q, nprobe, d)
+    resid_sub_all = resid_all.reshape(qn, nprobe, M, dsub)
+    dot_all = jnp.einsum(
+        "qpmd,mjd->qpmj", resid_sub_all, codebooks,
+        precision=distance_precision(),
+    )
+    r2_all = (resid_sub_all * resid_sub_all).sum(axis=3, keepdims=True)
+    luts_all = r2_all + cb2[None, None] - 2.0 * dot_all  # (q, nprobe, M, ksub)
+
     def fold(r, carry):
         run_d, run_i = carry
         lists = expanded[:, r]  # (q,) sub-list ids, may be -1
         safe = jnp.maximum(lists, 0)
-        # residual of each query to the step's probed PARENT center
-        resid = queries - jnp.take(centers, parents[:, r], axis=0)  # (q, d)
-        resid_sub = resid.reshape(qn, M, dsub)
-        # lookup tables: ||r_m - c_{m,j}||^2 for each subspace code j
-        dot = jnp.einsum(
-            "qmd,mjd->qmj", resid_sub, codebooks,
-            precision=distance_precision(),
-        )
-        r2 = (resid_sub * resid_sub).sum(axis=2, keepdims=True)  # (q, M, 1)
-        luts = r2 + cb2[None] - 2.0 * dot  # (q, M, ksub)
+        # this step's parent LUT, indexed by probe rank
+        luts = jnp.take_along_axis(
+            luts_all, ranks[:, r][:, None, None, None], axis=1
+        ).squeeze(1)  # (q, M, ksub)
         cand_codes = jnp.take(codes, safe, axis=0).astype(jnp.int32)
         # ADC: sum the per-subspace table entries selected by each code
         d2 = jnp.take_along_axis(

@@ -23,6 +23,20 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+# Sample-weight/fold-mask contract (parallel/device_cache.py): every
+# reduction here — init sampling logits, cluster sums/counts, inertia —
+# weights rows by `w` (w=0 rows are never sampled and contribute nothing),
+# so a w=0 row — zero padding OR a CV fold-mask hole — is mathematically
+# absent.  NOTE the trajectory is still row-COUNT sensitive: the seeded
+# Gumbel inits draw one variate per padded row, so a masked view and a
+# compacted view of the same data converge to (possibly) different local
+# optima.  KMeans therefore takes the cache's gather/compaction fold view
+# (`_supports_fold_weights` stays False), which reproduces the legacy
+# host-sliced trajectory exactly; the zero-weight invariance below is
+# what makes bucket padding safe and is asserted by
+# tests/test_device_cache.py.
+SUPPORTS_ZERO_WEIGHT_ROWS = True
+
 
 def _pairwise_sqdist(X: jax.Array, C: jax.Array) -> jax.Array:
     """(N,k) squared euclidean distances via the matmul identity."""

@@ -27,6 +27,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Sample-weight/fold-mask contract (parallel/device_cache.py): the
+# sufficient statistics weight every term by `w` and the host solver
+# consumes only those weighted sums (n enters as sw = w.sum()), so a w=0
+# row — zero padding OR a CV fold-mask hole — is mathematically absent.
+# The device cache's masked fold views rely on this; new reductions must
+# preserve it (tests/test_device_cache.py asserts the invariance).
+SUPPORTS_ZERO_WEIGHT_ROWS = True
+
 
 @jax.jit
 def linreg_sufficient_stats(X: jax.Array, w: jax.Array, y: jax.Array):

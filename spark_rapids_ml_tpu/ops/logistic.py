@@ -24,6 +24,14 @@ import jax.numpy as jnp
 
 from .lbfgs import lbfgs_minimize
 
+# Sample-weight/fold-mask contract (parallel/device_cache.py): the loss,
+# gradient, label range, and standardization moments all weight rows by
+# `w` and normalize by w.sum(), so a w=0 row — zero padding OR a CV
+# fold-mask hole — is mathematically absent from the optimization.  The
+# device cache's masked fold views rely on this; new reductions must
+# preserve it (tests/test_device_cache.py asserts the invariance).
+SUPPORTS_ZERO_WEIGHT_ROWS = True
+
 
 def _theta_layout(C: int, d: int, dtype, fit_intercept: bool):
     """Single source of truth for the packed-theta layout — coefficients

@@ -13,6 +13,14 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+# Sample-weight/fold-mask contract (parallel/device_cache.py): every data
+# reduction in this module weights rows by `w` and never uses a row COUNT
+# as n (mean/cov divide by w.sum()), so a w=0 row — zero padding OR a CV
+# fold-mask hole — is mathematically absent.  The device cache's masked
+# fold views rely on this; new reductions must preserve it
+# (tests/test_device_cache.py asserts the invariance).
+SUPPORTS_ZERO_WEIGHT_ROWS = True
+
 
 @partial(jax.jit, static_argnames=("k",))
 def pca_fit(X: jax.Array, w: jax.Array, k: int):

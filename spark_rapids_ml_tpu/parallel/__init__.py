@@ -22,3 +22,8 @@ from .context import (  # noqa: F401
     reinit_distributed,
     shutdown_distributed,
 )
+from .device_cache import (  # noqa: F401
+    DeviceDatasetCache,
+    clear_device_cache,
+    get_device_cache,
+)

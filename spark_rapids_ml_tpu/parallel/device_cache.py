@@ -1,0 +1,717 @@
+#
+# Device-resident dataset cache — the "stage once, fit/evaluate many"
+# layer (the Snap ML hierarchical-accelerator-cache lesson from PAPERS.md
+# applied to the JAX runtime).  Staging dominates large fits (BENCH_r05:
+# 220 s of a 413 s PCA fit), and before this layer `CrossValidator.fit`
+# paid `2k+1` full host->device stagings of overlapping rows per run: k
+# fold-train stagings in `fitMultiple`, k fold-eval stagings in
+# `_transformEvaluate`, plus the best-model refit.  Here the full dataset
+# is staged onto the mesh ONCE (through the PR-2 pipelined engine inside
+# `RowStager.stage`) and every consumer gets a VIEW of the resident
+# sharded arrays:
+#
+#   - fold TRAIN selection happens on device: a per-row fold-id array is
+#     staged with the data's layout, and a weight-capable kernel sees
+#     `w * (fold_id != fold)` (zero-weight rows are mathematically absent
+#     — the contract the ops kernels declare via SUPPORTS_ZERO_WEIGHT_ROWS);
+#   - estimators whose fit is row-COUNT sensitive (seeded inits draw one
+#     Gumbel per padded row) instead get an on-device gather/compaction
+#     view shaped exactly like a fresh staging of the fold's host slice,
+#     so trajectories match the legacy path;
+#   - fold EVAL runs each model's `_transform_device` over the resident
+#     rows and selects the fold's rows host-side — no eval restaging;
+#   - the best-params refit fits the resident full dataset directly.
+#
+# Entries are fingerprint-keyed (content hash of the host arrays + layout
+# metadata), accounted against the same device-memory model as the
+# staging decisions (`device_data_budget_bytes`, the `_over_device_budget`
+# formula in core.py), LRU-evicted under the `device_cache_bytes` conf,
+# and the whole layer degrades to the legacy per-fold host-slicing path
+# when disabled (`device_cache=off`) or over budget.  Hit/miss/evict
+# counters mirror into `mesh.STAGE_COUNTS` and emit trace events.
+#
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data import DeviceDataset
+from .mesh import (
+    STAGE_COUNTS,
+    RowStager,
+    NamedSharding,
+    data_pspec,
+    get_mesh,
+)
+
+# cumulative registry metrics (also mirrored into mesh.STAGE_COUNTS):
+# read by tests, bench.py `cv_cached`, and operators debugging residency
+CACHE_METRICS: Dict[str, int] = {
+    "hits": 0,
+    "misses": 0,
+    "evictions": 0,
+    "inserts": 0,
+    "resident_bytes": 0,
+    "resident_entries": 0,
+}
+
+_lock = threading.Lock()
+
+
+def _note(kind: str, detail: str = "") -> None:
+    with _lock:
+        CACHE_METRICS[kind] = CACHE_METRICS.get(kind, 0) + 1
+        mirrored = "cache_" + kind
+        if mirrored in STAGE_COUNTS:
+            STAGE_COUNTS[mirrored] += 1
+    from ..tracing import event
+
+    event(f"device_cache_{kind}", detail=detail)
+
+
+def device_data_budget_bytes() -> float:
+    """The device-memory budget staged training data is accounted
+    against: hbm_bytes * mem_ratio_for_data * n_devices — ONE formula
+    shared with `_TpuCaller._over_device_budget` (core.py) so the cache
+    can never believe in more memory than the staging decisions do."""
+    import jax
+
+    from ..config import get_config
+
+    return (
+        float(get_config("hbm_bytes"))
+        * float(get_config("mem_ratio_for_data"))
+        * len(jax.devices())
+    )
+
+
+def cache_enabled() -> bool:
+    from ..config import get_config
+
+    return str(get_config("device_cache")).lower() == "on"
+
+
+def cache_budget_bytes() -> float:
+    from ..config import get_config
+
+    explicit = int(get_config("device_cache_bytes"))
+    return float(explicit) if explicit > 0 else device_data_budget_bytes()
+
+
+# ---------------------------------------------------------------------------
+# Fingerprinting
+# ---------------------------------------------------------------------------
+
+# above this, a 2-D array hashes a strided row sample + a per-row
+# random-projection digest instead of every byte (hashing 5 GB would
+# cost seconds; staging it costs minutes — but the fingerprint must stay
+# cheap enough to run on every fit).  1-D arrays (labels/weights) always
+# hash in full: they are a few bytes per row.
+_FULL_HASH_MAX_BYTES = 64 * 1024 * 1024
+_SAMPLE_ROWS = 1024
+
+
+def _hash_array(h: "hashlib._Hash", arr: Optional[np.ndarray]) -> None:
+    if arr is None:
+        h.update(b"<none>")
+        return
+    arr = np.ascontiguousarray(arr)
+    h.update(str(arr.shape).encode())
+    h.update(str(arr.dtype).encode())
+    if arr.ndim != 2 or arr.nbytes <= _FULL_HASH_MAX_BYTES:
+        h.update(arr.tobytes())
+        return
+    # strided row sample + a per-row random-projection digest (one
+    # O(n*d) matvec pass against a shape-seeded fixed vector): the (n,)
+    # projection sequence is ORDER-sensitive — swapping any two distinct
+    # rows changes it — so permutations of non-sampled rows cannot
+    # silently collide with the resident entry (an order-invariant
+    # column sum could)
+    n = arr.shape[0]
+    stride = max(1, n // _SAMPLE_ROWS)
+    h.update(np.ascontiguousarray(arr[::stride]).tobytes())
+    v = np.random.default_rng(arr.shape[1]).standard_normal(arr.shape[1])
+    h.update(np.asarray(arr @ v, np.float64).tobytes())
+
+
+def dataset_fingerprint(
+    X: np.ndarray,
+    y: Optional[np.ndarray],
+    weight: Optional[np.ndarray],
+    dtype: np.dtype,
+    label_dtype: Optional[np.dtype],
+    mesh,
+) -> str:
+    """Content fingerprint binding a cache entry to the DATA and its
+    staged layout: host array contents, staged dtypes, and the mesh's
+    device set (a different mesh shards differently).  Shape-bucketing is
+    part of the layout, so its conf value keys too."""
+    from ..config import get_config
+
+    h = hashlib.blake2b(digest_size=20)
+    _hash_array(h, X)
+    _hash_array(h, y)
+    _hash_array(h, weight)
+    h.update(str(np.dtype(dtype)).encode())
+    h.update(str(np.dtype(label_dtype) if label_dtype else None).encode())
+    h.update(str(bool(get_config("shape_bucketing"))).encode())
+    h.update(",".join(str(d.id) for d in mesh.devices.flat).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# On-device fold programs
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _masked_weight_fn(sharding):
+    """Jitted `w * (fold_ids != fold)` — ONE compile serves every fold
+    (the fold index is a traced scalar)."""
+    import jax
+
+    def mask(w, fold_ids, fold):
+        return w * (fold_ids != fold).astype(w.dtype)
+
+    return jax.jit(mask, out_shardings=sharding)
+
+
+@functools.lru_cache(maxsize=64)
+def _gather_masked_fn(sharding):
+    """Jitted resident-array row gather + validity mask:
+    `out[i] = arr[idx[i]] * valid[i]`, with the view's row sharding.  The
+    only bytes that cross the HOST->device edge for a gather view are the
+    (4 bytes/row) index and validity arrays; the data rows move between
+    devices — but NOTE that XLA lowers the arbitrary cross-shard take to
+    an all-gather, so the program transiently materializes the FULL
+    source array per device (~n_dev x the dataset, cluster-wide).  The
+    reservation for gather-path consumers sizes that transient
+    (`_cached_fit_entry`'s working_factor).  The mask matters because
+    padding slots of the view have no source row to read (their `idx`
+    points at an arbitrary valid slot); re-zeroing them reproduces
+    EXACTLY the zero padding a fresh host staging of the fold slice
+    would carry (byte parity with the legacy path, asserted by
+    tests/test_device_cache.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def gather(arr, idx, valid):
+        g = jnp.take(arr, idx, axis=0)
+        v = valid.astype(arr.dtype)
+        return g * (v[:, None] if g.ndim == 2 else v)
+
+    return jax.jit(gather, out_shardings=sharding)
+
+
+# ---------------------------------------------------------------------------
+# Cache entry: one resident dataset + its fold views
+# ---------------------------------------------------------------------------
+
+
+class CacheEntry:
+    """A dataset resident on the mesh plus the machinery to derive fold
+    views from it without restaging.  `dataset` is a `DeviceDataset`
+    (with its staging `RowStager`, so layouts always line up).  Fold
+    state lives in per-run `FoldSet` objects (`fold_set`), NOT on the
+    entry: concurrent CV runs sharing one resident entry must not swap
+    each other's fold assignments."""
+
+    def __init__(self, fingerprint: str, dataset: DeviceDataset,
+                 nbytes: int, base_bytes: Optional[int] = None) -> None:
+        self.fingerprint = fingerprint
+        self.dataset = dataset
+        # nbytes = the RESERVED accounting size (base + any gather-path
+        # working headroom); base_bytes = the resident arrays alone
+        self.nbytes = int(nbytes)
+        self.base_bytes = int(base_bytes if base_bytes is not None
+                              else nbytes)
+        self.last_used = 0
+        self._src_slot: Optional[np.ndarray] = None  # orig row -> staged slot
+
+    @property
+    def stager(self) -> RowStager:
+        return self.dataset._stager
+
+    @property
+    def mesh(self):
+        return self.dataset.mesh
+
+    # -- fold registration ---------------------------------------------------
+
+    def fold_set(self, folds: np.ndarray) -> "FoldSet":
+        """Stage a per-row fold-id array (int32, entry layout) and
+        return the RUN-owned handle the fold views hang off.  Padding
+        rows get fold id -1 — they carry zero weight already, but the
+        sentinel keeps them out of any `== fold` eval selection too."""
+        folds = np.ascontiguousarray(np.asarray(folds, np.int32))
+        st = self.stager
+        if folds.shape[0] != st.n_valid:
+            raise ValueError(
+                f"fold array has {folds.shape[0]} rows, dataset has "
+                f"{st.n_valid}"
+            )
+        import jax
+
+        padded = np.full((st.local_padded,), -1, np.int32)
+        padded[: st.n_valid] = folds
+        sharding = NamedSharding(self.mesh, data_pspec(1))
+        fold_dev = jax.device_put(st._to_layout(padded), sharding)
+        return FoldSet(self, folds, fold_dev)
+
+    def _slot_of_row(self) -> np.ndarray:
+        """original row id -> staged slot index, for the entry layout."""
+        if self._src_slot is None:
+            st = self.stager
+            laid = np.full((st.local_padded,), -1, np.int64)
+            laid[: st.n_valid] = np.arange(st.n_valid, dtype=np.int64)
+            laid = st._to_layout(laid)  # slot -> orig row (or -1)
+            slot = np.empty((st.n_valid,), np.int64)
+            valid = laid >= 0
+            slot[laid[valid]] = np.flatnonzero(valid)
+            self._src_slot = slot
+        return self._src_slot
+
+    def _gather_view(self, sel: np.ndarray, what: str) -> DeviceDataset:
+        """On-device gather/compaction of the rows selected by boolean
+        `sel` into a fresh sharded view laid out EXACTLY like a legacy
+        staging of the selected host slice (same RowStager layout
+        decisions).  Only the int32 slot-index + validity arrays cross
+        the host->device edge; the data rows move device-to-device."""
+        import jax
+
+        ds = self.dataset
+        rows = np.flatnonzero(sel)
+        if rows.size == 0:
+            raise ValueError(f"{what} selects no rows")
+        src_slot = self._slot_of_row()[rows]
+        view_st = RowStager(rows.size, self.mesh)
+        idx = np.zeros((view_st.local_padded,), np.int64)
+        idx[: rows.size] = src_slot
+        idx = view_st._to_layout(idx).astype(np.int32)
+        sharding1 = NamedSharding(self.mesh, data_pspec(1))
+        idx_dev = jax.device_put(idx, sharding1)
+        valid = np.zeros((view_st.local_padded,), np.float32)
+        valid[: rows.size] = 1.0
+        valid_dev = jax.device_put(
+            view_st._to_layout(valid).astype(np.dtype(ds.weight.dtype)),
+            sharding1,
+        )
+        sharding2 = NamedSharding(self.mesh, data_pspec(2))
+        Xv = _gather_masked_fn(sharding2)(ds.X, idx_dev, valid_dev)
+        wv = _gather_masked_fn(sharding1)(ds.weight, idx_dev, valid_dev)
+        yv = None
+        if ds.y is not None:
+            yv = _gather_masked_fn(sharding1)(ds.y, idx_dev, valid_dev)
+        return DeviceDataset(
+            self.mesh, Xv, rows.size, y=yv, weight=wv, stager=view_st
+        )
+
+
+class FoldSet:
+    """One CV run's fold assignment staged against a cache entry's
+    layout.  Owned by the RUN, not the entry: two concurrent consumers
+    of the same resident entry each hold their own FoldSet, so neither
+    can silently evaluate against the other's train/eval split."""
+
+    def __init__(self, entry: CacheEntry, folds: np.ndarray,
+                 fold_dev) -> None:
+        self.entry = entry
+        self.folds = folds  # host (n_valid,) int32, original row order
+        self.fold_dev = fold_dev  # staged fold ids, entry layout
+
+    def train_view(self, fold: int) -> DeviceDataset:
+        """Weight-mask train view: the resident X/y plus
+        `w * (fold_id != fold)`.  Zero host->device traffic.  Correct for
+        kernels that honor the zero-weight-row contract
+        (ops SUPPORTS_ZERO_WEIGHT_ROWS; `_supports_fold_weights`)."""
+        import jax.numpy as jnp
+
+        entry = self.entry
+        ds = entry.dataset
+        sharding = NamedSharding(entry.mesh, data_pspec(1))
+        w = _masked_weight_fn(sharding)(
+            ds.weight, self.fold_dev, jnp.asarray(int(fold), jnp.int32)
+        )
+        return DeviceDataset(
+            entry.mesh, ds.X, ds.n_valid, y=ds.y, weight=w,
+            stager=entry.stager,
+        )
+
+    def gather_train_view(self, fold: int) -> DeviceDataset:
+        """Gather/compaction train view for estimators whose fit is
+        row-count sensitive (seeded inits draw one variate per padded
+        row): byte-identical to a fresh staging of the fold's host
+        slice, so fits match the uncached path's trajectory."""
+        return self.entry._gather_view(self.folds != fold,
+                                       f"train fold {fold}")
+
+    def eval_view(self, fold: int, eval_df) -> "CachedEvalView":
+        """Fold-eval view: the fold's rows are gather/compacted on
+        device ONCE and every model scores only them (`eval_df` holds
+        the fold's host rows for the evaluator's label/weight
+        columns)."""
+        sel = np.asarray(self.folds == fold)
+        if not sel.any():
+            raise ValueError(f"fold {fold} has no validation rows")
+        return CachedEvalView(self.entry, fold, sel, eval_df)
+
+
+class CachedEvalView:
+    """`_transformEvaluate` input backed by a cache entry: the fold's
+    eval rows are gather/compacted on device once per fold (transforms
+    run over n/k rows, not n — row-wise transforms make the compaction
+    exact), each model's `_transform_device` runs over them (compile
+    shared across folds and param maps via shape bucketing), and the
+    trimmed outputs come back in the eval frame's row order — zero eval
+    restaging.  Models without a device transform fall back to their
+    normal host transform of the fold's rows.
+
+    Unlike `_transform_mesh`, the fold transform is NOT re-chunked by
+    `host_batch_bytes`: its input rows are already resident (no staged
+    copy to bound) and its outputs are O(n/k x n_output_cols) — small
+    next to the (n/k, d) view for every current model family.  A future
+    model with very wide outputs would want chunking here too."""
+
+    def __init__(self, entry: CacheEntry, fold: int, sel: np.ndarray,
+                 eval_df) -> None:
+        self.entry = entry
+        self.fold = int(fold)
+        self.sel = sel  # bool (n_valid,) in original row order
+        self.eval_df = eval_df
+        self._view: Optional[DeviceDataset] = None  # built on first use
+
+    def _eval_rows(self) -> DeviceDataset:
+        if self._view is None:
+            self._view = self.entry._gather_view(
+                self.sel, f"eval fold {self.fold}"
+            )
+        return self._view
+
+    def evaluate(self, models: List[Any], evaluator: Any) -> List[float]:
+        return [self._evaluate_one(m, evaluator) for m in models]
+
+    def _evaluate_one(self, model: Any, evaluator: Any) -> float:
+        from ..core import _TpuModel
+
+        if type(model)._transform_device is _TpuModel._transform_device:
+            # no device transform (DBSCAN/UMAP/kNN manage their own
+            # staging): the fold's host rows go through the normal path
+            return evaluator.evaluate(model.transform(self.eval_df))
+        import jax
+        import pandas as pd
+
+        view = self._eval_rows()
+        st = view._stager
+        dev = model._transform_device(view.X)
+        cols: Dict[str, Any] = {}
+        for col, v in dev.items():
+            # fetch trims padding and restores the eval frame's row order
+            host = (
+                st.fetch(v)
+                if isinstance(v, jax.Array)
+                else st.trim_host(np.asarray(v))
+            )
+            cols[col] = list(host) if host.ndim == 2 else host
+        base = self.eval_df
+        overlap = [c for c in cols if c in base.columns]
+        if overlap:
+            base = base.drop(columns=overlap)
+        out_df = pd.concat(
+            [
+                base.reset_index(drop=True),
+                pd.DataFrame(cols),
+            ],
+            axis=1,
+        )
+        return evaluator.evaluate(out_df)
+
+
+# ---------------------------------------------------------------------------
+# The registry
+# ---------------------------------------------------------------------------
+
+
+class DeviceDatasetCache:
+    """Fingerprint-keyed LRU registry of resident datasets, accounted
+    against `cache_budget_bytes()`.  Registry mutations hold `_mu`; the
+    module `_lock` (metrics) is never taken while `_mu` is held in a way
+    that nests the other direction, so the two cannot deadlock."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, CacheEntry] = {}
+        self._clock = 0
+        self._mu = threading.RLock()
+        # bytes reserve()d but not yet insert()ed (staging in flight):
+        # without this ledger two concurrent misses could both pass
+        # reserve() against the same headroom and overcommit the budget
+        self._pending = 0
+
+    def lookup(self, fingerprint: str) -> Optional[CacheEntry]:
+        with self._mu:
+            entry = self._entries.get(fingerprint)
+            if entry is None:
+                return None
+            self._clock += 1
+            entry.last_used = self._clock
+        _note("hits", detail=f"fp={fingerprint[:12]} bytes={entry.nbytes}")
+        return entry
+
+    def resident_bytes(self) -> int:
+        with self._mu:
+            return sum(e.nbytes for e in self._entries.values())
+
+    def claimed_bytes(self) -> int:
+        """Resident bytes PLUS in-flight reservations — what every
+        budget comparison must see."""
+        with self._mu:
+            return self.resident_bytes() + self._pending
+
+    def _evict_lru(self) -> bool:
+        with self._mu:
+            if not self._entries:
+                return False
+            fp = min(self._entries,
+                     key=lambda k: self._entries[k].last_used)
+            self.evict(fp)
+            return True
+
+    def evict(self, fingerprint: str) -> None:
+        with self._mu:
+            entry = self._entries.pop(fingerprint, None)
+        if entry is None:
+            return
+        # deliberately do NOT null the entry's device references: an
+        # in-flight CV run may still hold this entry and its views, and
+        # they stay valid — eviction only removes the REGISTRY's claim,
+        # and the buffers free (async, via jax) when the last consumer
+        # reference dies
+        _note("evictions",
+              detail=f"fp={fingerprint[:12]} bytes={entry.nbytes}")
+        self._sync_metrics()
+
+    def reserve(self, need_bytes: int) -> bool:
+        """Claim room for `need_bytes` of new residency, LRU-evicting
+        entries as needed.  On True the bytes are held as an in-flight
+        claim until `insert` (which converts it to the entry) or
+        `release` (staging failed); False when they cannot fit even with
+        the cache empty (the caller then degrades to the uncached
+        path)."""
+        budget = cache_budget_bytes()
+        if need_bytes > budget:
+            return False
+        with self._mu:
+            while self.claimed_bytes() + need_bytes > budget:
+                if not self._evict_lru():
+                    break
+            if self.claimed_bytes() + need_bytes > budget:
+                return False
+            self._pending += int(need_bytes)
+            return True
+
+    def release(self, need_bytes: int) -> None:
+        """Drop an in-flight reservation whose staging failed."""
+        with self._mu:
+            self._pending = max(0, self._pending - int(need_bytes))
+
+    def top_up(self, entry: CacheEntry, extra: int) -> bool:
+        """Grow an existing (just-looked-up, hence MRU) entry's
+        reservation by `extra` bytes, LRU-evicting OTHER entries as
+        needed — never the entry itself (the `len > 1` guard keeps the
+        MRU entry out of reach of `_evict_lru`).  False when the extra
+        headroom cannot fit."""
+        budget = cache_budget_bytes()
+        with self._mu:
+            while (
+                self.claimed_bytes() + extra > budget
+                and len(self._entries) > 1
+            ):
+                if not self._evict_lru():
+                    break
+            if entry.fingerprint not in self._entries:
+                return False
+            if self.claimed_bytes() + extra > budget:
+                return False
+            entry.nbytes += int(extra)
+        self._sync_metrics()
+        return True
+
+    def insert(self, entry: CacheEntry) -> None:
+        with self._mu:
+            self._clock += 1
+            entry.last_used = self._clock
+            self._entries[entry.fingerprint] = entry
+            # the staging this entry came from ran under a reserve()
+            # claim; the entry now carries those bytes itself
+            self._pending = max(0, self._pending - entry.nbytes)
+        with _lock:
+            CACHE_METRICS["inserts"] += 1
+        self._sync_metrics()
+
+    def clear(self) -> None:
+        with self._mu:
+            fps = list(self._entries)
+        for fp in fps:
+            self.evict(fp)
+
+    def _sync_metrics(self) -> None:
+        resident, count = self.resident_bytes(), len(self._entries)
+        with _lock:
+            CACHE_METRICS["resident_bytes"] = resident
+            CACHE_METRICS["resident_entries"] = count
+
+
+_global_cache: Optional[DeviceDatasetCache] = None
+
+
+def get_device_cache() -> DeviceDatasetCache:
+    global _global_cache
+    if _global_cache is None:
+        _global_cache = DeviceDatasetCache()
+    return _global_cache
+
+
+def clear_device_cache() -> None:
+    """Release every resident entry (tests; explicit operator reset;
+    the OOM-recovery paths in core.py call this so resident entries
+    cannot starve a retried fit)."""
+    if _global_cache is not None:
+        _global_cache.clear()
+
+
+def cache_resident_bytes() -> int:
+    """Bytes the cache holds or has claimed (resident entries plus
+    in-flight reservations) — added to every `_over_device_budget`
+    estimate (core.py) so staging decisions see the HBM the cache
+    occupies."""
+    return _global_cache.claimed_bytes() if _global_cache is not None else 0
+
+
+def evict_to_fit(need_bytes: float, budget: float) -> None:
+    """LRU-evict resident entries until `need_bytes` fits under `budget`
+    alongside the remaining residency (no-op when it already fits).
+    Residency is re-creatable; a staging decision must not degrade to
+    the much slower streamed-statistics path while droppable entries
+    hold the room (in-flight consumers of an evicted entry keep their
+    views — only the registry's claim is released)."""
+    if _global_cache is None:
+        return
+    cache = _global_cache
+    while (
+        cache.resident_bytes()
+        and need_bytes + cache.claimed_bytes() > budget
+    ):
+        if not cache._evict_lru():
+            break
+
+
+def get_or_stage(
+    X: np.ndarray,
+    y: Optional[np.ndarray],
+    weight: Optional[np.ndarray],
+    dtype,
+    label_dtype=None,
+    num_workers: Optional[int] = None,
+    logger=None,
+    working_factor: float = 1.0,
+) -> Optional[CacheEntry]:
+    """The one staging entry point of the cache: return the resident
+    entry for this dataset, staging it (once, through the pipelined
+    engine) on a miss.  None when the entry would not fit the budget —
+    the caller falls back to the legacy uncached path.  `working_factor`
+    scales the RESERVATION for consumers whose fold views need transient
+    device memory beyond the resident entry — the gather/compaction
+    path's cross-shard take lowers to an all-gather that transiently
+    replicates the full array per device (~n_dev x), plus the compacted
+    view itself: the headroom must exist up front or the per-fold gather
+    OOMs after reserve() said yes.  A cache HIT tops the existing
+    entry's reservation up to this consumer's factor (a gather-path run
+    may hit an entry a mask-path run inserted at factor 1)."""
+    dtype = np.dtype(dtype)
+    mesh = get_mesh(num_workers)
+    fp = dataset_fingerprint(X, y, weight, dtype, label_dtype, mesh)
+    cache = get_device_cache()
+    entry = cache.lookup(fp)
+    if entry is not None:
+        want = int(entry.base_bytes * max(working_factor, 1.0))
+        if want > entry.nbytes and not cache.top_up(
+            entry, want - entry.nbytes
+        ):
+            _note(
+                "misses",
+                detail=f"fp={fp[:12]} hit lacks gather headroom "
+                f"(+{want - entry.nbytes} over budget)",
+            )
+            return None
+        return entry
+    st = RowStager(X.shape[0], mesh)
+    ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
+    row_bytes = int(X.shape[1]) * dtype.itemsize + dtype.itemsize
+    if y is not None:
+        row_bytes += ldt.itemsize
+    need = st.local_padded * row_bytes
+    reserved = int(need * max(working_factor, 1.0))
+    if not cache.reserve(reserved):
+        _note(
+            "misses",
+            detail=f"fp={fp[:12]} over-budget need={need} "
+            f"budget={cache_budget_bytes():.0f}",
+        )
+        if logger is not None:
+            logger.info(
+                f"device cache: dataset (~{need/2**20:.0f} MiB) exceeds "
+                "the cache budget; falling back to uncached staging"
+            )
+        return None
+    _note("misses", detail=f"fp={fp[:12]} staging {need} bytes")
+    try:
+        Xs = st.stage(X, dtype)
+        w = st.mask(dtype, weights=weight)
+        yd = None
+        if y is not None:
+            yd = st.stage(np.asarray(y).reshape(-1).astype(ldt), ldt)
+    except Exception as e:
+        # the byte model cannot see fragmentation or non-dataset HBM
+        # (model attributes, solver state): a real staging OOM degrades
+        # to the legacy uncached path like every other ineligibility —
+        # drop the partial buffers first, they hold the exhausted HBM
+        from ..resilience import is_oom
+
+        Xs = w = yd = None  # noqa: F841
+        cache.release(reserved)
+        if not is_oom(e):
+            raise
+        if logger is not None:
+            logger.warning(
+                "device cache: staging exhausted HBM; falling back to "
+                "uncached staging"
+            )
+        return None
+    ds = DeviceDataset(mesh, Xs, st.n_valid, y=yd, weight=w, stager=st)
+    # the entry records the full reservation (base + gather headroom):
+    # it must survive later inserts, or an interleaved get_or_stage
+    # could reclaim the room the per-fold gathers need (overstating
+    # residency costs cache capacity, never correctness)
+    entry = CacheEntry(fp, ds, reserved, base_bytes=need)
+    cache.insert(entry)
+    return entry
+
+
+__all__ = [
+    "CACHE_METRICS",
+    "CacheEntry",
+    "CachedEvalView",
+    "DeviceDatasetCache",
+    "FoldSet",
+    "cache_budget_bytes",
+    "cache_enabled",
+    "cache_resident_bytes",
+    "clear_device_cache",
+    "dataset_fingerprint",
+    "device_data_budget_bytes",
+    "get_device_cache",
+    "get_or_stage",
+]

@@ -218,6 +218,28 @@ def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None,
 # `staging` workload and the parity tests)
 STAGE_METRICS: dict = {}
 
+# CUMULATIVE process-wide staging/cache counters (never cleared by a
+# staging run, unlike STAGE_METRICS): `dataset_stagings` counts EVERY
+# 2-D host->device staging through RowStager.stage/stage_sparse — fit
+# feature matrices AND per-chunk transform/eval inputs (which is why a
+# legacy k-fold CV measures >= 2k+1: k train stagings + one eval staging
+# per (fold, model) + the refit).  The `cache_*` keys mirror the
+# device-cache registry's hit/miss/evict events
+# (parallel/device_cache.py).  bench.py's `cv_cached` section and the
+# cache tests read deltas of these to assert the stagings-per-CV-run
+# contract (2k+1-and-more -> 1).
+STAGE_COUNTS: dict = {
+    "dataset_stagings": 0,
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "cache_evictions": 0,
+}
+
+
+def note_dataset_staging() -> None:
+    """Record one full host->device staging of a 2-D feature block."""
+    STAGE_COUNTS["dataset_stagings"] += 1
+
 # tests: route even tiny arrays through the engine
 _FORCE_PIPELINED = False
 
@@ -455,6 +477,17 @@ def _chunked_device_get(arr) -> np.ndarray:
             )
         return np.asarray(arr)
     row_bytes = max(nbytes // arr.shape[0], 1)
+    if row_bytes > _MAX_PUT_BYTES:
+        # the chunked loop degenerates to one row per fetch and EACH of
+        # those still exceeds the ceiling — same attribution warning as
+        # the single-row branch, or the hang class would be silent here
+        from ..utils import get_logger
+
+        get_logger("mesh").warning(
+            f"chunked device fetch rows are {row_bytes/2**20:.0f} MiB each "
+            "(single row over the transfer ceiling) — may exceed the "
+            "tunnel transfer-RPC deadline"
+        )
     rows = max(1, int(_MAX_PUT_BYTES // row_bytes))
     out = np.empty(arr.shape, arr.dtype)
     for lo in range(0, arr.shape[0], rows):
@@ -488,6 +521,16 @@ def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
         return (jax.device_put(arr, sharding) if sharding is not None
                 else jax.device_put(arr))
     row_bytes = max(arr.nbytes // arr.shape[0], 1)
+    if row_bytes > _MAX_PUT_BYTES:
+        # mirror of the fetch-side chunked-loop warning: one-row pieces
+        # are still over the ceiling and cannot be split further
+        from ..utils import get_logger
+
+        get_logger("mesh").warning(
+            f"chunked device_put pieces are {row_bytes/2**20:.0f} MiB each "
+            "(single row over the transfer ceiling) — may exceed the "
+            "tunnel transfer-RPC deadline"
+        )
     chunk = max(1, int(_MAX_PUT_BYTES // row_bytes))
     pieces = (
         (lo, np.ascontiguousarray(arr[lo : lo + chunk]))
@@ -671,6 +714,10 @@ class RowStager:
             raise ValueError(
                 f"array has {arr.shape[0]} rows, stager expects {self.n_local}"
             )
+        if arr.ndim == 2:
+            # 1-D companions (labels/weights/masks/fold-ids) ride along a
+            # dataset staging; only the feature block counts as one
+            note_dataset_staging()
         sharding = NamedSharding(self.mesh, data_pspec(arr.ndim))
         if self.n_proc == 1:
             if (
@@ -796,6 +843,7 @@ class RowStager:
         d = int(X.shape[1])
         dtype = np.dtype(dtype) if dtype is not None else np.dtype(X.dtype)
         ensure_x64(dtype)
+        note_dataset_staging()
         chunk = max(1, int(chunk_rows_for(d, dtype.itemsize)))
         sharding = NamedSharding(self.mesh, data_pspec(2))
 

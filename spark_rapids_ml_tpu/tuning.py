@@ -128,6 +128,9 @@ class CrossValidator(Params):
         self._set(numFolds=numFolds, parallelism=parallelism, foldCol=foldCol)
         if seed is not None:
             self._set(seed=seed)
+        # introspection for tests/bench: did the last fit run on the
+        # device-resident cache path or the legacy host-slicing loop?
+        self._last_fit_used_cache = False
         self.logger = get_logger(type(self))
 
     def setEstimator(self, value: Optional[_TpuEstimator]) -> "CrossValidator":
@@ -186,6 +189,27 @@ class CrossValidator(Params):
                     f"or more data (n={n}, numFolds={k})"
                 )
 
+        # stage-once fast path (parallel/device_cache.py): the full
+        # dataset becomes resident on the mesh and every fold's
+        # train/eval selection derives ON DEVICE — the whole CV run
+        # (k folds x fitMultiple + eval, plus the best-model refit) pays
+        # ONE host->device staging instead of 2k+1.  Anything that makes
+        # the cache ineligible (off, over budget, sparse, multi-process,
+        # CPU fallback) keeps the legacy host-slicing loop.
+        entry = None
+        if isinstance(est, _TpuEstimator):
+            entry = est._cached_fit_entry(df)
+        self._last_fit_used_cache = entry is not None
+        if entry is not None:
+            return self._fit_cached(est, evaluator, param_maps, df, folds, k,
+                                    entry)
+        return self._fit_legacy(est, evaluator, param_maps, df, folds, k)
+
+    def _fit_legacy(
+        self, est, evaluator, param_maps, df, folds, k: int
+    ) -> "CrossValidatorModel":
+        """Per-fold host slicing + restaging (the pre-cache path; also
+        the parity reference for the cached driver)."""
         n_models = len(param_maps)
         metrics = np.zeros((n_models,), np.float64)
         for fold in range(k):
@@ -206,6 +230,58 @@ class CrossValidator(Params):
             else int(np.argmin(metrics))
         )
         best_model = est.fit(df, param_maps[best])
+        return CrossValidatorModel(
+            bestModel=best_model,
+            avgMetrics=list(metrics),
+            bestIndex=best,
+        )
+
+    def _fit_cached(
+        self, est, evaluator, param_maps, df, folds, k: int, entry
+    ) -> "CrossValidatorModel":
+        """Device-resident CV driver: fold train views are weight masks
+        (weight-capable kernels) or on-device gather/compaction views
+        (everything else — also the choice for seeded row-count-sensitive
+        inits, where the gather view reproduces the legacy trajectory);
+        eval scores the resident rows; the refit fits the resident full
+        dataset.  Zero restaging for the entire run."""
+        from .tracing import trace
+
+        fold_set = entry.fold_set(folds)  # run-owned: see FoldSet
+        use_mask = est._supports_fold_weights()
+        self.logger.info(
+            f"CV on resident dataset cache ({'weight-mask' if use_mask else 'gather'} "
+            f"fold views, {entry.nbytes / 2**20:.0f} MiB resident)"
+        )
+        n_models = len(param_maps)
+        metrics = np.zeros((n_models,), np.float64)
+        for fold in range(k):
+            with trace(f"cv_fold[{fold}]", self.logger):
+                train_view = (
+                    fold_set.train_view(fold)
+                    if use_mask
+                    else fold_set.gather_train_view(fold)
+                )
+                models: List[Optional[_TpuModel]] = [None] * n_models
+                for index, model in est.fitMultiple(train_view, param_maps):
+                    models[index] = model
+                val_view = fold_set.eval_view(
+                    fold, df[folds == fold].reset_index(drop=True)
+                )
+                combined = models[0]._combine(
+                    [m for m in models if m is not None]
+                )
+                fold_metrics = combined._transformEvaluate(val_view, evaluator)
+            metrics += np.asarray(fold_metrics) / k
+            self.logger.info(f"fold {fold}: metrics {fold_metrics}")
+
+        best = (
+            int(np.argmax(metrics))
+            if evaluator.isLargerBetter()
+            else int(np.argmin(metrics))
+        )
+        # zero-staging refit: the resident full dataset IS the training set
+        best_model = est.fit(entry.dataset, param_maps[best])
         return CrossValidatorModel(
             bestModel=best_model,
             avgMetrics=list(metrics),
