@@ -99,7 +99,8 @@ run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
 run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
-    tests/test_resilience.py tests/test_no_import_change.py \
+    tests/test_resilience.py tests/test_elastic.py \
+    tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py "$@"
 # guard against a new test file silently missing from the batches: only
@@ -126,6 +127,51 @@ echo "== fault-injection smoke: every recovery path on the CPU mesh =="
 # guard requires it there): this dedicated step keeps the recovery gate
 # visible and runnable in isolation even if the batches are resharded
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+echo "== elastic-recovery smoke: device loss mid-Lloyd shrinks the mesh =="
+# tier-1 marker-safe: a device_lost injection at Lloyd iteration 4 of a
+# checkpointed KMeans fit must (a) complete on the (n-1)-device degraded
+# mesh, (b) resume at iteration 3 instead of restarting (salvage counter),
+# (c) re-stage the dataset exactly ONCE, and (d) land within rtol of the
+# uninterrupted fit's clustering cost.  tests/test_elastic.py covers the
+# whole state machine; this dedicated step keeps the recovery gate
+# visible and runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.parallel.mesh import STAGE_COUNTS, active_devices
+from spark_rapids_ml_tpu.resilience import fault_inject
+from spark_rapids_ml_tpu.resilience.elastic import RECOVERY_METRICS
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6)).astype(np.float32)
+df = pd.DataFrame({"features": list(X)})
+with tempfile.TemporaryDirectory() as ckpt:
+    set_config(checkpoint_dir=ckpt, retry_backoff_s=0.01, retry_jitter=0.0)
+    kw = dict(k=3, seed=7, maxIter=8, tol=0.0)
+    m0 = KMeans(**kw).fit(df)                 # uninterrupted, 8 devices
+    s0 = STAGE_COUNTS["dataset_stagings"]
+    with fault_inject("kmeans_lloyd", "device_lost", times=1, skip=3):
+        m1 = KMeans(**kw).fit(df)             # loses a device at iter 4
+
+stagings = STAGE_COUNTS["dataset_stagings"] - s0
+assert stagings == 2, f"expected exactly one re-staging, saw {stagings - 1}"
+assert len(active_devices()) == 7, active_devices()
+assert RECOVERY_METRICS["meshes_rebuilt"] == 1, RECOVERY_METRICS
+assert RECOVERY_METRICS["iterations_salvaged"] == 3, RECOVERY_METRICS
+np.testing.assert_allclose(m1.inertia_, m0.inertia_, rtol=1e-3)
+print(
+    "elastic smoke OK: resumed at iter 3 on "
+    f"{len(active_devices())} devices, 1 re-staging, "
+    f"cost {m1.inertia_:.2f} vs {m0.inertia_:.2f}"
+)
+EOF
 
 echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="
 # tier-1 marker-safe: byte-exact parity of the pipelined per-device

@@ -118,7 +118,7 @@ _DEFAULTS: Dict[str, Any] = {
     # Deterministic fault injection (resilience/faults.py):
     # "site:kind[:times[:skip]]" comma list, e.g.
     # "fit_kernel:oom:1,transform_dispatch:timeout:1:2".  Kinds: oom,
-    # timeout, preemption, hang.  Empty disables.  Tests use the
+    # timeout, preemption, hang, device_lost.  Empty disables.  Tests use the
     # `fault_inject` context manager instead; this conf arms sites for
     # whole-process runs (CI smoke, bench rehearsals).
     "fault_inject_spec": "",
@@ -179,6 +179,21 @@ _DEFAULTS: Dict[str, Any] = {
     # that cannot fit even after evicting everything is NOT cached (the
     # fit degrades gracefully to the uncached path).
     "device_cache_bytes": 0,
+    # Elastic mesh recovery (resilience/elastic.py): "on" lets a fit that
+    # loses a device mid-iteration SHRINK the mesh to the survivors,
+    # re-stage its data, and resume from its last checkpoint instead of
+    # re-running the whole fit and praying the same device count comes
+    # back (the DrJAX elastic re-planning lesson, PAPERS.md).  "off"
+    # restores the PR-1 behavior: a device loss is handled like a
+    # preemption — reinit_distributed + a full retry on the unchanged
+    # device set.
+    "elastic": "on",
+    # Smallest surviving-device count an elastic recovery may shrink the
+    # mesh to.  Below it the recovery falls back to the full-retry
+    # (preemption) path: a fit squeezed onto too few chips would OOM or
+    # crawl, which is worse than waiting for the scheduler to restore
+    # capacity.
+    "elastic_min_devices": 1,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

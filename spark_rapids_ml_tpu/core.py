@@ -52,24 +52,37 @@ from .resilience import is_oom as _is_oom  # noqa: F401
 
 def _fit_fingerprint(fit_input: FitInput) -> str:
     """Cheap content fingerprint binding an in-memory checkpoint tag to
-    the DATA, not just its shape: two scalar device reductions over the
-    staged arrays (plus the weighted label sum when present).  Without
-    this, a crashed fit's checkpoint would be silently resumed by a
-    same-shaped, same-hyperparameter fit on DIFFERENT data — skipping
-    most of its iterations (the in-file tag check in
-    resilience/checkpoint.py can only refuse what the tag encodes).
-    Streaming fits bind the dataset path instead."""
+    the DATA, not just its shape: scalar device reductions over the
+    staged arrays (plus the label sum when present).  Without this, a
+    crashed fit's checkpoint would be silently resumed by a same-shaped,
+    same-hyperparameter fit on DIFFERENT data — skipping most of its
+    iterations (the in-file tag check in resilience/checkpoint.py can
+    only refuse what the tag encodes).  Streaming fits bind the dataset
+    path instead.
+
+    The reductions are EXACT and mesh-layout-independent: each array is
+    bitcast to same-width integers and summed with modular (wraparound)
+    arithmetic, which is associative + commutative — so the fingerprint
+    is invariant under re-sharding and padding-row changes (padding is
+    +0.0, bit pattern 0).  This is load-bearing for elastic recovery
+    (resilience/elastic.py): a fit resumed on a SHRUNKEN mesh must
+    derive the same tag from its re-staged arrays or its checkpoint is
+    orphaned, and f32 float sums differ in the last ulp per shard count
+    (per-shard partial-sum order changes with the device set)."""
     import jax
     import jax.numpy as jnp
 
-    sx = jax.device_get(jnp.sum(fit_input.X, dtype=jnp.float32))
-    sw = jax.device_get(jnp.sum(fit_input.w, dtype=jnp.float32))
-    parts = [f"sx={float(sx):.9g}", f"swt={float(sw):.9g}"]
+    def _isum(arr) -> int:
+        itype = {1: jnp.int8, 2: jnp.int16, 4: jnp.int32, 8: jnp.int64}[
+            np.dtype(arr.dtype).itemsize
+        ]
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = jax.lax.bitcast_convert_type(arr, itype)
+        return int(jax.device_get(jnp.sum(arr.astype(itype), dtype=itype)))
+
+    parts = [f"sx={_isum(fit_input.X)}", f"swt={_isum(fit_input.w)}"]
     if fit_input.y is not None:
-        sy = jax.device_get(
-            jnp.sum(fit_input.y.astype(jnp.float32) * fit_input.w)
-        )
-        parts.append(f"sy={float(sy):.9g}")
+        parts.append(f"sy={_isum(fit_input.y)}")
     return "|".join(parts)
 
 
@@ -522,7 +535,11 @@ class _TpuEstimator(Estimator, _TpuCaller):
 
     # -- fit orchestration ---------------------------------------------------
 
-    def _run_fit_kernel(self, fit_input: FitInput) -> Dict[str, Any]:
+    def _run_fit_kernel(
+        self,
+        fit_input: FitInput,
+        restage: Optional[Callable[[], FitInput]] = None,
+    ) -> Dict[str, Any]:
         """Dispatch the distributed fit kernel through the resilience
         layer (resilience/): the `fit_kernel` fault-injection site, the
         `guarded` watchdog (`dispatch_deadline_s` — a hang raises a typed
@@ -531,17 +548,55 @@ class _TpuEstimator(Estimator, _TpuCaller):
         re-dispatch, OOM drops the failed dispatch's temporaries and
         re-dispatches, a preemption re-inits `jax.distributed` first —
         and iterative solvers with `checkpoint_dir` set then resume from
-        their per-iteration checkpoint rather than iteration 0."""
+        their per-iteration checkpoint rather than iteration 0.
+
+        `restage` is the elastic-recovery hook: when a DEVICE LOSS is
+        recovered by shrinking the mesh (resilience/elastic.py), the
+        staged inputs must move to the surviving devices before the
+        re-dispatch — the callable rebuilds the FitInput against the
+        degraded mesh (a fresh `_stage_fit_input` of the same host
+        batch).  Without it (or when the recovery falls back to the
+        full-retry path) the re-dispatch reuses the original staging."""
         from .resilience import guarded, maybe_inject, retry_call
+
+        cell = {"fi": fit_input}
+        # the cell owns the staging from here: dropping the parameter
+        # binding (and callers not keeping their own locals) lets a
+        # successful restage actually free the pre-loss arrays
+        fit_input = None  # type: ignore[assignment]
 
         def _kernel() -> Dict[str, Any]:
             maybe_inject("fit_kernel")
-            return self._fit_array(fit_input)
+            return self._fit_array(cell["fi"])
+
+        def _on_device_loss() -> None:
+            from .resilience.elastic import recover_from_device_loss
+
+            if recover_from_device_loss(self.logger) and restage is not None:
+                # the old staging is held for fallback only: a restage
+                # can itself fail (on real hardware a host round-trip
+                # through arrays sharded over the dead chip raises) —
+                # then the retry keeps the original staging and behaves
+                # like the pre-elastic full retry instead of crashing
+                # the fit with an opaque hook error
+                old, cell["fi"] = cell["fi"], None
+                from .tracing import trace
+
+                try:
+                    with trace("elastic_restage", self.logger):
+                        cell["fi"] = restage()
+                except Exception as e:
+                    cell["fi"] = old
+                    self.logger.warning(
+                        f"Elastic restage failed ({type(e).__name__}: "
+                        f"{e}); retrying with the original staging"
+                    )
 
         return retry_call(
             lambda: guarded(_kernel, label="fit_kernel", log=self.logger),
             label="fit_kernel",
             log=self.logger,
+            on_device_loss=_on_device_loss,
         )
 
     def _extract(self, dataset: DatasetLike) -> _ArrayBatch:
@@ -621,25 +676,31 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     "budget or force_streaming_stats set; fitting from "
                     "multi-pass streamed statistics."
                 )
-                return self._fit_streaming(path)
+                return self._run_streaming_fit(path)
         ds_dev = fit_input = None
         try:
             from .resilience import maybe_inject
 
-            maybe_inject("stage_parquet")
-            ds_dev = stage_parquet(
-                path,
-                features_col=fcol,
-                features_cols=fcols,
-                label_col=label_col,
-                weight_col=weight_col,
-                num_workers=self.num_workers,
-                dtype=dtype,
-                label_dtype=self._fit_label_dtype() if label_col else None,
-                chunk_rows=None,
-            )
-            fit_input = self._stage_from_device(ds_dev)
-            return self._run_fit_kernel(fit_input)
+            def _stage_all() -> FitInput:
+                maybe_inject("stage_parquet")
+                ds = stage_parquet(
+                    path,
+                    features_col=fcol,
+                    features_cols=fcols,
+                    label_col=label_col,
+                    weight_col=weight_col,
+                    num_workers=self.num_workers,
+                    dtype=dtype,
+                    label_dtype=self._fit_label_dtype() if label_col else None,
+                    chunk_rows=None,
+                )
+                return self._stage_from_device(ds)
+
+            # no local binding: the kernel runner's cell is the only
+            # owner of the staging, so an elastic restage can free it.
+            # Restage re-ingests the parquet chunks onto the degraded
+            # mesh (the streaming reader re-resolves the mesh).
+            return self._run_fit_kernel(_stage_all(), restage=_stage_all)
         except Exception as e:
             # drop the staged buffers BEFORE any retry — keeping them alive
             # would hold the very HBM whose exhaustion we are recovering from
@@ -676,7 +737,22 @@ class _TpuEstimator(Estimator, _TpuCaller):
             "Device staging exhausted HBM; retrying as a "
             "multi-pass streaming-statistics fit."
         )
-        return self._fit_streaming(path)
+        return self._run_streaming_fit(path)
+
+    def _run_streaming_fit(self, path: str) -> Dict[str, Any]:
+        """Dispatch a multi-pass streaming fit through the retry policy.
+        Streaming fits re-resolve the mesh and re-stage every chunk each
+        epoch, so a device-loss recovery needs no explicit restage hook:
+        the re-dispatched fit lands on the degraded mesh by construction
+        and (with `checkpoint_dir` set) resumes from its last completed
+        iteration."""
+        from .resilience import retry_call
+
+        return retry_call(
+            lambda: self._fit_streaming(path),
+            label="fit_streaming",
+            log=self.logger,
+        )
 
     def _fit(self, dataset: DatasetLike) -> "_TpuModel":
         if self._use_cpu_fallback():
@@ -710,9 +786,23 @@ class _TpuEstimator(Estimator, _TpuCaller):
             with device_profile():
                 if isinstance(dataset, DeviceDataset):
                     with trace("stage_from_device", self.logger):
-                        fit_input = self._stage_from_device(dataset)
+                        # single-element hand-off: popping below leaves
+                        # the kernel runner's cell as the only owner, so
+                        # an elastic restage can free the old staging
+                        staged = [self._stage_from_device(dataset)]
                     with trace("fit_kernel", self.logger):
-                        attrs = self._run_fit_kernel(fit_input)
+                        # elastic restage: the resident DeviceDataset is
+                        # sharded over the PRE-loss mesh, so a recovery
+                        # must round-trip through the host to land the
+                        # rows on the survivors (that fetch can fail on
+                        # real hardware — the runner then falls back to
+                        # the original staging)
+                        attrs = self._run_fit_kernel(
+                            staged.pop(),
+                            restage=lambda: self._stage_fit_input(
+                                dataset.to_host_batch()
+                            ),
+                        )
                 else:
                     from .config import get_config
                     from .streaming import is_parquet_path
@@ -727,9 +817,13 @@ class _TpuEstimator(Estimator, _TpuCaller):
                         attrs = self._maybe_fit_sparse_stats(batch)
                     if attrs is None:
                         with trace("stage", self.logger):
-                            fit_input = self._stage_fit_input(batch)
+                            # hand-off list: see the DeviceDataset branch
+                            staged = [self._stage_fit_input(batch)]
                         with trace("fit_kernel", self.logger):
-                            attrs = self._run_fit_kernel(fit_input)
+                            attrs = self._run_fit_kernel(
+                                staged.pop(),
+                                restage=lambda: self._stage_fit_input(batch),
+                            )
         finally:
             if exchange_cleanup:
                 import shutil
@@ -770,19 +864,41 @@ class _TpuEstimator(Estimator, _TpuCaller):
 
         if single_pass:
             if isinstance(dataset, DeviceDataset):
-                fit_input = estimator._stage_from_device(dataset)
+                staged = {"fi": estimator._stage_from_device(dataset)}
+
+                def _restage() -> FitInput:
+                    return estimator._stage_fit_input(dataset.to_host_batch())
+
             else:
                 if batch is None:
                     batch = estimator._extract(dataset)
                 estimator._validate_input(batch)
-                fit_input = estimator._stage_fit_input(batch)
+                staged = {"fi": estimator._stage_fit_input(batch)}
+
+                def _restage() -> FitInput:
+                    return estimator._stage_fit_input(batch)
 
             def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
                 est_i = estimator.copy(paramMaps[index])
-                fi = FitInput(
-                    **{**fit_input.__dict__, "params": dict(est_i._tpu_params)}
+
+                def _with_params(fi: FitInput) -> FitInput:
+                    return FitInput(
+                        **{**fi.__dict__, "params": dict(est_i._tpu_params)}
+                    )
+
+                def _elastic_restage() -> FitInput:
+                    # elastic device-loss recovery mid-grid: re-stage
+                    # onto the degraded mesh and PUBLISH the new staging
+                    # so the remaining param maps fit from it instead of
+                    # the arrays sharded over the lost device (a benign
+                    # race: a concurrent fit holding the old staging
+                    # just fails once more and restages again)
+                    staged["fi"] = _restage()
+                    return _with_params(staged["fi"])
+
+                attrs = est_i._run_fit_kernel(
+                    _with_params(staged["fi"]), restage=_elastic_restage
                 )
-                attrs = est_i._run_fit_kernel(fi)
                 model = est_i._create_model(attrs)
                 est_i._copyValues(model, paramMaps[index])
                 return index, model
@@ -1111,6 +1227,22 @@ class _TpuModel(Model, _TpuCaller):
                 _default_preemption_hook()
                 self.logger.warning(
                     f"Transform dispatch preempted; resuming at row {lo}"
+                )
+            elif action == "device_loss":
+                from .resilience.elastic import recover_from_device_loss
+
+                if recover_from_device_loss(self.logger):
+                    # shrink to the surviving mesh: every remaining chunk
+                    # stages fresh per dispatch, so adopting the rebuilt
+                    # mesh is the whole repair (no resident state to move)
+                    mesh = get_mesh(
+                        self._num_workers if jax.process_count() == 1 else None
+                    )
+                    n_dev = mesh.devices.size
+                    chunk = _floor_chunk(chunk)
+                self.logger.warning(
+                    f"Transform dispatch lost a device; resuming at row "
+                    f"{lo} on {mesh.devices.size} device(s)"
                 )
             else:  # transient
                 delay = policy.backoff(transient_attempts)

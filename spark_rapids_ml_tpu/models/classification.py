@@ -466,9 +466,12 @@ class LogisticRegression(
 
             # m (lbfgs_memory) is shape-critical: the checkpointed S/Y
             # history buffers are (m, n), so a resume under a different m
-            # must tag-mismatch and start fresh, not broadcast-fail
+            # must tag-mismatch and start fresh, not broadcast-fail.
+            # n binds n_valid, never the padded shape: padding depends on
+            # the device count, and an elastic resume on a shrunken mesh
+            # must derive the same tag (resilience/elastic.py)
             ckpt_tag = (
-                f"logreg-mem|n={int(fit_input.X.shape[0])}"
+                f"logreg-mem|n={int(fit_input.n_valid)}"
                 f"|d={fit_input.pdesc.n}|C={n_classes}|l2={l2}|l1={l1}"
                 f"|int={fit_intercept}|std={standardization}|mi={max_iter}"
                 f"|m={int(p.get('lbfgs_memory', 10))}"

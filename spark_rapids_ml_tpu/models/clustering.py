@@ -237,8 +237,12 @@ class KMeans(KMeansClass, _TpuEstimator, _KMeansTpuParams):
         if ckpt_dir:
             from ..core import _fit_fingerprint
 
+            # the tag binds n_valid, never the PADDED shape: padding is a
+            # function of the device count, and an elastic resume on a
+            # shrunken mesh (resilience/elastic.py) must derive the SAME
+            # tag from its re-staged input to find the checkpoint
             ckpt_tag = (
-                f"kmeans-mem|n={int(fit_input.X.shape[0])}"
+                f"kmeans-mem|n={int(fit_input.n_valid)}"
                 f"|d={fit_input.pdesc.n}|k={k}|seed={seed}"
                 f"|mi={max_iter}|tol={p['tol']}|{_fit_fingerprint(fit_input)}"
             )

@@ -10,15 +10,20 @@ from .mesh import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
     RowStager,
+    active_devices,
+    exclude_devices,
     get_mesh,
     replicate,
+    restore_devices,
     shard_rows,
     data_pspec,
     replicated_pspec,
 )
 from .context import (  # noqa: F401
+    DeviceLoss,
     TpuContext,
     init_distributed,
+    probe_device_health,
     reinit_distributed,
     shutdown_distributed,
 )

@@ -27,6 +27,51 @@ from .mesh import get_mesh
 _distributed_initialized = False
 
 
+class DeviceLoss(RuntimeError):
+    """One or more devices vanished mid-fit (spot reclaim of a worker's
+    chips, ICI/PCIe failure).  Typed — carrying the lost device list —
+    so the elastic recovery layer (resilience/elastic.py) can shrink the
+    mesh to the survivors instead of treating the failure as an opaque
+    crash.  The message is deliberately shaped like the jaxlib runtime
+    error family ('failed to execute ... device') so the string
+    classifier (resilience/retry.py `is_device_loss`) routes real and
+    typed losses identically."""
+
+    def __init__(self, lost_devices) -> None:
+        self.lost_devices = list(lost_devices)
+        ids = [getattr(d, "id", d) for d in self.lost_devices]
+        super().__init__(
+            f"failed to execute on device(s) {ids}: device lost "
+            "(detected by the post-dispatch health probe)"
+        )
+
+
+def probe_device_health(devices=None) -> list:
+    """Cheap post-dispatch health probe: a tiny host->device->host
+    round-trip per device (a scalar, so the probe costs microseconds per
+    chip).  Returns the devices that failed the round-trip — on a
+    healthy mesh, an empty list.  A collective that hung or died only
+    says 'something failed'; this probe turns it into WHICH devices are
+    gone, the input the elastic recovery layer plans its degraded mesh
+    from.  Simulated losses (the `device_lost` fault kind) are layered
+    on top by `resilience.elastic.probe_lost_devices`, which is what
+    recovery paths should call."""
+    import numpy as np
+
+    devices = list(devices) if devices is not None else list(jax.devices())
+    lost = []
+    for d in devices:
+        try:
+            host = np.asarray(
+                jax.device_get(jax.device_put(np.zeros((), np.float32), d))
+            )
+            if host.shape != ():  # pragma: no cover - defensive
+                lost.append(d)
+        except Exception:
+            lost.append(d)
+    return lost
+
+
 def _runtime_initialized() -> bool:
     """Whether the jax distributed runtime is live, across jax versions:
     `jax.distributed.is_initialized()` where it exists, else the

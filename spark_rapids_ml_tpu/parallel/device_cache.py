@@ -77,15 +77,16 @@ def device_data_budget_bytes() -> float:
     """The device-memory budget staged training data is accounted
     against: hbm_bytes * mem_ratio_for_data * n_devices — ONE formula
     shared with `_TpuCaller._over_device_budget` (core.py) so the cache
-    can never believe in more memory than the staging decisions do."""
-    import jax
-
+    can never believe in more memory than the staging decisions do.
+    Counts ACTIVE devices only: after an elastic mesh shrink the lost
+    chips' HBM is gone with them."""
     from ..config import get_config
+    from .mesh import active_devices
 
     return (
         float(get_config("hbm_bytes"))
         * float(get_config("mem_ratio_for_data"))
-        * len(jax.devices())
+        * len(active_devices())
     )
 
 
@@ -590,6 +591,29 @@ def cache_resident_bytes() -> int:
     return _global_cache.claimed_bytes() if _global_cache is not None else 0
 
 
+def invalidate_for_devices(ids) -> int:
+    """Evict every resident entry whose mesh contains one of the given
+    device ids — the elastic mesh recovery hook (resilience/elastic.py):
+    an entry sharded over a lost device is unreadable, so its registry
+    claim is dropped and the next consumer re-stages onto the shrunken
+    mesh through the pipelined engine (a cache MISS — the new mesh's
+    device set keys a different fingerprint anyway).  Returns the number
+    of entries invalidated."""
+    if _global_cache is None:
+        return 0
+    ids = {int(i) for i in ids}
+    cache = _global_cache
+    with cache._mu:
+        doomed = [
+            fp
+            for fp, e in cache._entries.items()
+            if any(int(d.id) in ids for d in e.mesh.devices.flat)
+        ]
+    for fp in doomed:
+        cache.evict(fp)
+    return len(doomed)
+
+
 def evict_to_fit(need_bytes: float, budget: float) -> None:
     """LRU-evict resident entries until `need_bytes` fits under `budget`
     alongside the remaining residency (no-op when it already fits).
@@ -714,4 +738,5 @@ __all__ = [
     "device_data_budget_bytes",
     "get_device_cache",
     "get_or_stage",
+    "invalidate_for_devices",
 ]

@@ -36,6 +36,52 @@ MODEL_AXIS = "model"
 
 _mesh_cache = {}
 
+# Device ids an elastic recovery (resilience/elastic.py) has removed from
+# service: every future mesh is built from the survivors only.  Lives
+# here — not in the resilience layer — because get_mesh is the single
+# choke point every staging/fit path resolves devices through.
+_excluded_device_ids: set = set()
+
+
+def active_devices() -> list:
+    """The devices meshes may be built from: the visible set minus any
+    the elastic recovery layer has excluded after a device loss."""
+    devices = jax.devices()
+    if not _excluded_device_ids:
+        return list(devices)
+    return [d for d in devices if d.id not in _excluded_device_ids]
+
+
+def excluded_device_ids() -> frozenset:
+    return frozenset(_excluded_device_ids)
+
+
+def exclude_devices(ids) -> None:
+    """Remove devices from every FUTURE mesh (elastic mesh recovery:
+    the survivors of a device loss form the degraded mesh).  Cached
+    meshes containing an excluded device are dropped so the next
+    `get_mesh` rebuilds from the survivors; arrays already sharded over
+    a lost device stay untouched — their consumers re-stage."""
+    _excluded_device_ids.update(int(i) for i in ids)
+    for key in list(_mesh_cache):
+        if any(d in _excluded_device_ids for d in key[1]):
+            del _mesh_cache[key]
+
+
+def restore_devices() -> None:
+    """Clear every elastic exclusion (tests; operator reset after the
+    lost hardware came back — the next fit sees the full device set)."""
+    _excluded_device_ids.clear()
+
+
+def drop_staging_programs() -> None:
+    """Forget the compiled staging programs: the donated single-device
+    updaters and the global bounded-upload pair bind CONCRETE devices,
+    so after a mesh rebuild they must re-lower for the surviving device
+    set instead of dispatching to a dead chip."""
+    _shard_update_fns.cache_clear()
+    _chunked_upload_fns.cache_clear()
+
 
 def bucket_rows(n: int) -> int:
     """Smallest {1, 1.5} x 2^k >= n (min 256): the shape-bucketing grid.
@@ -69,17 +115,37 @@ def bucket_rows_floor(n: int) -> int:
 
 
 def get_mesh(num_workers: Optional[int] = None) -> Mesh:
-    """A 1-D mesh over the first `num_workers` visible devices.  `num_workers`
-    is the analog of the reference's `num_workers` (= #GPUs = #barrier tasks,
-    reference params.py:556-588); on TPU it is the number of chips
-    participating in the SPMD fit."""
-    devices = jax.devices()
+    """A 1-D mesh over the first `num_workers` ACTIVE devices (visible
+    minus elastic exclusions).  `num_workers` is the analog of the
+    reference's `num_workers` (= #GPUs = #barrier tasks, reference
+    params.py:556-588); on TPU it is the number of chips participating
+    in the SPMD fit."""
+    devices = active_devices()
+    if not devices:
+        raise RuntimeError(
+            "no devices left after elastic exclusions "
+            f"({sorted(_excluded_device_ids)}); call "
+            "parallel.mesh.restore_devices() once the hardware is back"
+        )
     n = num_workers or len(devices)
     if n > len(devices):
-        raise ValueError(
-            f"num_workers={n} exceeds the {len(devices)} visible devices. "
-            f"On multi-host pods initialize jax.distributed first."
-        )
+        if _excluded_device_ids:
+            # elastic degraded mode: the requested width counts devices a
+            # recovery removed from service — shrink to the survivors
+            # rather than failing a fit the recovery just salvaged
+            from ..utils import get_logger
+
+            get_logger("mesh").warning(
+                f"num_workers={n} exceeds the {len(devices)} surviving "
+                f"device(s) (excluded: {sorted(_excluded_device_ids)}); "
+                "running on the degraded mesh"
+            )
+            n = len(devices)
+        else:
+            raise ValueError(
+                f"num_workers={n} exceeds the {len(devices)} visible devices. "
+                f"On multi-host pods initialize jax.distributed first."
+            )
     key = (n, tuple(d.id for d in devices[:n]))
     if key not in _mesh_cache:
         _mesh_cache[key] = Mesh(np.array(devices[:n]), (DATA_AXIS,))

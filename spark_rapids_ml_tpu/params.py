@@ -596,9 +596,12 @@ class _TpuParams(_TpuClass, Params):
     @staticmethod
     def _infer_num_workers() -> int:
         try:
-            import jax
+            # active devices, not all visible ones: after an elastic mesh
+            # recovery (resilience/elastic.py) the lost chips are excluded
+            # from service and an inferred width must count the survivors
+            from .parallel.mesh import active_devices
 
-            return len(jax.devices())
+            return len(active_devices())
         except Exception:  # pragma: no cover
             return 1
 

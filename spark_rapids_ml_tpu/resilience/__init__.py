@@ -21,6 +21,12 @@
 #                  naming, atomic tmp + os.replace, rank-0 writer) lifted
 #                  out of streaming.py and shared by every iterative
 #                  solver loop.
+#   elastic.py     elastic mesh recovery: a classified DEVICE LOSS
+#                  shrinks the mesh to the survivors
+#                  (parallel/mesh.py exclusions), invalidates resident
+#                  cache entries for re-staging, and lets checkpointed
+#                  solvers resume at iteration k on the smaller mesh —
+#                  instead of the blind full retry.
 #
 # The layer imports neither jax nor numpy at module scope: arming faults
 # or reading a policy must not pay the multi-second jax import.
@@ -31,12 +37,21 @@ from .checkpoint import (  # noqa: F401
     load_checkpoint,
     resolve_checkpoint_dir,
     save_checkpoint,
+    sweep_orphaned_tmps,
+)
+from .elastic import (  # noqa: F401
+    RECOVERY_METRICS,
+    probe_lost_devices,
+    recover_from_device_loss,
+    reset_elastic,
+    simulate_device_loss,
 )
 from .faults import SimulatedPreemption, fault_inject, maybe_inject  # noqa: F401
 from .guard import DispatchTimeout, guarded  # noqa: F401
 from .retry import (  # noqa: F401
     RetryPolicy,
     classify_error,
+    is_device_loss,
     is_oom,
     is_preemption,
     is_remote_compile_flake,
@@ -46,6 +61,7 @@ from .retry import (  # noqa: F401
 
 __all__ = [
     "DispatchTimeout",
+    "RECOVERY_METRICS",
     "RetryPolicy",
     "SimulatedPreemption",
     "checkpoint_file_for",
@@ -53,13 +69,19 @@ __all__ = [
     "clear_checkpoint",
     "fault_inject",
     "guarded",
+    "is_device_loss",
     "is_oom",
     "is_preemption",
     "is_remote_compile_flake",
     "is_transient",
     "load_checkpoint",
     "maybe_inject",
+    "probe_lost_devices",
+    "recover_from_device_loss",
+    "reset_elastic",
     "resolve_checkpoint_dir",
     "retry_call",
     "save_checkpoint",
+    "simulate_device_loss",
+    "sweep_orphaned_tmps",
 ]

@@ -21,6 +21,43 @@ from ..utils import get_logger
 logger = get_logger("spark_rapids_ml_tpu.resilience")
 
 
+# a *.tmp.npz younger than this is presumed to be a CONCURRENT save
+# still between its np.savez and os.replace — sweeping it would break
+# that save; anything older is a crash leftover (the replace is
+# milliseconds after the savez finishes)
+_TMP_SWEEP_AGE_S = 60.0
+
+
+def sweep_orphaned_tmps(ckpt_dir: str) -> int:
+    """Remove orphaned `*.tmp.npz` files from a checkpoint dir: a crash
+    BETWEEN `np.savez` and `os.replace` (save_checkpoint) leaks the tmp
+    forever — nothing ever resolves to the `.tmp.npz` name, so without
+    this sweep a long-lived shared checkpoint dir accretes dead files on
+    every unlucky crash.  Age-guarded (`_TMP_SWEEP_AGE_S`) so an
+    in-flight save from another rank/process is never swept; writer rank
+    only, like every other mutation of the shared dir.  Returns the
+    number of files removed."""
+    if not ckpt_dir or not _is_writer():
+        return 0
+    import glob
+    import time
+
+    removed = 0
+    for tmp in glob.glob(os.path.join(ckpt_dir, "*.tmp.npz")):
+        try:
+            if time.time() - os.path.getmtime(tmp) >= _TMP_SWEEP_AGE_S:
+                os.remove(tmp)
+                removed += 1
+        except OSError:
+            continue  # another sweeper/raced writer got there first
+    if removed:
+        logger.info(
+            f"Swept {removed} orphaned checkpoint tmp file(s) from "
+            f"{ckpt_dir}"
+        )
+    return removed
+
+
 def resolve_checkpoint_dir(streaming: bool = False) -> str:
     """The effective checkpoint directory; empty string = off.
 
@@ -30,11 +67,18 @@ def resolve_checkpoint_dir(streaming: bool = False) -> str:
     would silently reroute every small fit of an existing
     streaming-checkpoint user onto the slower per-iteration host-dispatched
     solvers (`checkpoint_dir` forces stepwise, see ops/kmeans.py
-    `kmeans_fit_auto`)."""
+    `kmeans_fit_auto`).
+
+    Resolution also sweeps orphaned `*.tmp.npz` leftovers (a crash
+    between savez and replace) — every fit resolves its dir before
+    touching it, so the sweep needs no separate maintenance hook."""
     d = get_config("checkpoint_dir")
     if not d and streaming:
         d = get_config("streaming_checkpoint_dir")
-    return str(d or "")
+    d = str(d or "")
+    if d and os.path.isdir(d):
+        sweep_orphaned_tmps(d)
+    return d
 
 
 def checkpoint_file_for(ckpt_dir: str, tag: str) -> str:
@@ -97,6 +141,14 @@ def load_checkpoint(path: str, tag: str) -> Optional[Dict[str, object]]:
             "(tag mismatch)"
         )
         return None
+    if "it" in state:
+        # the first resume after an elastic mesh rebuild is the
+        # recovery's payoff — attribute the salvaged iterations
+        # (resilience/elastic.py gates on its own pending flag, so
+        # ordinary crash-restart resumes cost one no-op call)
+        from .elastic import note_checkpoint_resume
+
+        note_checkpoint_resume(int(np.asarray(state["it"])))
     return state
 
 

@@ -1,0 +1,281 @@
+#
+# Elastic mesh recovery — shrink, re-stage, resume.  On shared TPU
+# fleets the dominant mid-fit failure is a device going away (spot
+# reclaim of one worker's chips, an ICI link dying): PR-1's resilience
+# layer could only answer with a blind `reinit_distributed` + FULL
+# retry, re-running every completed iteration and assuming the same
+# device count comes back.  Elastic execution frameworks (DrJAX's
+# re-planning over a changed device set; Snap ML keeping partial solver
+# state local so node loss never restarts global work — PAPERS.md) show
+# the better contract, implemented here as a three-step state machine:
+#
+#   DETECT   the retry classifier types the failure (`is_device_loss`,
+#            retry.py) and the post-dispatch health probe
+#            (parallel/context.py `probe_device_health`) names WHICH
+#            devices are gone — an opaque crash becomes a plan input;
+#   SHRINK   `recover_from_device_loss` removes the lost devices from
+#            service (parallel/mesh.py `exclude_devices` — every future
+#            `get_mesh` builds from the survivors), drops the compiled
+#            staging programs bound to the dead chips
+#            (`drop_staging_programs`) so donated-buffer updaters
+#            re-lower for the new shard count, and invalidates resident
+#            cache entries staged over them
+#            (parallel/device_cache.py `invalidate_for_devices`) so the
+#            next consumer re-stages through the pipelined engine;
+#   RESUME   the retry loop re-dispatches: the caller re-stages its
+#            inputs onto the degraded mesh (core.py `_run_fit_kernel`'s
+#            restage hook) and the checkpointed iterative solvers
+#            (KMeans Lloyd, L-BFGS, FISTA, epoch streaming) reload
+#            their last `resilience/checkpoint.py` state — the tags are
+#            mesh-layout-independent by construction — and continue
+#            from iteration k on the smaller mesh instead of restarting
+#            at 0.
+#
+# Gates: the `elastic` conf ("off" restores the PR-1 full-retry path
+# unchanged) and `elastic_min_devices` (shrinking below it falls back —
+# a fit squeezed onto too few chips is worse than waiting for
+# capacity).  Every transition emits an `elastic_recovery[...]` trace
+# event and bumps `RECOVERY_METRICS`.
+#
+# Testability: the whole state machine is drivable on the CPU test mesh
+# — the `device_lost` fault kind (faults.py) raises the jaxlib-shaped
+# error AND registers a simulated loss here, so `probe_lost_devices`
+# reports it exactly like a failed hardware probe.  No wall clocks, no
+# real hardware.
+#
+# Real-hardware caveat: on current TPU runtimes a physically lost chip
+# often poisons the whole backend client; the shrink path then engages
+# after the runtime re-bootstrap (the preemption hook runs first on
+# those error shapes).  The state machine itself is runtime-agnostic —
+# it plans from whatever the probe reports.
+#
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..config import get_config
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+_lock = threading.Lock()
+
+# cumulative process-wide recovery counters (tests, bench, operators):
+#   losses_detected      devices the probe confirmed gone
+#   meshes_rebuilt       successful shrink-to-survivors recoveries
+#   iterations_salvaged  solver iterations a post-recovery checkpoint
+#                        resume did NOT have to re-run
+#   full_retry_fallbacks losses handled by the PR-1 full-retry path
+#                        (elastic off / below elastic_min_devices)
+RECOVERY_METRICS: Dict[str, int] = {
+    "losses_detected": 0,
+    "meshes_rebuilt": 0,
+    "iterations_salvaged": 0,
+    "full_retry_fallbacks": 0,
+}
+
+# device ids the `device_lost` fault kind has marked lost — the CPU test
+# mesh has no hardware to actually kill, so the probe layers this
+# registry over the real round-trip probe
+_sim_lost: set = set()
+
+# set by a successful mesh rebuild, consumed by the FIRST checkpoint
+# resume after it: the bridge that lets `iterations_salvaged` attribute
+# resumed iterations to the recovery that made them possible
+_recovery_pending = False
+
+
+def elastic_enabled() -> bool:
+    return str(get_config("elastic")).lower() == "on"
+
+
+def elastic_min_devices() -> int:
+    return max(1, int(get_config("elastic_min_devices")))
+
+
+# ---------------------------------------------------------------------------
+# Simulated losses (the CPU-mesh test hook)
+# ---------------------------------------------------------------------------
+
+
+def simulate_device_loss(device_id: Optional[int] = None) -> int:
+    """Mark a device lost WITHOUT real hardware: the probe reports it
+    exactly like a failed round-trip.  Default: the last still-active
+    device, so repeated injections cascade (8 -> 7 -> 6 ...).  Called by
+    the `device_lost` fault kind (faults.py); tests may call it
+    directly.  Returns the lost device id."""
+    if device_id is None:
+        from ..parallel.mesh import active_devices
+
+        devices = active_devices()
+        candidates = [d.id for d in devices if d.id not in _sim_lost]
+        if not candidates:
+            raise RuntimeError("no active device left to simulate losing")
+        device_id = candidates[-1]
+    with _lock:
+        _sim_lost.add(int(device_id))
+    return int(device_id)
+
+
+def simulated_lost_ids() -> frozenset:
+    with _lock:
+        return frozenset(_sim_lost)
+
+
+def reset_elastic() -> None:
+    """Full reset of the elastic layer (tests; operator reset once lost
+    hardware is back): clears simulated losses, restores excluded
+    devices to service, zeroes the metrics, and drops any pending
+    salvage attribution."""
+    global _recovery_pending
+    from ..parallel.mesh import restore_devices
+
+    with _lock:
+        _sim_lost.clear()
+        for k in RECOVERY_METRICS:
+            RECOVERY_METRICS[k] = 0
+        _recovery_pending = False
+    restore_devices()
+
+
+# ---------------------------------------------------------------------------
+# Detection
+# ---------------------------------------------------------------------------
+
+
+def probe_lost_devices(devices=None) -> List:
+    """The devices of `devices` (default: the active set) that are gone:
+    simulated losses plus every device failing the real health probe
+    (parallel/context.py `probe_device_health`)."""
+    from ..parallel.context import probe_device_health
+    from ..parallel.mesh import active_devices
+
+    devices = list(devices) if devices is not None else active_devices()
+    with _lock:
+        sim = set(_sim_lost)
+    lost = [d for d in devices if d.id in sim]
+    lost += probe_device_health([d for d in devices if d.id not in sim])
+    return lost
+
+
+def note_checkpoint_resume(it: int) -> None:
+    """Called by `load_checkpoint` on every successful resume carrying
+    an iteration counter: the FIRST resume after a mesh rebuild is the
+    recovery's payoff, recorded as `iterations_salvaged` (iterations the
+    degraded-mesh fit did not have to re-run)."""
+    global _recovery_pending
+    with _lock:
+        if not _recovery_pending:
+            return
+        _recovery_pending = False
+        RECOVERY_METRICS["iterations_salvaged"] += max(int(it), 0)
+    from ..tracing import event
+
+    event("elastic_recovery[resumed]", detail=f"it={int(it)}", log=logger)
+
+
+# ---------------------------------------------------------------------------
+# The recovery state machine
+# ---------------------------------------------------------------------------
+
+
+def recover_from_device_loss(logger_=None) -> bool:
+    """Handle a dispatch failure classified `device_loss`: probe, then
+    either SHRINK the mesh to the survivors (True — the caller should
+    re-stage onto the new mesh and re-dispatch; checkpointed solvers
+    resume at iteration k) or FALL BACK to the PR-1 full-retry path
+    (False — `reinit_distributed` ran, the caller re-dispatches
+    unchanged).  Fallback triggers: `elastic=off`, fewer than
+    `elastic_min_devices` survivors, or a probe that finds every device
+    healthy (a runtime flake that merely looked like a loss)."""
+    global _recovery_pending
+    from ..tracing import event
+
+    lg = logger_ or logger
+    from ..parallel.mesh import active_devices
+
+    devices = active_devices()
+    lost = probe_lost_devices(devices)
+    event(
+        "elastic_recovery[probe]",
+        detail=f"n_dev={len(devices)} lost={[d.id for d in lost]}",
+        log=lg,
+    )
+    if not lost:
+        # the error string looked like a device loss but every device
+        # answers the probe: treat it as the runtime hiccup it was
+        lg.warning(
+            "device-loss-shaped error but all devices answer the health "
+            "probe; falling back to the full-retry (preemption) path"
+        )
+        _fallback_full_retry(lg)
+        return False
+    with _lock:
+        RECOVERY_METRICS["losses_detected"] += len(lost)
+    lost_id_set = {int(d.id) for d in lost}
+    survivors = [d for d in devices if int(d.id) not in lost_id_set]
+    if not elastic_enabled() or len(survivors) < elastic_min_devices():
+        reason = (
+            "elastic=off"
+            if not elastic_enabled()
+            else f"{len(survivors)} survivor(s) < "
+            f"elastic_min_devices={elastic_min_devices()}"
+        )
+        event("elastic_recovery[fallback]", detail=reason, log=lg)
+        lg.warning(
+            f"Device loss ({[d.id for d in lost]}) not recovered "
+            f"elastically ({reason}); full retry on the unchanged device "
+            "set"
+        )
+        _fallback_full_retry(lg)
+        return False
+
+    # -- shrink: survivors-only meshes, re-lowered staging, fresh cache --
+    from ..parallel.device_cache import invalidate_for_devices
+    from ..parallel.mesh import drop_staging_programs, exclude_devices
+
+    lost_ids = [int(d.id) for d in lost]
+    exclude_devices(lost_ids)
+    drop_staging_programs()
+    evicted = invalidate_for_devices(lost_ids)
+    with _lock:
+        RECOVERY_METRICS["meshes_rebuilt"] += 1
+        _recovery_pending = True
+    event(
+        "elastic_recovery[mesh_rebuilt]",
+        detail=(
+            f"lost={lost_ids} n_dev={len(survivors)} "
+            f"cache_evicted={evicted}"
+        ),
+        log=lg,
+    )
+    lg.warning(
+        f"Elastic recovery: lost device(s) {lost_ids}; continuing on "
+        f"{len(survivors)} surviving device(s) "
+        f"({evicted} resident dataset(s) invalidated for re-staging)"
+    )
+    return True
+
+
+def _fallback_full_retry(lg) -> None:
+    """The PR-1 behavior: re-bootstrap jax.distributed and let the
+    retry loop re-dispatch on the unchanged device set."""
+    with _lock:
+        RECOVERY_METRICS["full_retry_fallbacks"] += 1
+    from .retry import _default_preemption_hook
+
+    _default_preemption_hook()
+
+
+__all__ = [
+    "RECOVERY_METRICS",
+    "elastic_enabled",
+    "elastic_min_devices",
+    "note_checkpoint_resume",
+    "probe_lost_devices",
+    "recover_from_device_loss",
+    "reset_elastic",
+    "simulate_device_loss",
+    "simulated_lost_ids",
+]

@@ -42,7 +42,7 @@ class _Fault:
     __slots__ = ("kind", "times", "skip", "seconds")
 
     def __init__(self, kind: str, times: int, skip: int, seconds: float) -> None:
-        if kind not in ("oom", "timeout", "preemption", "hang"):
+        if kind not in ("oom", "timeout", "preemption", "hang", "device_lost"):
             raise ValueError(f"unknown fault kind: {kind!r}")
         self.kind = kind
         self.times = int(times)
@@ -73,7 +73,11 @@ def fault_inject(
     Kinds: `oom` (a RESOURCE_EXHAUSTED RuntimeError), `timeout` (a typed
     DispatchTimeout), `preemption` (SimulatedPreemption), `hang` (sleeps
     `seconds` so the `guarded` watchdog fires — the only kind that needs
-    a positive `dispatch_deadline_s` to become an error).
+    a positive `dispatch_deadline_s` to become an error), `device_lost`
+    (a jaxlib-shaped 'failed to execute ... device' RuntimeError that
+    ALSO registers a simulated loss with resilience/elastic.py, so the
+    health probe reports the device gone and the whole elastic-recovery
+    state machine runs on the CPU test mesh).
     """
     f = _Fault(kind, times, skip, seconds)
     with _lock:
@@ -151,6 +155,19 @@ def maybe_inject(site: str) -> None:
         raise DispatchTimeout(site, fault.seconds)
     if fault.kind == "preemption":
         raise SimulatedPreemption(site)
+    if fault.kind == "device_lost":
+        # mark the device gone FIRST (so the recovery probe finds it),
+        # then fail the dispatch the way jaxlib does when a chip
+        # vanishes mid-execution — the string shape `is_device_loss`
+        # (retry.py) classifies
+        from .elastic import simulate_device_loss
+
+        dev = simulate_device_loss()
+        raise RuntimeError(
+            "INTERNAL: failed to execute XLA Runtime executable: device "
+            f"{dev} has been lost (injected fault at dispatch site "
+            f"'{site}')"
+        )
     # "hang": park inside the dispatch so the guarded watchdog fires; on
     # its own (no deadline armed) this is just a stall, never an error
     time.sleep(fault.seconds)

@@ -13,6 +13,12 @@
 #               (parallel/context.py `reinit_distributed`) and resume —
 #               iterative solvers pick their checkpoint back up
 #               (resilience/checkpoint.py)
+#   device_loss one or more DEVICES vanished but the process lives: the
+#               elastic recovery layer (resilience/elastic.py) shrinks
+#               the mesh to the survivors, the caller re-stages, and
+#               checkpointed solvers resume at iteration k on the
+#               smaller mesh (falls back to the preemption repair when
+#               elastic is off / too few survivors)
 #   fatal       everything else propagates unchanged on the FIRST raise
 #
 from __future__ import annotations
@@ -41,17 +47,55 @@ def is_oom(e: BaseException) -> bool:
 
 def is_preemption(e: BaseException) -> bool:
     """A TPU worker/coordinator went away mid-fit (maintenance event,
-    spot reclaim): the runtime must re-bootstrap before any retry."""
+    spot reclaim): the runtime must re-bootstrap before any retry.
+
+    Beyond the obvious 'preempted' strings, the coordination service
+    surfaces worker death as status-code / transport errors that never
+    say "preempted": `DATA_LOSS` (a restarted worker lost its state),
+    heartbeat timeouts ('... heartbeat timed out' / 'Heartbeat request
+    failed'), and the coordination channel's socket closing under it.
+    Each of those is pinned by a test (tests/test_resilience.py).  Plain
+    user RuntimeErrors that merely mention sockets stay in the
+    `transient` family, and everything unmatched stays fatal."""
     from .faults import SimulatedPreemption
 
     if isinstance(e, SimulatedPreemption):
         return True
     s = str(e)
+    low = s.lower()
     return (
         "preempted" in s
         or "PREEMPTED" in s
+        or "DATA_LOSS" in s
         or "coordinator disconnected" in s
         or "worker has been restarted" in s
+        or ("heartbeat" in low and ("timed out" in low or "failed" in low))
+        or ("coordination" in low and "socket closed" in low)
+    )
+
+
+def is_device_loss(e: BaseException) -> bool:
+    """One or more DEVICES vanished mid-execution (spot reclaim of a
+    worker's chips, an ICI/PCIe failure) — distinct from a whole-worker
+    preemption because the surviving devices can keep working: the
+    elastic recovery layer (resilience/elastic.py) shrinks the mesh and
+    resumes instead of blind-retrying.  Matches the typed
+    `parallel.context.DeviceLoss` (duck-typed on `lost_devices`, so this
+    module never imports jax) and runtime errors that name a DEVICE as
+    lost or invalid ('INTERNAL: failed to execute XLA Runtime
+    executable: device N has been lost', 'device is in an invalid
+    state').  Deliberately NOT a match on 'failed to execute' alone:
+    that wrapper also carries deterministic internal failures (a custom
+    call rejecting, a lowering bug), which must stay fatal on the first
+    raise rather than burn retry rounds re-bootstrapping a healthy
+    runtime.  The misclassification that remains possible (a transient
+    error naming a 'lost device') is recoverable: the health probe finds
+    every device answering and the recovery falls back."""
+    if getattr(e, "lost_devices", None) is not None:
+        return True
+    low = str(e).lower()
+    return "device" in low and (
+        "lost" in low or "is in an invalid state" in low
     )
 
 
@@ -103,7 +147,12 @@ def is_transient(e: BaseException) -> bool:
 
 def classify_error(e: BaseException) -> str:
     """Map an exception to its recovery action:
-    'preemption' | 'oom' | 'transient' | 'fatal'."""
+    'device_loss' | 'preemption' | 'oom' | 'transient' | 'fatal'.
+    Device loss classifies FIRST: the same jaxlib error can carry both a
+    device-loss marker and a coordinator string, and only the
+    device-loss action knows how to keep the survivors working."""
+    if is_device_loss(e):
+        return "device_loss"
     if is_preemption(e):
         return "preemption"
     if is_oom(e):
@@ -117,6 +166,17 @@ def _default_oom_hook() -> None:
     # free the failed dispatch's temporaries before re-dispatching; the
     # caller's staged inputs (deliberately still referenced) survive
     gc.collect()
+
+
+def _default_device_loss_hook() -> None:
+    # the elastic state machine (resilience/elastic.py): shrink the mesh
+    # to the survivors when allowed, else fall back to the preemption
+    # repair — either way the retry loop re-dispatches afterwards.
+    # Callers whose inputs must move to the degraded mesh (core.py
+    # _run_fit_kernel) pass their own hook that ALSO re-stages.
+    from .elastic import recover_from_device_loss
+
+    recover_from_device_loss(logger)
 
 
 def _default_preemption_hook() -> None:
@@ -148,7 +208,9 @@ class RetryPolicy:
     backoff_mult: float = 2.0
     jitter: float = 0.25
     classify: Callable[[BaseException], str] = classify_error
-    retryable: Tuple[str, ...] = ("oom", "transient", "preemption")
+    retryable: Tuple[str, ...] = (
+        "oom", "transient", "preemption", "device_loss",
+    )
     # OOM gets a TIGHTER budget than max_attempts: one gc'd re-dispatch
     # recovers fragmentation/injected faults, but a dataset that genuinely
     # exceeds HBM fails every attempt after minutes of device work each —
@@ -178,12 +240,14 @@ def retry_call(
     log: Optional[object] = None,
     on_oom: Optional[Callable[[], None]] = None,
     on_preemption: Optional[Callable[[], None]] = None,
+    on_device_loss: Optional[Callable[[], None]] = None,
 ) -> Any:
     """Run `fn` under `policy` (default: `RetryPolicy.from_config()`).
 
     Each recovery is surfaced as a `retry[label]` trace event.  `on_oom` /
-    `on_preemption` override the default repair hooks (gc-collect /
-    `reinit_distributed`).  Callers whose recovery mutates loop state the
+    `on_preemption` / `on_device_loss` override the default repair hooks
+    (gc-collect / `reinit_distributed` / the elastic mesh recovery —
+    resilience/elastic.py).  Callers whose recovery mutates loop state the
     policy cannot see (the transform chunk loop in core.py: chunk halving,
     resume-row tracking across a pipelined pending dispatch) apply the
     SAME policy — `RetryPolicy.from_config()`, `classify`, `backoff`, and
@@ -232,6 +296,8 @@ def retry_call(
             (on_oom or _default_oom_hook)()
         elif action == "preemption":
             (on_preemption or _default_preemption_hook)()
+        elif action == "device_loss":
+            (on_device_loss or _default_device_loss_hook)()
         else:  # transient
             time.sleep(policy.backoff(attempt))
         attempt += 1

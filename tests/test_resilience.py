@@ -68,6 +68,38 @@ def test_error_classifiers():
     assert classify_error(ValueError("nope")) == "fatal"
 
 
+def test_preemption_classifier_real_coordinator_strings():
+    """The satellite contract: is_preemption must recognize the REAL
+    coordinator/runtime failure strings a worker death produces — each
+    pinned here verbatim — while plain user RuntimeErrors stay fatal."""
+    # status-code family: a restarted worker lost its coordination state
+    assert is_preemption(RuntimeError("DATA_LOSS: worker state lost"))
+    # heartbeat family: the coordination service stopped hearing from a task
+    assert is_preemption(
+        RuntimeError("coordination service heartbeat timed out")
+    )
+    assert is_preemption(RuntimeError("UNAVAILABLE: Heartbeat request failed"))
+    # transport family: the coordination channel's socket closed under it
+    assert is_preemption(
+        RuntimeError("Coordination service agent: Socket closed before barrier")
+    )
+    # a NON-coordination socket error is transient (backoff), not preemption
+    assert not is_preemption(RuntimeError("UNAVAILABLE: Socket closed"))
+    assert classify_error(RuntimeError("UNAVAILABLE: Socket closed")) == "transient"
+    # plain user errors stay fatal
+    assert not is_preemption(RuntimeError("heartbeat animation glitch"))
+    assert classify_error(RuntimeError("something broke")) == "fatal"
+    # device loss classifies to its OWN action, ahead of preemption
+    dl = RuntimeError(
+        "INTERNAL: failed to execute XLA Runtime executable: device 2 "
+        "has been lost"
+    )
+    from spark_rapids_ml_tpu.resilience import is_device_loss
+
+    assert is_device_loss(dl)
+    assert classify_error(dl) == "device_loss"
+
+
 def test_remote_compile_flake_classifier():
     """The r05 UMAP bench killer — a compile-service HTTP 500 — must back
     off and retry (transient), while genuine compiler rejections and
