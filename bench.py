@@ -947,6 +947,43 @@ def _flush_partial() -> None:
         pass  # a read-only /tmp must not kill the bench
 
 
+def _telemetry_section(name: str, extra: dict, fn):
+    """Run one bench section with its telemetry delta embedded in the
+    section's JSON (`<name>_telemetry`): the changed registry counters
+    (stagings, cache hits, retries, recoveries — telemetry/registry.py)
+    plus the per-stage wall-clock aggregated from the trace spans the
+    section recorded.  BENCH_*.json trajectories then carry per-stage
+    breakdowns, not just section totals.  Telemetry failures never fail
+    the section."""
+    t0 = time.time()
+    try:
+        from spark_rapids_ml_tpu.telemetry import delta, snapshot
+
+        snap = snapshot()
+    except Exception:
+        snap = None
+    try:
+        return fn()
+    finally:
+        if snap is not None:
+            try:
+                from spark_rapids_ml_tpu import tracing
+
+                agg: dict = {}
+                for e in tracing.get_all_trace_events():
+                    if e.kind != "span" or e.t0 < t0:
+                        continue
+                    key = e.name.split("[", 1)[0]
+                    agg[key] = agg.get(key, 0.0) + e.seconds
+                top = sorted(agg.items(), key=lambda kv: -kv[1])[:12]
+                extra[f"{name}_telemetry"] = {
+                    "counters": delta(snap, snapshot()),
+                    "stage_seconds": {k: round(v, 4) for k, v in top},
+                }
+            except Exception:
+                pass
+
+
 def _emit() -> None:
     if _state["printed"]:
         return
@@ -1414,7 +1451,7 @@ def main() -> None:
             _flush_partial()
             continue
         if name == "logreg":
-            _run_logreg()
+            _telemetry_section("logreg", extra, _run_logreg)
             _flush_partial()
             continue
         fn = benches.get(name)
@@ -1422,7 +1459,7 @@ def main() -> None:
             continue
         print(f"bench: {name} ...", file=sys.stderr, flush=True)
         try:
-            fn(extra)
+            _telemetry_section(name, extra, lambda: fn(extra))
         except Exception as e:  # non-headline failures are recorded, not fatal
             extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:200]
         _flush_partial()

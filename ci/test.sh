@@ -46,7 +46,7 @@ mods = [
     "spark_rapids_ml_tpu.tuning", "spark_rapids_ml_tpu.pipeline",
     "spark_rapids_ml_tpu.sklearn_api", "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.streaming", "spark_rapids_ml_tpu.metrics",
-    "spark_rapids_ml_tpu.resilience",
+    "spark_rapids_ml_tpu.resilience", "spark_rapids_ml_tpu.telemetry",
     "benchmark.benchmark_runner", "benchmark.gen_data",
     "benchmark.gen_data_distributed",
 ]
@@ -99,7 +99,7 @@ run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
 run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
-    tests/test_resilience.py tests/test_elastic.py \
+    tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py "$@"
@@ -171,6 +171,57 @@ print(
     f"{len(active_devices())} devices, 1 re-staging, "
     f"cost {m1.inertia_:.2f} vs {m0.inertia_:.2f}"
 )
+EOF
+
+echo "== telemetry smoke: chrome trace + prometheus round-trip =="
+# tier-1 marker-safe: one small fit with telemetry_dir set plus one
+# injected retry must leave (a) a Chrome-trace JSON that PARSES and
+# carries >=1 instant event (the retry marker) tagged with the fit's
+# run_id, (b) a dump_prometheus() page that round-trips through the
+# minimal text-format parser with the retry counter visible, and (c) a
+# fit-report artifact on disk.  tests/test_telemetry.py covers the full
+# matrix; this dedicated step keeps the exporters gate runnable alone.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import glob
+import json
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.resilience import fault_inject
+from spark_rapids_ml_tpu.telemetry import (
+    dump_chrome_trace, dump_prometheus, parse_prometheus,
+)
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(300, 8)).astype(np.float32)
+df = pd.DataFrame({"features": list(X)})
+with tempfile.TemporaryDirectory() as td:
+    set_config(telemetry_dir=td, retry_backoff_s=0.01, retry_jitter=0.0)
+    with fault_inject("fit_kernel", "oom", times=1):
+        m = PCA(k=2).setInputCol("features").setOutputCol("o").fit(df)
+    rep = m.fit_report()
+    arts = glob.glob(f"{td}/fit_PCA_*.json")
+    assert len(arts) == 1 and json.load(open(arts[0]))["run_id"] == rep["run_id"]
+
+trace = json.loads(dump_chrome_trace(run_id=rep["run_id"]))
+instants = [e for e in trace["traceEvents"] if e.get("ph") == "i"]
+assert len(instants) >= 1, "expected >=1 instant marker in the chrome trace"
+assert any(e["name"].startswith("retry[") for e in instants), instants
+assert all(e["args"]["run_id"] == rep["run_id"] for e in instants)
+
+page = dump_prometheus()
+parsed = parse_prometheus(page)
+retry_key = ("spark_rapids_ml_tpu_retries_total",
+             (("action", "oom"), ("label", "fit_kernel")))
+assert parsed[retry_key] >= 1.0, retry_key
+assert rep["resilience"]["retries"] >= 1
+print(f"telemetry smoke OK: {len(instants)} marker(s), "
+      f"{len(parsed)} prometheus samples, report at {rep['run_id']}")
 EOF
 
 echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="

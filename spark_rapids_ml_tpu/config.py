@@ -194,6 +194,21 @@ _DEFAULTS: Dict[str, Any] = {
     # crawl, which is worse than waiting for the scheduler to restore
     # capacity.
     "elastic_min_devices": 1,
+    # Per-fit telemetry reports (telemetry/report.py): when set, every
+    # fit writes `<dir>/fit_<Estimator>_<run_id>.json` — stage timing
+    # tree, bytes staged, cache hits, retries/recoveries, solver loss
+    # curve.  The same dict is reachable as `model.fit_report()`.
+    "telemetry_dir": "",
+    # Opt-in Prometheus scrape endpoint (telemetry/exporters.py): a
+    # stdlib HTTP server on this port serves /metrics with every
+    # registry metric (`spark_rapids_ml_tpu_*` families).  0 = off.
+    "telemetry_port": 0,
+    # Progress heartbeat for long iterative solvers (telemetry/
+    # heartbeat.py): KMeans Lloyd, L-BFGS, FISTA and epoch-streaming
+    # loops log iteration/loss/throughput every this many seconds.
+    # <= 0 silences the log line (the solver progress gauges still
+    # update every iteration).
+    "heartbeat_interval_s": 30.0,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

@@ -107,9 +107,20 @@ class Estimator(Params):
     """pyspark.ml.Estimator-compatible base."""
 
     def fit(self, dataset: DatasetLike, params: Optional[Dict[Param, Any]] = None):
-        if params:
-            return self.copy(params)._fit(dataset)
-        return self._fit(dataset)
+        est = self.copy(params) if params else self
+        # every fit runs under a minted run_id and a root `fit[<Est>]`
+        # span (telemetry/report.py): retries, device-loss recoveries and
+        # checkpoint resumes recorded anywhere below stamp this run, and
+        # the assembled per-fit report lands on the model
+        # (`model.fit_report()`; JSON artifact when `telemetry_dir` is
+        # set)
+        from .telemetry.report import FitTelemetry
+
+        tel = FitTelemetry(type(est).__name__)
+        with tel.span():
+            model = est._fit(dataset)
+        tel.attach(model, log=getattr(est, "logger", None))
+        return model
 
     @abstractmethod
     def _fit(self, dataset: DatasetLike):
@@ -120,9 +131,17 @@ class Transformer(Params):
     """pyspark.ml.Transformer-compatible base."""
 
     def transform(self, dataset: DatasetLike, params: Optional[Dict[Param, Any]] = None):
-        if params:
-            return self.copy(params)._transform(dataset)
-        return self._transform(dataset)
+        from .tracing import current_run_id, run_context
+
+        tr = self.copy(params) if params else self
+        # a TOP-LEVEL transform mints its own run_id; a transform running
+        # inside an active run (Pipeline._fit driving its stages, CV
+        # eval) inherits it, so its spans and retry markers stay attached
+        # to the fit that issued them
+        if current_run_id():
+            return tr._transform(dataset)
+        with run_context(prefix="transform"):
+            return tr._transform(dataset)
 
     @abstractmethod
     def _transform(self, dataset: DatasetLike):
@@ -130,7 +149,14 @@ class Transformer(Params):
 
 
 class Model(Transformer):
-    pass
+    def fit_report(self) -> Optional[Dict[str, Any]]:
+        """The telemetry report of the fit that produced this model
+        (telemetry/report.py): stage timing tree, bytes staged, cache
+        hits, retries/recoveries, solver iteration/loss curve.  None for
+        models not produced by `Estimator.fit` in this process (loaded
+        from disk, hand-built).  The same dict is written to
+        `telemetry_dir` as a JSON artifact when that conf is set."""
+        return getattr(self, "_fit_report", None)
 
 
 # ---------------------------------------------------------------------------
@@ -879,6 +905,8 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     return estimator._stage_fit_input(batch)
 
             def fit_single(index: int) -> Tuple[int, "_TpuModel"]:
+                from .tracing import run_context
+
                 est_i = estimator.copy(paramMaps[index])
 
                 def _with_params(fi: FitInput) -> FitInput:
@@ -896,10 +924,13 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     staged["fi"] = _restage()
                     return _with_params(staged["fi"])
 
-                attrs = est_i._run_fit_kernel(
-                    _with_params(staged["fi"]), restage=_elastic_restage
-                )
-                model = est_i._create_model(attrs)
+                # one run_id per grid member, so a retry/recovery inside
+                # fitMultiple attributes to the param map it interrupted
+                with run_context(prefix="fit"):
+                    attrs = est_i._run_fit_kernel(
+                        _with_params(staged["fi"]), restage=_elastic_restage
+                    )
+                    model = est_i._create_model(attrs)
                 est_i._copyValues(model, paramMaps[index])
                 return index, model
 
@@ -1201,8 +1232,12 @@ class _TpuModel(Model, _TpuCaller):
                             except Exception:
                                 pass  # the original error already surfaced
             lo = resume_at
+            from .resilience.retry import RETRIES
             from .tracing import event
 
+            # same counter family as retry_call: the inline chunk loop
+            # must not diverge from the policy wrapper in the metrics
+            RETRIES.inc(label="transform_dispatch", action=action)
             event(
                 "retry[transform_dispatch]",
                 detail=f"action={action} resume_row={lo}",

@@ -401,12 +401,16 @@ def kmeans_fit_stepwise(
             )
         return acc
 
+    from ..telemetry import Heartbeat
+
+    hb = Heartbeat("kmeans_lloyd", total=max_iter)
     n_iter = start_it
     for n_iter in range(start_it + 1, max_iter + 1):
         maybe_inject("kmeans_lloyd")
         sums, counts, _ = one_pass(C)
         C, shift2 = _lloyd_center_update(C, sums, counts)
         shift2 = float(np.asarray(shift2))  # scalar fetch = sync
+        hb.beat(n_iter, detail=f"shift2={shift2:.3e}")
         if checkpoint_path:
             save_checkpoint(
                 checkpoint_path, checkpoint_tag,

@@ -315,6 +315,9 @@ def lbfgs_minimize_host(
         hist = [float(f + full_term(w))]
         converged = False
         it = 0
+    from ..telemetry import Heartbeat
+
+    hb = Heartbeat("lbfgs", total=max_iter)
     while it < max_iter and not converged:
         maybe_inject("lbfgs_iteration")
         pg = pseudo_grad(w, g)
@@ -357,6 +360,7 @@ def lbfgs_minimize_host(
         w, f, g = w_new, f_new, g_new
         hist.append(new_full)
         it += 1
+        hb.beat(it, loss=new_full)
         if checkpoint_path:
             save_checkpoint(checkpoint_path, checkpoint_tag, {
                 "w": w, "f": f, "g": g, "S": S, "Y": Y,

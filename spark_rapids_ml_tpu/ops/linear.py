@@ -153,6 +153,9 @@ def solve_linear_host(
             from ..tracing import event
 
             event("fista_resume", detail=f"it={start_it}")
+        from ..telemetry import Heartbeat
+
+        hb = Heartbeat("fista", total=max_iter)
         for it in range(start_it, max_iter):
             maybe_inject("linreg_fista")
             grad = G @ z - b + l2 * z
@@ -163,6 +166,7 @@ def solve_linear_host(
             beta = beta_new
             t_mom = t_new
             n_iter = it + 1
+            hb.beat(n_iter, detail=f"delta={delta:.3e}")
             if checkpoint_path:
                 save_checkpoint(
                     checkpoint_path, checkpoint_tag,

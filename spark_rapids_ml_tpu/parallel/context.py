@@ -58,17 +58,29 @@ def probe_device_health(devices=None) -> list:
     recovery paths should call."""
     import numpy as np
 
+    from ..telemetry.registry import counter
+    from ..tracing import trace
+
     devices = list(devices) if devices is not None else list(jax.devices())
     lost = []
-    for d in devices:
-        try:
-            host = np.asarray(
-                jax.device_get(jax.device_put(np.zeros((), np.float32), d))
-            )
-            if host.shape != ():  # pragma: no cover - defensive
+    with trace("device_health_probe"):
+        for d in devices:
+            try:
+                host = np.asarray(
+                    jax.device_get(jax.device_put(np.zeros((), np.float32), d))
+                )
+                if host.shape != ():  # pragma: no cover - defensive
+                    lost.append(d)
+            except Exception:
                 lost.append(d)
-        except Exception:
-            lost.append(d)
+    counter(
+        "device_health_probes_total", "Per-device health round-trips"
+    ).inc(len(devices))
+    if lost:
+        counter(
+            "device_probe_failures_total",
+            "Devices that failed the health round-trip",
+        ).inc(len(lost))
     return lost
 
 

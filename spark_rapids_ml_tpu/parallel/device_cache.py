@@ -48,26 +48,36 @@ from .mesh import (
     get_mesh,
 )
 
-# cumulative registry metrics (also mirrored into mesh.STAGE_COUNTS):
-# read by tests, bench.py `cv_cached`, and operators debugging residency
-CACHE_METRICS: Dict[str, int] = {
-    "hits": 0,
-    "misses": 0,
-    "evictions": 0,
-    "inserts": 0,
-    "resident_bytes": 0,
-    "resident_entries": 0,
-}
+# cumulative cache metrics (also mirrored into mesh.STAGE_COUNTS): read
+# by tests, bench.py `cv_cached`, and operators debugging residency.
+# Now a VIEW over the telemetry registry (the `device_cache{key=...}`
+# Prometheus family) — the mapping surface is unchanged.
+from ..telemetry.registry import dict_view as _dict_view
+
+CACHE_METRICS = _dict_view(
+    "device_cache",
+    "Device-resident dataset cache counters (hits/misses/evictions/...)",
+    initial={
+        "hits": 0,
+        "misses": 0,
+        "evictions": 0,
+        "inserts": 0,
+        "resident_bytes": 0,
+        "resident_entries": 0,
+    },
+)
 
 _lock = threading.Lock()
 
 
 def _note(kind: str, detail: str = "") -> None:
     with _lock:
-        CACHE_METRICS[kind] = CACHE_METRICS.get(kind, 0) + 1
-        mirrored = "cache_" + kind
-        if mirrored in STAGE_COUNTS:
-            STAGE_COUNTS[mirrored] += 1
+        CACHE_METRICS.bump(kind)
+        # the STAGE_COUNTS mirror used to be gated on the key already
+        # existing, which silently dropped any kind whose mirror was
+        # missing (`inserts` drifted unrecorded); bump() creates-at-zero,
+        # and tests/test_telemetry.py asserts the two stay equal
+        STAGE_COUNTS.bump("cache_" + kind)
     from ..tracing import event
 
     event(f"device_cache_{kind}", detail=detail)
@@ -548,8 +558,9 @@ class DeviceDatasetCache:
             # the staging this entry came from ran under a reserve()
             # claim; the entry now carries those bytes itself
             self._pending = max(0, self._pending - entry.nbytes)
-        with _lock:
-            CACHE_METRICS["inserts"] += 1
+        # through _note so the STAGE_COUNTS cache_inserts mirror moves
+        # with it (the drift test pins the pair equal)
+        _note("inserts")
         self._sync_metrics()
 
     def clear(self) -> None:

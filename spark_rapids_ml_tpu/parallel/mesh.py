@@ -279,10 +279,17 @@ def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None,
 # next piece while the current one rides the wire.  Padding rows are
 # never transferred at all — the zeros buffers already hold them.
 
+from ..telemetry.registry import dict_view as _dict_view
+
 # last staging-engine run: bytes, seconds, mb_per_s, host_prep_s,
 # device_put_s, overlap_ratio, pieces, depth, label (read by bench.py's
-# `staging` workload and the parity tests)
-STAGE_METRICS: dict = {}
+# `staging` workload and the parity tests).  Since the telemetry PR this
+# is a VIEW over the process-global metrics registry
+# (telemetry/registry.py) — same mapping surface, but `dump_prometheus`
+# and `snapshot()` export it as the `staging_last{key=...}` family.
+STAGE_METRICS = _dict_view(
+    "staging_last", "Last staging-engine run (bytes/seconds/MB-s/overlap)"
+)
 
 # CUMULATIVE process-wide staging/cache counters (never cleared by a
 # staging run, unlike STAGE_METRICS): `dataset_stagings` counts EVERY
@@ -294,12 +301,17 @@ STAGE_METRICS: dict = {}
 # (parallel/device_cache.py).  bench.py's `cv_cached` section and the
 # cache tests read deltas of these to assert the stagings-per-CV-run
 # contract (2k+1-and-more -> 1).
-STAGE_COUNTS: dict = {
-    "dataset_stagings": 0,
-    "cache_hits": 0,
-    "cache_misses": 0,
-    "cache_evictions": 0,
-}
+STAGE_COUNTS = _dict_view(
+    "staging_counts",
+    "Cumulative staging/cache counters (dataset_stagings, cache_*)",
+    initial={
+        "dataset_stagings": 0,
+        "cache_hits": 0,
+        "cache_misses": 0,
+        "cache_evictions": 0,
+        "cache_inserts": 0,
+    },
+)
 
 
 def note_dataset_staging() -> None:
@@ -500,6 +512,11 @@ def run_staging_pipeline(
         ))
     STAGE_METRICS.clear()
     STAGE_METRICS.update(
+        # absolute completion time: per-fit reports copy these engine
+        # numbers only when the run happened INSIDE the fit's window
+        # (STAGE_METRICS is process-wide last-run state, so without the
+        # stamp a cache-served fit would inherit the previous fit's MB/s)
+        stamp=round(time.time(), 3),
         label=label,
         bytes=writer.bytes_written,
         seconds=round(wall, 4),

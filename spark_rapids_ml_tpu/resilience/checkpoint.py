@@ -120,6 +120,11 @@ def save_checkpoint(path: str, tag: str, state: Dict[str, object]) -> None:
     tmp = path + ".tmp.npz"
     np.savez(tmp, tag=np.asarray(tag), **{k: np.asarray(v) for k, v in state.items()})
     os.replace(tmp, path)
+    from ..telemetry.registry import counter
+
+    counter(
+        "checkpoint_saves_total", "Solver-state checkpoint writes"
+    ).inc()
 
 
 def load_checkpoint(path: str, tag: str) -> Optional[Dict[str, object]]:
@@ -141,6 +146,11 @@ def load_checkpoint(path: str, tag: str) -> Optional[Dict[str, object]]:
             "(tag mismatch)"
         )
         return None
+    from ..telemetry.registry import counter
+
+    counter(
+        "checkpoint_resumes_total", "Solver fits resumed from checkpoint"
+    ).inc()
     if "it" in state:
         # the first resume after an elastic mesh rebuild is the
         # recovery's payoff — attribute the salvaged iterations

@@ -52,7 +52,7 @@
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from ..config import get_config
 from ..utils import get_logger
@@ -68,12 +68,21 @@ _lock = threading.Lock()
 #                        resume did NOT have to re-run
 #   full_retry_fallbacks losses handled by the PR-1 full-retry path
 #                        (elastic off / below elastic_min_devices)
-RECOVERY_METRICS: Dict[str, int] = {
-    "losses_detected": 0,
-    "meshes_rebuilt": 0,
-    "iterations_salvaged": 0,
-    "full_retry_fallbacks": 0,
-}
+# Now a VIEW over the telemetry registry (telemetry/registry.py): the
+# same mapping surface, exported as the `recovery{key=...}` Prometheus
+# family so `dump_prometheus()` always matches these counters.
+from ..telemetry.registry import dict_view as _dict_view
+
+RECOVERY_METRICS = _dict_view(
+    "recovery",
+    "Elastic mesh recovery counters (losses/rebuilds/salvage/fallbacks)",
+    initial={
+        "losses_detected": 0,
+        "meshes_rebuilt": 0,
+        "iterations_salvaged": 0,
+        "full_retry_fallbacks": 0,
+    },
+)
 
 # device ids the `device_lost` fault kind has marked lost — the CPU test
 # mesh has no hardware to actually kill, so the probe layers this

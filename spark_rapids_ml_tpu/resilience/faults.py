@@ -141,8 +141,12 @@ def maybe_inject(site: str) -> None:
                     fault = f
     if fault is None:
         return
+    from ..telemetry.registry import counter
     from ..tracing import event
 
+    counter(
+        "faults_injected_total", "Deterministic fault injections by site"
+    ).inc(site=site, kind=fault.kind)
     event(f"fault_injected[{site}]", detail=fault.kind, log=logger)
     if fault.kind == "oom":
         raise RuntimeError(

@@ -15,9 +15,15 @@ import threading
 from typing import Any, Callable, Optional
 
 from ..config import get_config
+from ..telemetry.registry import counter as _counter
 from ..utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+TIMEOUTS = _counter(
+    "dispatch_timeouts_total",
+    "Watchdog deadline expiries by dispatch label",
+)
 
 
 class DispatchTimeout(RuntimeError):
@@ -87,6 +93,7 @@ def guarded(
     if t.is_alive():
         from ..tracing import event
 
+        TIMEOUTS.inc(label=label)
         event(
             f"dispatch_timeout[{label}]",
             detail=f"deadline={deadline:.1f}s",

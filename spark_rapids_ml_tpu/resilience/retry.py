@@ -30,9 +30,18 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
 
 from ..config import get_config
+from ..telemetry.registry import counter as _counter
 from ..utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+# one counter family for every policy-driven recovery, labeled by the
+# dispatch site and the classified action — the queryable form of the
+# `retry[<label>]` trace events (core.py's inline transform retry loop
+# bumps the same family so the two paths never diverge in the metrics)
+RETRIES = _counter(
+    "retries_total", "Policy-driven dispatch retries by site and action"
+)
 
 
 def is_oom(e: BaseException) -> bool:
@@ -282,6 +291,7 @@ def retry_call(
         # the exception and releases them before the repair hook runs
         from ..tracing import event
 
+        RETRIES.inc(label=label, action=action)
         event(
             f"retry[{label}]",
             detail=f"attempt={attempt} action={action}",

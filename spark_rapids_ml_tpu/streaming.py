@@ -1231,11 +1231,15 @@ def kmeans_streaming_fit(
         logger.info(
             f"Resuming epoch-streaming kmeans at iteration {start_it}"
         )
+    from .telemetry import Heartbeat
+
+    hb = Heartbeat("kmeans_streaming", total=max_iter, log=logger)
     n_iter = start_it
     cost = 0.0
     for n_iter in range(start_it + 1, max_iter + 1):
         maybe_inject("kmeans_lloyd")
         sums, counts, cost = one_pass(C_host)
+        hb.beat(n_iter, loss=cost)
         new_C = np.where(
             counts[:, None] > 0,
             sums / np.where(counts > 0, counts, 1.0)[:, None],

@@ -1,0 +1,83 @@
+#
+# telemetry/ — the unified observability layer.  Four PRs of machinery
+# (staging engine, device cache, retry, elastic recovery) each grew a
+# module-level metric dict and timestamp-less trace events; this package
+# gives them one queryable surface:
+#
+#   registry.py   typed process-global metrics registry
+#                 (Counter/Gauge/Histogram with labels, snapshot/reset).
+#                 The legacy dicts — `mesh.STAGE_METRICS`/`STAGE_COUNTS`,
+#                 `device_cache.CACHE_METRICS`,
+#                 `elastic.RECOVERY_METRICS` — are now thin views over it
+#                 (`dict_view`), so every old caller keeps working while
+#                 the registry exports everything.
+#   exporters.py  Chrome trace-event JSON (loads in Perfetto: one track
+#                 per thread + an instant-marker track for resilience
+#                 events) and Prometheus text format (`dump_prometheus`,
+#                 plus the opt-in stdlib HTTP endpoint gated by the
+#                 `telemetry_port` conf).
+#   report.py     per-fit JSON reports (stage timing tree, bytes staged,
+#                 cache hits, retries/recoveries, solver loss curve) —
+#                 written under `telemetry_dir` and reachable as
+#                 `model.fit_report()`.
+#   heartbeat.py  progress heartbeat for long iterative solvers
+#                 (iteration/loss/throughput every
+#                 `heartbeat_interval_s`).
+#
+# Span correlation lives in tracing.py: every span/instant carries
+# absolute t0/t1, the recording thread id, and the `run_id` core.py
+# mints per fit/transform — so retries, device-loss recoveries and
+# checkpoint resumes land inside the spans they interrupted.
+#
+# Like resilience/, this package imports neither jax nor numpy at module
+# scope: reading a counter must not pay the accelerator import.
+#
+from .exporters import (  # noqa: F401
+    chrome_trace,
+    dump_chrome_trace,
+    dump_prometheus,
+    maybe_start_http_server,
+    parse_prometheus,
+    start_http_server,
+    stop_http_server,
+)
+from .heartbeat import Heartbeat  # noqa: F401
+from .registry import (  # noqa: F401
+    REGISTRY,
+    DictView,
+    Metric,
+    MetricsRegistry,
+    counter,
+    delta,
+    dict_view,
+    gauge,
+    histogram,
+    reset_metrics,
+    snapshot,
+)
+from .report import FitTelemetry, solver_summary, span_tree  # noqa: F401
+
+__all__ = [
+    "DictView",
+    "FitTelemetry",
+    "Heartbeat",
+    "Metric",
+    "MetricsRegistry",
+    "REGISTRY",
+    "chrome_trace",
+    "counter",
+    "delta",
+    "dict_view",
+    "dump_chrome_trace",
+    "dump_prometheus",
+    "gauge",
+    "histogram",
+    "maybe_start_http_server",
+    "parse_prometheus",
+    "reset_metrics",
+    "snapshot",
+    "solver_summary",
+    "span_tree",
+    "start_http_server",
+    "stop_http_server",
+]

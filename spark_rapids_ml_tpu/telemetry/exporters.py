@@ -1,0 +1,350 @@
+#
+# Telemetry exporters — the two formats production tooling already
+# understands:
+#
+#   Chrome trace-event JSON   the recorded spans (tracing.py) as complete
+#                             events, one track per thread, plus an
+#                             instant-event track for the resilience
+#                             markers (retries, injected faults, elastic
+#                             recoveries, checkpoint resumes).  Loads
+#                             directly in Perfetto (ui.perfetto.dev) or
+#                             chrome://tracing.
+#   Prometheus text format    every registry metric (counters, gauges —
+#                             including the legacy dict views — and
+#                             histograms) as `spark_rapids_ml_tpu_*`
+#                             families.  `dump_prometheus()` renders the
+#                             page; `start_http_server` serves it from a
+#                             stdlib http endpoint gated by the
+#                             `telemetry_port` conf (opt-in: 0 = off).
+#
+# A minimal text-format parser (`parse_prometheus`) rides along so tests
+# and the CI smoke can round-trip the dump without a prometheus client
+# dependency.
+#
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# one label pair inside a sample's {...} body; values are quoted with
+# \\ / \" / \n escapes per the exposition format
+_RE_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_RE_ESCAPE = re.compile(r"\\(.)")
+
+
+def _unescape_one(m: "re.Match") -> str:
+    c = m.group(1)
+    return "\n" if c == "n" else c
+
+from .registry import REGISTRY, MetricsRegistry
+
+# every exported family carries the library prefix so a shared scrape
+# endpoint can't collide with the host application's metrics
+PROM_PREFIX = "spark_rapids_ml_tpu_"
+
+# synthetic Chrome-trace thread id for the instant-marker track: real
+# thread ids are pthread handles and never reach this reserved value
+MARKER_TID = 2**31 - 1
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace events (Perfetto / chrome://tracing)
+# ---------------------------------------------------------------------------
+
+
+def chrome_trace(
+    events: Optional[list] = None, run_id: Optional[str] = None
+) -> Dict[str, Any]:
+    """The recorded trace spans as a Chrome trace-event JSON object
+    (`{"traceEvents": [...]}`).  `events` defaults to every thread's
+    buffer (tracing.get_all_trace_events); `run_id` filters to one
+    fit/transform run.  Spans become complete ("X") events on their
+    recording thread's track; instant events (kind="instant") land on a
+    dedicated "resilience markers" track so retries/recoveries stay
+    visible at any zoom level.  Timestamps are absolute epoch
+    microseconds, so traces from concurrent processes align."""
+    from ..tracing import get_all_trace_events
+
+    evs = events if events is not None else get_all_trace_events(run_id)
+    if events is not None and run_id is not None:
+        evs = [e for e in evs if e.run_id == run_id]
+    pid = os.getpid()
+    out: List[Dict[str, Any]] = []
+    tids = {}
+    for e in evs:
+        args: Dict[str, Any] = {}
+        if e.detail:
+            args["detail"] = e.detail
+        if e.run_id:
+            args["run_id"] = e.run_id
+        if getattr(e, "kind", "span") == "instant":
+            out.append(
+                {
+                    "name": e.name,
+                    "ph": "i",
+                    "s": "p",  # process-scoped marker line
+                    "ts": e.t0 * 1e6,
+                    "pid": pid,
+                    "tid": MARKER_TID,
+                    "args": args,
+                }
+            )
+        else:
+            tids.setdefault(e.thread_id, None)
+            out.append(
+                {
+                    "name": e.name,
+                    "ph": "X",
+                    "ts": e.t0 * 1e6,
+                    "dur": max(e.seconds, 0.0) * 1e6,
+                    "pid": pid,
+                    "tid": e.thread_id,
+                    "args": args,
+                }
+            )
+    # track names: one per recording thread + the marker track
+    meta = [
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": MARKER_TID,
+            "args": {"name": "resilience markers"},
+        }
+    ]
+    for i, tid in enumerate(sorted(tids)):
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": f"thread-{i}" if i else "controller"},
+            }
+        )
+    return {"traceEvents": meta + out, "displayTimeUnit": "ms"}
+
+
+def dump_chrome_trace(
+    path: Optional[str] = None,
+    events: Optional[list] = None,
+    run_id: Optional[str] = None,
+) -> str:
+    """`chrome_trace` as a JSON string; also written to `path` when
+    given (atomic tmp + replace, so a concurrent Perfetto load never
+    sees a torn file)."""
+    payload = json.dumps(chrome_trace(events, run_id))
+    if path:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    return payload
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text format
+# ---------------------------------------------------------------------------
+
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+def _escape_label(v: str) -> str:
+    """Prometheus exposition-format label escaping: backslash, quote,
+    newline.  Without it a label value carrying a quote/comma breaks
+    every consumer of the page (including our own parser)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_labels(pairs: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    items = [f'{k}="{_escape_label(v)}"' for k, v in pairs]
+    if extra:
+        items.append(extra)
+    return "{" + ",".join(items) + "}" if items else ""
+
+
+def dump_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Every registry metric in the Prometheus exposition text format
+    (`# HELP` / `# TYPE` headers, `_bucket`/`_sum`/`_count` histogram
+    series).  The legacy dict views (STAGE_COUNTS, CACHE_METRICS,
+    RECOVERY_METRICS, ...) export as gauge families labeled by `key`, so
+    `spark_rapids_ml_tpu_recovery{key="meshes_rebuilt"}` always equals
+    `RECOVERY_METRICS["meshes_rebuilt"]`."""
+    reg = registry or REGISTRY
+    lines: List[str] = []
+    for m in reg.metrics():
+        name = PROM_PREFIX + m.name
+        if m.help:
+            lines.append(f"# HELP {name} {m.help}")
+        lines.append(f"# TYPE {name} {m.kind}")
+        samples = m.samples()
+        if m.kind == "histogram":
+            for lk, h in samples.items():
+                for le, c in zip(m.buckets, h["buckets"]):
+                    extra = 'le="%s"' % le
+                    lines.append(f"{name}_bucket{_fmt_labels(lk, extra)} {c}")
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(lk, inf)} {h['count']}"
+                )
+                lines.append(f"{name}_sum{_fmt_labels(lk)} "
+                             f"{_fmt_value(h['sum'])}")
+                lines.append(f"{name}_count{_fmt_labels(lk)} {h['count']}")
+        else:
+            for lk, v in samples.items():
+                lines.append(f"{name}{_fmt_labels(lk)} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(
+    text: str,
+) -> Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float]:
+    """Minimal text-format parser: `{(name, ((label, value), ...)): v}`.
+    Enough to round-trip `dump_prometheus` in tests/CI without a
+    prometheus client library; raises ValueError on malformed sample
+    lines so a broken dump fails loudly."""
+    out: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, value = line.rpartition(" ")
+        if not head:
+            raise ValueError(f"malformed prometheus sample: {line!r}")
+        labels: Tuple[Tuple[str, str], ...] = ()
+        name = head
+        if head.endswith("}"):
+            name, _, rest = head.partition("{")
+            body = rest[:-1]
+            # escape-aware: values may contain \\, \" and \n (and
+            # commas, which a naive split would sever)
+            pairs = [
+                (k, _RE_ESCAPE.sub(_unescape_one, v))
+                for k, v in _RE_LABEL.findall(body)
+            ]
+            if body and not pairs:
+                raise ValueError(f"malformed label in: {line!r}")
+            labels = tuple(sorted(pairs))
+        out[(name, labels)] = float(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Opt-in stdlib HTTP endpoint (`telemetry_port` conf)
+# ---------------------------------------------------------------------------
+
+_server_lock = threading.Lock()
+_server = None
+
+
+def start_http_server(
+    port: int,
+    registry: Optional[MetricsRegistry] = None,
+    host: str = "127.0.0.1",
+):
+    """Serve `/metrics` (Prometheus text format) from a daemon-thread
+    stdlib HTTP server on `port` (0 = ephemeral; read the bound port off
+    the returned server's `.server_port`).  One server per process —
+    repeat calls return the running one.  Binds LOOPBACK by default:
+    the dump names datasets, staging sizes and failure activity, which
+    must not leak to every network peer of a multi-tenant host — pass
+    `host="0.0.0.0"` deliberately for a cluster-scraped deployment."""
+    global _server
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    with _server_lock:
+        if _server is not None:
+            return _server
+        reg = registry or REGISTRY
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib handler contract
+                if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = dump_prometheus(reg).encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # scrapes must not spam stderr
+                pass
+
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(
+            target=srv.serve_forever, name="telemetry-http", daemon=True
+        )
+        t.start()
+        _server = srv
+        from ..utils import get_logger
+
+        get_logger("spark_rapids_ml_tpu.telemetry").info(
+            f"telemetry endpoint: http://{host}:{srv.server_port}/metrics"
+        )
+        return srv
+
+
+def stop_http_server() -> None:
+    """Shut the endpoint down (tests; operator teardown).  Idempotent."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+
+
+def maybe_start_http_server():
+    """Start the endpoint iff the `telemetry_port` conf is set (> 0) and
+    no server is running yet — the cheap per-fit hook core.py calls.
+    Never raises: an occupied port logs a warning instead of failing the
+    fit it was meant to observe."""
+    from ..config import get_config
+
+    port = int(get_config("telemetry_port") or 0)
+    if port <= 0 or _server is not None:
+        return _server
+    try:
+        return start_http_server(port)
+    except OSError as e:
+        from ..utils import get_logger
+
+        get_logger("spark_rapids_ml_tpu.telemetry").warning(
+            f"telemetry endpoint on port {port} failed to start ({e}); "
+            "metrics stay available via dump_prometheus()"
+        )
+        return None
+
+
+__all__ = [
+    "MARKER_TID",
+    "PROM_PREFIX",
+    "chrome_trace",
+    "dump_chrome_trace",
+    "dump_prometheus",
+    "maybe_start_http_server",
+    "parse_prometheus",
+    "start_http_server",
+    "stop_http_server",
+]

@@ -1,0 +1,351 @@
+#
+# Typed process-global metrics registry — the single surface that absorbs
+# the metric dicts four PRs grew independently (`mesh.STAGE_METRICS` /
+# `STAGE_COUNTS`, `device_cache.CACHE_METRICS`,
+# `elastic.RECOVERY_METRICS`).  Three metric kinds with label support:
+#
+#   Counter    monotonically increasing (retries, faults injected,
+#              checkpoint saves) — `inc(amount, **labels)`
+#   Gauge      settable point-in-time value (resident bytes, solver
+#              iteration) — `set(value, **labels)` / `inc`/`dec`
+#   Histogram  bucketed observations (fit wall seconds) —
+#              `observe(value, **labels)`
+#
+# Values are stored as exact Python numbers (int stays int), so the
+# legacy dict views (`dict_view`) preserve the arithmetic the old
+# module-level dicts had.  `snapshot()` returns a plain nested dict for
+# delta computation (per-fit reports, bench sections); `reset()` zeroes
+# every sample but keeps registrations (and re-seeds view initials).
+# The Prometheus text rendering lives in exporters.py (`dump_prometheus`).
+#
+# Deliberately dependency-free (no jax/numpy at module scope): bumping a
+# counter from the resilience layer must never pay an accelerator import.
+#
+from __future__ import annotations
+
+import threading
+from collections.abc import MutableMapping
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Metric:
+    """One metric family: a name, a kind, and per-labelset samples.
+    Thread-safe through the owning registry's lock."""
+
+    def __init__(
+        self,
+        name: str,
+        kind: str,
+        help: str = "",
+        buckets: Optional[Tuple[float, ...]] = None,
+        lock: Optional[threading.RLock] = None,
+    ) -> None:
+        if kind not in ("counter", "gauge", "histogram"):
+            raise ValueError(f"unknown metric kind: {kind!r}")
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(
+            sorted(buckets or _DEFAULT_BUCKETS)
+        )
+        self._lock = lock or threading.RLock()
+        # counter/gauge: labelset -> number; histogram: labelset ->
+        # {"buckets": [count per le], "sum": float, "count": int}
+        self._samples: Dict[LabelKey, Any] = {}
+
+    # -- counter/gauge -------------------------------------------------------
+
+    def inc(self, amount: Any = 1, **labels: Any) -> None:
+        if self.kind == "histogram":
+            raise TypeError("histograms take observe(), not inc()")
+        if self.kind == "counter" and amount < 0:
+            raise ValueError("counters only increase")
+        key = _label_key(labels)
+        with self._lock:
+            self._samples[key] = self._samples.get(key, 0) + amount
+
+    def dec(self, amount: Any = 1, **labels: Any) -> None:
+        if self.kind != "gauge":
+            raise TypeError("only gauges decrease")
+        self.inc(-amount, **labels)
+
+    def set(self, value: Any, **labels: Any) -> None:
+        if self.kind == "histogram":
+            raise TypeError("histograms take observe(), not set()")
+        with self._lock:
+            self._samples[_label_key(labels)] = value
+
+    def value(self, default: Any = 0, **labels: Any) -> Any:
+        with self._lock:
+            return self._samples.get(_label_key(labels), default)
+
+    # -- histogram -----------------------------------------------------------
+
+    def observe(self, value: float, **labels: Any) -> None:
+        if self.kind != "histogram":
+            raise TypeError(f"{self.kind} metrics take inc()/set()")
+        v = float(value)
+        key = _label_key(labels)
+        with self._lock:
+            h = self._samples.get(key)
+            if h is None:
+                h = self._samples[key] = {
+                    "buckets": [0] * len(self.buckets),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    h["buckets"][i] += 1
+            h["sum"] += v
+            h["count"] += 1
+
+    # -- shared --------------------------------------------------------------
+
+    def samples(self) -> Dict[LabelKey, Any]:
+        with self._lock:
+            return {
+                k: (dict(v, buckets=list(v["buckets"]))
+                    if isinstance(v, dict) else v)
+                for k, v in self._samples.items()
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._samples.clear()
+
+
+class DictView(MutableMapping):
+    """Mapping facade over one gauge family labeled by ``key`` — the
+    back-compat skin for the legacy module-level metric dicts
+    (`mesh.STAGE_COUNTS` et al.).  Every read/write goes straight through
+    the registry, so `dump_prometheus()` and `snapshot()` see the same
+    numbers the old dict callers do; non-numeric values (the staging
+    engine's `label` field) are kept on the view itself, outside the
+    metric samples."""
+
+    def __init__(self, metric: Metric, initial: Optional[dict] = None):
+        self._metric = metric
+        self._initial = dict(initial or {})
+        self._strs: Dict[str, Any] = {}
+        self.seed()
+
+    def seed(self) -> None:
+        """Apply the initial key set WITHOUT clobbering live samples:
+        only missing keys are set.  Registry reset clears samples first
+        (so the initials land), while a re-import/reload that rebuilds a
+        view must not zero counters the process already accumulated."""
+        for k, v in self._initial.items():
+            if k not in self:
+                self[k] = v
+
+    def __getitem__(self, key: str) -> Any:
+        if key in self._strs:
+            return self._strs[key]
+        sentinel = object()
+        v = self._metric.value(default=sentinel, key=key)
+        if v is sentinel:
+            raise KeyError(key)
+        return v
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            self._strs[key] = value
+            with self._metric._lock:
+                self._metric._samples.pop(_label_key({"key": key}), None)
+        else:
+            self._strs.pop(key, None)
+            self._metric.set(value, key=key)
+
+    def __delitem__(self, key: str) -> None:
+        if key in self._strs:
+            del self._strs[key]
+            return
+        with self._metric._lock:
+            lk = _label_key({"key": key})
+            if lk not in self._metric._samples:
+                raise KeyError(key)
+            del self._metric._samples[lk]
+
+    def __iter__(self) -> Iterator[str]:
+        # only this view's own samples — exactly one `key` label; a
+        # stray differently-labeled sample someone registered onto the
+        # same family must not break iteration/len/clear
+        keys = [
+            lk[0][1]
+            for lk in self._metric.samples()
+            if len(lk) == 1 and lk[0][0] == "key"
+        ]
+        keys += [k for k in self._strs if k not in keys]
+        return iter(keys)
+
+    def __len__(self) -> int:
+        return len(list(iter(self)))
+
+    def bump(self, key: str, amount: Any = 1) -> None:
+        """Increment `key`, creating it at 0 first — the drift-proof form
+        of ``view[key] += 1`` (never drops a missing mirror key)."""
+        self[key] = self.get(key, 0) + amount
+
+    def __repr__(self) -> str:  # debugging/reprs in logs
+        return repr(dict(self))
+
+
+class MetricsRegistry:
+    """Process-global metric store: register-once families, snapshot and
+    reset.  One RLock guards registration and every sample mutation."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, Metric] = {}
+        self._views: Dict[str, DictView] = {}
+
+    def _register(
+        self, name: str, kind: str, help: str = "",
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {m.kind}"
+                    )
+                return m
+            m = Metric(name, kind, help, buckets, lock=self._lock)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Metric:
+        return self._register(name, "counter", help)
+
+    def gauge(self, name: str, help: str = "") -> Metric:
+        return self._register(name, "gauge", help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Optional[Tuple[float, ...]] = None,
+    ) -> Metric:
+        return self._register(name, "histogram", help, buckets)
+
+    def dict_view(
+        self, name: str, help: str = "", initial: Optional[dict] = None
+    ) -> DictView:
+        """A legacy-dict facade over a gauge family labeled ``key``.
+        Idempotent per name: a repeat call (module reload, a test
+        re-importing bench.py) returns the SAME view with any new
+        initial keys merged non-destructively — live counters are never
+        zeroed and the view table stays bounded."""
+        metric = self._register(name, "gauge", help)
+        with self._lock:
+            view = self._views.get(name)
+            if view is None:
+                view = DictView(metric, initial)
+                self._views[name] = view
+            elif initial:
+                view._initial.update(initial)
+                view.seed()
+        return view
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Plain nested dict of every sample: {metric: {labelstr: value}}
+        with labelstr ``'k=v,k2=v2'`` (empty string for unlabeled) and
+        histogram values flattened to {"sum", "count"}.  Safe to hold
+        across a fit and diff with `delta`."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for m in self.metrics():
+            fam: Dict[str, Any] = {}
+            for lk, v in m.samples().items():
+                ls = ",".join(f"{k}={val}" for k, val in lk)
+                if isinstance(v, dict):
+                    fam[ls] = {"sum": v["sum"], "count": v["count"]}
+                else:
+                    fam[ls] = v
+            out[m.name] = fam
+        return out
+
+    def reset(self) -> None:
+        """Zero every sample; registrations (and dict-view initial keys)
+        survive."""
+        with self._lock:
+            for m in self._metrics.values():
+                m.clear()
+            for v in self._views.values():
+                v._strs.clear()
+                v.seed()
+
+
+def delta(
+    before: Dict[str, Dict[str, Any]], after: Dict[str, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """Numeric per-sample change between two `snapshot()`s, keeping only
+    samples that moved (per-fit reports, bench section telemetry).
+    Histogram samples diff their {"sum", "count"} pair."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, fam in after.items():
+        prev = before.get(name, {})
+        changed: Dict[str, Any] = {}
+        for ls, v in fam.items():
+            p = prev.get(ls)
+            if isinstance(v, dict):
+                pc = (p or {}).get("count", 0)
+                if v.get("count", 0) != pc:
+                    changed[ls] = {
+                        "count": v.get("count", 0) - pc,
+                        "sum": round(
+                            v.get("sum", 0.0) - (p or {}).get("sum", 0.0), 6
+                        ),
+                    }
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                pv = p if isinstance(p, (int, float)) else 0
+                if v != pv:
+                    changed[ls] = v - pv
+        if changed:
+            out[name] = changed
+    return out
+
+
+# the process-global default registry every module-level view and counter
+# registers with; tests may build private MetricsRegistry instances
+REGISTRY = MetricsRegistry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram
+dict_view = REGISTRY.dict_view
+snapshot = REGISTRY.snapshot
+reset_metrics = REGISTRY.reset
+
+
+__all__ = [
+    "DictView",
+    "Metric",
+    "MetricsRegistry",
+    "REGISTRY",
+    "counter",
+    "delta",
+    "dict_view",
+    "gauge",
+    "histogram",
+    "reset_metrics",
+    "snapshot",
+]

@@ -1,0 +1,285 @@
+#
+# Per-fit telemetry reports — one JSON artifact per fit answering "what
+# did this fit actually do": the stage timing tree (from the run's
+# spans), bytes staged and staging throughput, cache hits/evictions,
+# retries and recoveries (with iterations salvaged), and the solver's
+# iteration count / loss curve.  `core.Estimator.fit` opens a
+# `FitTelemetry` around every fit: it mints the run id (tracing.py
+# `run_context`), snapshots the registry before/after, and — when the
+# `telemetry_dir` conf is set — writes `<dir>/fit_<Est>_<run_id>.json`.
+# The same dict is reachable in-process as `model.fit_report()`.
+#
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .registry import REGISTRY, delta, histogram
+
+_fit_seconds = histogram(
+    "fit_duration_seconds", "Wall-clock seconds per estimator fit"
+)
+
+# model attribute names the solver summary scans, in preference order
+_N_ITER_KEYS = ("n_iter_", "num_iters", "n_iter")
+_LOSS_CURVE_KEYS = ("objective_history", "loss_curve", "hist")
+_FINAL_LOSS_KEYS = ("objective", "inertia_", "cost", "loss")
+
+
+def span_tree(events: List[Any]) -> List[Dict[str, Any]]:
+    """Nest the run's events into a start-ordered tree keyed off each
+    span's recorded depth (instant markers attach as zero-duration
+    leaves).  Events arrive start-sorted from
+    `tracing.get_all_trace_events`."""
+    root: List[Dict[str, Any]] = []
+    stack: List[tuple] = []  # (depth, node)
+    for e in sorted(events, key=lambda e: (e.t0, -e.t1)):
+        node: Dict[str, Any] = {
+            "name": e.name,
+            "t0": round(e.t0, 6),
+            "seconds": round(e.seconds, 6),
+        }
+        if e.detail:
+            node["detail"] = e.detail
+        if getattr(e, "kind", "span") == "instant":
+            node["instant"] = True
+        node["children"] = []
+        while stack and stack[-1][0] >= e.depth:
+            stack.pop()
+        (stack[-1][1]["children"] if stack else root).append(node)
+        stack.append((e.depth, node))
+    # drop empty children arrays for a compact artifact
+    def _prune(nodes: List[Dict[str, Any]]) -> None:
+        for n in nodes:
+            if n["children"]:
+                _prune(n["children"])
+            else:
+                del n["children"]
+
+    _prune(root)
+    return root
+
+
+def solver_summary(model: Any) -> Dict[str, Any]:
+    """Iteration count / loss curve from a fitted model's attributes —
+    generic over the solver families (KMeans `n_iter_`, LogReg
+    `num_iters` + `objective_history`, LinReg diag `n_iter`)."""
+    attrs: Dict[str, Any] = {}
+    getter = getattr(model, "_get_model_attributes", None)
+    if callable(getter):
+        try:
+            attrs = dict(getter() or {})
+        except Exception:
+            attrs = {}
+    out: Dict[str, Any] = {}
+    for k in _N_ITER_KEYS:
+        v = attrs.get(k, getattr(model, k, None))
+        if v is not None:
+            try:
+                out["n_iter"] = int(v)
+                break
+            except (TypeError, ValueError):
+                continue
+    for k in _LOSS_CURVE_KEYS:
+        v = attrs.get(k)
+        if v is not None:
+            try:
+                out["loss_curve"] = [float(x) for x in list(v)]
+                break
+            except (TypeError, ValueError):
+                continue
+    for k in _FINAL_LOSS_KEYS:
+        v = attrs.get(k, getattr(model, k, None))
+        if isinstance(v, (int, float)):
+            out["final_loss"] = float(v)
+            break
+    return out
+
+
+def _view_delta(d: Dict[str, Dict[str, Any]], family: str) -> Dict[str, Any]:
+    """One dict-view family's changed keys from a registry `delta`:
+    {'key=hits': 3} -> {'hits': 3}."""
+    out = {}
+    for ls, v in d.get(family, {}).items():
+        k = ls.split("=", 1)[1] if ls.startswith("key=") else ls
+        out[k] = v
+    return out
+
+
+class FitTelemetry:
+    """The per-fit observability scope `core.Estimator.fit` wraps every
+    fit in: mints the run id, opens the root `fit[<Est>]` span, and after
+    the fit builds the report dict from the run's spans plus registry
+    deltas.
+
+    The registry deltas are process-global: when fits OVERLAP (a caller
+    pulling `fitMultiple` from several threads), each report's
+    staging/cache/recovery sections include the concurrent fits'
+    activity too — the report then carries `"concurrent_fits": true` so
+    the numbers are read as process-level, not per-fit.  The span tree
+    and resilience marker counts stay exact (run-id filtered)."""
+
+    # fits currently inside span(); >1 means the registry deltas span
+    # more than this fit
+    _active = 0
+    _active_lock = threading.Lock()
+
+    def __init__(self, estimator_name: str) -> None:
+        self.estimator = estimator_name
+        self.run_id: str = ""
+        self.report: Optional[Dict[str, Any]] = None
+        self._before: Dict[str, Dict[str, Any]] = {}
+        self._t0 = 0.0
+        self._t1 = 0.0
+        self._overlapped = False
+
+    @contextlib.contextmanager
+    def span(self):
+        from ..tracing import mint_run_id, run_context, trace
+        from .exporters import maybe_start_http_server
+
+        maybe_start_http_server()
+        self.run_id = mint_run_id("fit")
+        self._before = REGISTRY.snapshot()
+        self._t0 = time.time()
+        cls = FitTelemetry
+        with cls._active_lock:
+            cls._active += 1
+            self._overlapped = cls._active > 1
+        try:
+            with run_context(self.run_id):
+                with trace(f"fit[{self.estimator}]"):
+                    yield self
+        finally:
+            with cls._active_lock:
+                self._overlapped = self._overlapped or cls._active > 1
+                cls._active -= 1
+        self._t1 = time.time()
+
+    def _resilience_section(
+        self, events: List[Any], deltas: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        instants = [e for e in events if getattr(e, "kind", "") == "instant"]
+        sec = {
+            "retries": sum(
+                1 for e in instants if e.name.startswith("retry[")
+            ),
+            "faults_injected": sum(
+                1 for e in instants if e.name.startswith("fault_injected[")
+            ),
+            "dispatch_timeouts": sum(
+                1 for e in instants if e.name.startswith("dispatch_timeout[")
+            ),
+            "checkpoint_resumes": sum(
+                1 for e in instants
+                if e.name.endswith("_resume") or e.name == "elastic_recovery[resumed]"
+            ),
+        }
+        rec = _view_delta(deltas, "recovery")
+        if rec:
+            sec["recoveries"] = rec
+            if "iterations_salvaged" in rec:
+                sec["iterations_salvaged"] = rec["iterations_salvaged"]
+        return sec
+
+    def build(self, model: Any = None) -> Dict[str, Any]:
+        """Assemble the report from the run's events + registry deltas.
+        Called once, after `span()` exits.  Reads only the CALLING
+        thread's trace buffer: every event of this run lands there by
+        construction (watchdog workers adopt it; concurrent fits on
+        other threads carry other run ids), so the per-fit cost stays a
+        single bounded-buffer scan, not a cross-thread merge."""
+        from ..tracing import get_trace_events
+
+        events = [
+            e for e in get_trace_events() if e.run_id == self.run_id
+        ]
+        deltas = delta(self._before, REGISTRY.snapshot())
+        wall = max(self._t1 - self._t0, 0.0)
+        _fit_seconds.observe(wall, estimator=self.estimator)
+
+        staging: Dict[str, Any] = _view_delta(deltas, "staging_counts")
+        # the staging engine's throughput numbers are process-wide
+        # LAST-RUN state: copy them only when that run completed inside
+        # this fit's window (the `stamp` key) and no OTHER fit overlapped
+        # it — a cache-served / serial-path / concurrent fit must not
+        # inherit someone else's bytes and MB/s
+        try:
+            from ..parallel.mesh import STAGE_METRICS
+
+            if (
+                not self._overlapped
+                and STAGE_METRICS.get("stamp", 0) >= self._t0
+            ):
+                for k in ("bytes", "mb_per_s", "overlap_ratio", "pieces"):
+                    v = STAGE_METRICS.get(k)
+                    if v is not None:
+                        staging[k] = v
+        except Exception:
+            pass
+
+        report: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "estimator": self.estimator,
+            # set when another fit overlapped this one: the registry
+            # deltas below then include the concurrent fits' activity
+            # (span tree / marker counts stay run-exact)
+            **({"concurrent_fits": True} if self._overlapped else {}),
+            "t0": round(self._t0, 6),
+            "t1": round(self._t1, 6),
+            "wall_s": round(wall, 4),
+            "spans": span_tree(events),
+            "staging": staging,
+            "cache": _view_delta(deltas, "device_cache"),
+            "resilience": self._resilience_section(events, deltas),
+        }
+        solver = solver_summary(model) if model is not None else {}
+        if solver:
+            report["solver"] = solver
+        self.report = report
+        return report
+
+    def attach(self, model: Any, log: Optional[object] = None) -> None:
+        """Build the report, expose it as `model.fit_report()`, and write
+        the JSON artifact when `telemetry_dir` is set.  Never raises —
+        observability must not fail the fit it observed."""
+        try:
+            report = self.build(model)
+        except Exception as e:  # pragma: no cover - defensive
+            _warn(log, f"fit report build failed ({type(e).__name__}: {e})")
+            return
+        try:
+            model._fit_report = report
+        except Exception:
+            pass  # models without assignable attributes keep the artifact
+        from ..config import get_config
+
+        tdir = str(get_config("telemetry_dir") or "")
+        if not tdir:
+            return
+        try:
+            os.makedirs(tdir, exist_ok=True)
+            path = os.path.join(
+                tdir, f"fit_{self.estimator}_{self.run_id}.json"
+            )
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:
+            _warn(log, f"fit report write to {tdir} failed ({e})")
+
+
+def _warn(log: Optional[object], msg: str) -> None:
+    if log is None:
+        from ..utils import get_logger
+
+        log = get_logger("spark_rapids_ml_tpu.telemetry")
+    log.warning(msg)
+
+
+__all__ = ["FitTelemetry", "solver_summary", "span_tree"]

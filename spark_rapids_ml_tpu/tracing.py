@@ -4,10 +4,15 @@
 # per-stage wall-clock logs in ANN, knn.py:1571-1687; benchmark
 # `with_benchmark` wrappers).  Two mechanisms:
 #
-#   - `trace(stage)`: a nestable per-process stage timer.  Events are
-#     recorded in-process (inspect with `get_trace_events` / `summarize`);
-#     at `verbose >= 1` each stage logs its wall-clock on exit, giving the
-#     per-stage timing breakdown the reference's verbose levels provide.
+#   - `trace(stage)`: a nestable per-process stage timer recording SPANS —
+#     absolute t0/t1 timestamps, the recording thread id, and the active
+#     `run_id` (minted per fit/transform by core.py) — so a degraded-mesh
+#     CV run can be reconstructed after the fact.  Events are recorded
+#     in-process (inspect with `get_trace_events` / `summarize`); at
+#     `verbose >= 1` each stage logs its wall-clock on exit.  The
+#     telemetry exporters (telemetry/exporters.py) render the recorded
+#     spans as Chrome trace-event JSON (one track per thread, instant
+#     markers on their own track — loads in Perfetto).
 #   - `profile_dir` config: when set, fits run under `jax.profiler.trace`,
 #     producing a TensorBoard/XProf trace of the actual device execution —
 #     the TPU-native deep-profiling path (there is no cuML logger to
@@ -18,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import uuid
 from dataclasses import dataclass
 from typing import Callable, Iterator, List, Optional
 
@@ -41,12 +47,37 @@ class TraceEvent:
     # instantaneous events (retries, injected faults, dispatch timeouts —
     # resilience/) carry their context here; timed stages leave it empty
     detail: str = ""
+    # -- span fields (this PR): correlation + absolute placement ----------
+    t0: float = 0.0  # absolute start, epoch seconds (time.time clock)
+    t1: float = 0.0  # absolute end; == t0 for instant events
+    thread_id: int = 0  # threading.get_ident() of the recording thread
+    run_id: str = ""  # the fit/transform run this event belongs to
+    kind: str = "span"  # "span" (timed stage) | "instant" (marker)
+
+
+# every thread's record list, registered once at creation so the
+# telemetry exporters can merge a PROCESS-wide view (the lists themselves
+# stay thread-local for lock-free appends; list.append is atomic under
+# the GIL).  Worker threads that adopt a caller's buffer share its
+# already-registered list — no duplicate registration.  Entries hold a
+# WEAK reference to the recording thread and are pruned (lazily, on the
+# next registration) once that thread is gone: a thread-per-request
+# service must not accumulate dead buffers — and their MAX_EVENTS of
+# history — forever.
+_buffers_lock = threading.Lock()
+_buffers: List[tuple] = []  # (thread_name, weakref-to-thread, records)
 
 
 def _records() -> List[TraceEvent]:
     rec = getattr(_tls, "records", None)
     if rec is None:
+        import weakref
+
         rec = _tls.records = []
+        t = threading.current_thread()
+        with _buffers_lock:
+            _buffers[:] = [b for b in _buffers if b[1]() is not None]
+            _buffers.append((t.name, weakref.ref(t), rec))
     return rec
 
 
@@ -62,20 +93,80 @@ def get_trace_events() -> List[TraceEvent]:
     return list(_records())
 
 
+def get_all_trace_events(run_id: Optional[str] = None) -> List[TraceEvent]:
+    """Events recorded on EVERY thread of this process, in start order
+    (parents sort before their children).  `run_id` filters to one
+    fit/transform run.  This is the exporters' view: a guarded dispatch's
+    worker thread adopts its caller's buffer, so cross-thread spans of
+    one run appear exactly once."""
+    with _buffers_lock:
+        bufs = [rec for _, _, rec in _buffers]
+    seen = set()
+    events: List[TraceEvent] = []
+    for rec in bufs:
+        if id(rec) in seen:  # adopted buffers are shared, not duplicated
+            continue
+        seen.add(id(rec))
+        events.extend(list(rec))
+    if run_id is not None:
+        events = [e for e in events if e.run_id == run_id]
+    # (t0, -t1): a parent starts no later than its children and ends no
+    # earlier, so ties break parent-first
+    events.sort(key=lambda e: (e.t0, -e.t1))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Run correlation — one id per fit/transform
+# ---------------------------------------------------------------------------
+
+
+def mint_run_id(prefix: str = "run") -> str:
+    """A fresh globally-unique run id (`<prefix>-<12 hex>`); core.py
+    mints one per fit/transform so retries, device-loss recoveries and
+    checkpoint resumes stamp the run they interrupted."""
+    return f"{prefix}-{uuid.uuid4().hex[:12]}"
+
+
+def current_run_id() -> str:
+    """The run id active on this thread ('' outside any run)."""
+    return getattr(_tls, "run_id", "")
+
+
+@contextlib.contextmanager
+def run_context(
+    run_id: Optional[str] = None, prefix: str = "run"
+) -> Iterator[str]:
+    """Scope a run id onto this thread: every span/event recorded inside
+    carries it.  Nests — an inner fit's run restores the outer run on
+    exit.  `run_id=None` mints a fresh id."""
+    rid = run_id or mint_run_id(prefix)
+    prev = getattr(_tls, "run_id", "")
+    _tls.run_id = rid
+    try:
+        yield rid
+    finally:
+        _tls.run_id = prev
+
+
 def adopt_trace_context() -> Callable[[], None]:
-    """Capture this thread's trace buffer and depth for adoption by a
-    worker thread (resilience/guard.py): the returned thunk, called on the
-    worker, makes its trace()/event() calls land in the CALLER's record
-    list.  Without this the watchdog thread's thread-local storage
-    swallows every event recorded inside a guarded dispatch.  list.append
-    is atomic under the GIL, so a caller reading while an abandoned worker
-    still appends is safe."""
+    """Capture this thread's trace buffer, depth AND run id for adoption
+    by a worker thread (resilience/guard.py): the returned thunk, called
+    on the worker, makes its trace()/event() calls land in the CALLER's
+    record list, at the caller's depth, stamped with the caller's run —
+    so a watchdog-guarded dispatch's stage timings and resilience markers
+    correlate with the fit that issued it.  Without this the watchdog
+    thread's thread-local storage swallows every event recorded inside a
+    guarded dispatch.  list.append is atomic under the GIL, so a caller
+    reading while an abandoned worker still appends is safe."""
     rec = _records()
     depth = getattr(_tls, "depth", 0)
+    run_id = getattr(_tls, "run_id", "")
 
     def _adopt() -> None:
         _tls.records = rec
         _tls.depth = depth
+        _tls.run_id = run_id
 
     return _adopt
 
@@ -85,11 +176,16 @@ def reset_trace() -> None:
 
 
 def summarize() -> str:
-    """Indented per-stage timing table for the recorded events."""
+    """Indented per-stage timing table for the recorded events, rendered
+    in START order (each span carries its t0): a parent prints before its
+    children and siblings print in execution order.  Events used to
+    append on stage EXIT, which printed nested stages before their
+    parents and interleaved siblings misleadingly."""
+    events = sorted(_records(), key=lambda e: (e.t0, -e.t1))
     lines = [
         f"{'  ' * e.depth}{e.name}: {e.seconds:.4f}s"
         + (f" [{e.detail}]" if e.detail else "")
-        for e in _records()
+        for e in events
     ]
     return "\n".join(lines)
 
@@ -97,10 +193,24 @@ def summarize() -> str:
 def event(name: str, detail: str = "", log: Optional[object] = None) -> None:
     """Record an INSTANTANEOUS event (zero-duration TraceEvent) — failure/
     recovery markers from the resilience layer: retries, injected faults,
-    dispatch timeouts, checkpoint resumes.  Always logged at `verbose >= 1`
-    like timed stages."""
+    dispatch timeouts, checkpoint resumes.  Stamped with the active run
+    id, so a recovery marker attributes to the fit it interrupted.
+    Always logged at `verbose >= 1` like timed stages."""
     depth = getattr(_tls, "depth", 0)
-    _append(TraceEvent(name, 0.0, depth, detail))
+    now = time.time()
+    _append(
+        TraceEvent(
+            name,
+            0.0,
+            depth,
+            detail,
+            t0=now,
+            t1=now,
+            thread_id=threading.get_ident(),
+            run_id=getattr(_tls, "run_id", ""),
+            kind="instant",
+        )
+    )
     if int(get_config("verbose") or 0) >= 1:
         suffix = f" [{detail}]" if detail else ""
         (log or logger).info(f"[trace] {'  ' * depth}{name}{suffix}")
@@ -108,16 +218,30 @@ def event(name: str, detail: str = "", log: Optional[object] = None) -> None:
 
 @contextlib.contextmanager
 def trace(name: str, log: Optional[object] = None) -> Iterator[None]:
-    """Time a stage.  Nested stages indent; `verbose >= 1` logs on exit."""
+    """Time a stage.  Nested stages indent; `verbose >= 1` logs on exit.
+    The recorded span carries absolute t0/t1, the recording thread id and
+    the active run id (see `run_context`)."""
     depth = getattr(_tls, "depth", 0)
     _tls.depth = depth + 1
+    t0_abs = time.time()
     t0 = time.perf_counter()
     try:
         yield
     finally:
         dt = time.perf_counter() - t0
         _tls.depth = depth
-        _append(TraceEvent(name, dt, depth))
+        _append(
+            TraceEvent(
+                name,
+                dt,
+                depth,
+                t0=t0_abs,
+                t1=t0_abs + dt,
+                thread_id=threading.get_ident(),
+                run_id=getattr(_tls, "run_id", ""),
+                kind="span",
+            )
+        )
         if int(get_config("verbose") or 0) >= 1:
             (log or logger).info(f"[trace] {'  ' * depth}{name}: {dt:.4f}s")
 

@@ -168,6 +168,18 @@ class CrossValidator(Params):
             raise ValueError(
                 "CrossValidator requires estimator, estimatorParamMaps and evaluator"
             )
+        # the whole CV run correlates under one run_id (the per-fold
+        # `cv_fold[...]` spans and eval events); the member fits below
+        # mint their own nested runs, so a mid-grid recovery still
+        # attributes to the fit it interrupted
+        from .tracing import run_context, trace
+
+        with run_context(prefix="cv"), trace("cross_validate", self.logger):
+            return self._fit_cv(est, evaluator, param_maps, dataset)
+
+    def _fit_cv(
+        self, est, evaluator, param_maps, dataset: DatasetLike
+    ) -> "CrossValidatorModel":
         df = _to_pandas_with_labels(dataset, est)
         n = len(df)
         k = self.getOrDefault("numFolds")
