@@ -925,15 +925,49 @@ def _payload() -> dict:
     }
 
 
+def _history_path() -> str:
+    """BENCH_HISTORY_PATH env, else the `bench_history_path` conf; empty
+    disables history appending."""
+    path = os.environ.get("BENCH_HISTORY_PATH")
+    if path is not None:
+        return path
+    try:
+        from spark_rapids_ml_tpu.config import get_config
+
+        return str(get_config("bench_history_path") or "")
+    except Exception:
+        return ""
+
+
+def _append_history() -> None:
+    """Append this run's completed sections to the bench history
+    (benchmark/history.py) — called at the per-section flush cadence;
+    the append is idempotent per (run_id, section), so each call only
+    adds sections that finished since the last one.  Never fatal."""
+    path = _history_path()
+    if not path:
+        return
+    try:
+        from benchmark.history import append_run
+
+        append_run(_payload(), path)
+    except Exception as e:
+        print(f"bench: history append failed ({type(e).__name__}: {e})",
+              file=sys.stderr, flush=True)
+
+
 def _flush_partial() -> None:
     """Write the current (partial) result JSON to BENCH_PARTIAL_PATH
     after every section, atomically — a later SIGKILL (no TERM grace, no
     stdout line) then still leaves every completed section's numbers on
     disk.  Opt-in (unset = no flush): a fixed default path would let
     concurrent runs on one host clobber each other's salvage file.
-    Children skip it: the supervisor flushes after each merge."""
+    Children skip it: the supervisor flushes after each merge.  The
+    bench-history append shares this cadence (and the child gate: the
+    supervisor owns the run's records)."""
     if os.environ.get("BENCH_CHILD") == "1":
         return
+    _append_history()
     path = os.environ.get("BENCH_PARTIAL_PATH")
     if not path:
         return
@@ -987,6 +1021,8 @@ def _telemetry_section(name: str, extra: dict, fn):
 def _emit() -> None:
     if _state["printed"]:
         return
+    if os.environ.get("BENCH_CHILD") != "1":
+        _append_history()  # the final state, even without partial flushes
     print(json.dumps(_payload()), flush=True)
     # set only after a complete write: a SIGTERM mid-print must not mark
     # the truncated line as already-emitted
@@ -1073,6 +1109,9 @@ def _env_float(name: str, default: float) -> float:
 _MERGE_PARENT_KEYS = frozenset({
     "platform", "isolation", "terminated", "host_loadavg_start",
     "host_loadavg_end", "host_cpus", "contended", "warm_runs_per_timing",
+    # the supervisor's run id keys the whole run's history records; a
+    # child's own stamp must not overwrite it in the merge
+    "bench_run_id",
 })
 
 
@@ -1318,6 +1357,12 @@ def main() -> None:
     from spark_rapids_ml_tpu.config import set_config
 
     _budget_init()
+    # one id per bench run keys the history records (BENCH_RUN_ID lets a
+    # driver correlate its own logs; children inherit the env but their
+    # payloads are merged under the supervisor's id)
+    _state["extra"]["bench_run_id"] = os.environ.setdefault(
+        "BENCH_RUN_ID", f"bench-{int(time.time())}-{os.getpid()}"
+    )
     # fixed benchmark shapes gain nothing from compile-sharing buckets;
     # exact padding keeps rows/sec honest
     set_config(shape_bucketing=False)

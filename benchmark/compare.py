@@ -1,0 +1,286 @@
+#
+# Bench-regression comparator — the gate the bench trajectory never had:
+# BENCH_r{N}.json artifacts accumulated for five PRs with no way to say
+# "did this PR make anything slower".  This tool reads the normalized
+# JSONL history (benchmark/history.py), compares the LATEST run's
+# metrics against the MEDIAN of the last k prior runs, and renders a
+# markdown trajectory table; any directional change past the tolerance
+# band exits nonzero, so CI can gate on it.
+#
+# Noise model: per-metric tolerance bands around a median-of-k baseline.
+# Single-run deltas on shared CI hosts are dominated by scheduler noise
+# (the repo's own tier-1 numbers swing ~±3% run to run; tiny-shape CPU
+# sections swing far more), so the default band is deliberately wide and
+# per-metric overrides (`--band metric=0.5`) let hot metrics gate
+# tighter.  Metrics whose direction is unknown (counts, shape configs)
+# are reported as `info` and never gate.  A first run with no baseline
+# exits 0 ("no baseline yet") — the gate bootstraps itself.
+#
+#   python -m benchmark.compare --history BENCH_HISTORY.jsonl \
+#       [--k 5] [--tolerance 0.35] [--sections staging,logreg] \
+#       [--band logreg_rows_per_sec=0.2] [--markdown-out trajectory.md]
+#
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from .history import (
+    DEFAULT_HISTORY,
+    load_history,
+    runs_in_order,
+)
+
+# suffix rules for metric direction; first match wins
+_LOWER_BETTER = (
+    "_sec",
+    "_seconds",
+    "_stagings_per_run",
+)
+_HIGHER_BETTER = (
+    "_per_sec",
+    "_per_s",
+    "_mb_per_s",
+    "_mb_s",
+    "_qps",
+    "_speedup",
+    "_speedup_x",
+    "_vs_baseline",
+    "_recall",
+    "_ari",
+    "_overlap_ratio",
+)
+_HIGHER_CONTAINS = ("_recall_at_",)
+
+
+def metric_direction(name: str) -> Optional[str]:
+    """"lower" / "higher" = which way is better; None = informational
+    (never gates).  Higher-better suffixes test FIRST: `*_per_sec`
+    throughputs would otherwise match the `_sec` time suffix."""
+    if name.endswith(_HIGHER_BETTER) or any(
+        t in name for t in _HIGHER_CONTAINS
+    ):
+        return "higher"
+    if name.endswith(_LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def compare_runs(
+    current: List[Dict[str, Any]],
+    baseline_runs: List[List[Dict[str, Any]]],
+    tolerance: float = 0.35,
+    bands: Optional[Dict[str, float]] = None,
+    abs_floor: float = 0.0,
+) -> Tuple[List[Dict[str, Any]], bool]:
+    """Compare one run's records against prior runs' records.
+
+    `current`: the latest run's (section, metrics) records.
+    `baseline_runs`: one records-list per PRIOR run (newest last); each
+    metric baselines against the MEDIAN of its values across them.
+    Returns (rows, regressed): one row per current metric with
+    {"section", "metric", "baseline", "n_base", "current", "change",
+    "status"}; status in {"ok", "improved", "regression", "no-baseline",
+    "info"}.  `regressed` is True iff any row regressed.
+
+    `abs_floor`: a regression additionally needs |current - baseline| >
+    abs_floor — a 20 ms metric doubling on a loaded CI host is scheduler
+    jitter, not a regression, and no relative band alone can say so."""
+    bands = bands or {}
+    # metric -> list of prior values (one per run that recorded it)
+    prior: Dict[Tuple[str, str], List[float]] = {}
+    for run in baseline_runs:
+        per_run: Dict[Tuple[str, str], float] = {}
+        for rec in run:
+            for m, v in rec.get("metrics", {}).items():
+                per_run[(rec["section"], m)] = float(v)
+        for key, v in per_run.items():
+            prior.setdefault(key, []).append(v)
+    rows: List[Dict[str, Any]] = []
+    regressed = False
+    for rec in current:
+        section = rec["section"]
+        for metric, value in sorted(rec.get("metrics", {}).items()):
+            value = float(value)
+            base_vals = prior.get((section, metric))
+            direction = metric_direction(metric)
+            row: Dict[str, Any] = {
+                "section": section,
+                "metric": metric,
+                "current": value,
+            }
+            if direction is None:
+                row.update(status="info", baseline=None, change=None,
+                           n_base=len(base_vals or ()))
+                rows.append(row)
+                continue
+            if not base_vals:
+                row.update(status="no-baseline", baseline=None,
+                           change=None, n_base=0)
+                rows.append(row)
+                continue
+            base = statistics.median(base_vals)
+            row["baseline"] = round(base, 6)
+            row["n_base"] = len(base_vals)
+            if base <= 0:
+                row.update(status="info", change=None)
+                rows.append(row)
+                continue
+            change = (value - base) / base  # signed relative change
+            row["change"] = round(change, 4)
+            band = bands.get(metric, tolerance)
+            # "worse" is +change for lower-better metrics, -change for
+            # higher-better ones
+            worse = change if direction == "lower" else -change
+            if worse > band and abs(value - base) > abs_floor:
+                row["status"] = "regression"
+                regressed = True
+            elif worse < -band:
+                row["status"] = "improved"
+            else:
+                row["status"] = "ok"
+            rows.append(row)
+    return rows, regressed
+
+
+def render_markdown(
+    rows: List[Dict[str, Any]],
+    run_id: str,
+    baseline_ids: List[str],
+    tolerance: float,
+) -> str:
+    """The trajectory table, gating metrics first, regressions on top."""
+    order = {"regression": 0, "improved": 1, "ok": 2, "no-baseline": 3,
+             "info": 4}
+    rows = sorted(
+        rows, key=lambda r: (order.get(r["status"], 9), r["section"],
+                             r["metric"])
+    )
+    lines = [
+        f"## Bench trajectory — run `{run_id}`",
+        "",
+        f"Baseline: median of {len(baseline_ids)} prior run(s) "
+        f"(tolerance ±{tolerance:.0%} unless banded per metric).",
+        "",
+        "| section | metric | baseline | current | Δ | status |",
+        "|---|---|---:|---:|---:|---|",
+    ]
+    mark = {"regression": "🔴 regression", "improved": "🟢 improved",
+            "ok": "ok", "no-baseline": "no baseline", "info": "·"}
+    for r in rows:
+        base = "" if r.get("baseline") is None else f"{r['baseline']:g}"
+        chg = (
+            ""
+            if r.get("change") is None
+            else f"{r['change']:+.1%}"
+        )
+        lines.append(
+            f"| {r['section']} | `{r['metric']}` | {base} | "
+            f"{r['current']:g} | {chg} | {mark.get(r['status'], r['status'])} |"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _parse_bands(items: List[str]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for item in items or ():
+        name, _, val = item.partition("=")
+        if not name or not val:
+            raise SystemExit(f"--band expects metric=fraction, got {item!r}")
+        out[name] = float(val)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate the latest bench run against its history."
+    )
+    ap.add_argument("--history", default=DEFAULT_HISTORY,
+                    help="JSONL history file (benchmark/history.py)")
+    ap.add_argument("--k", type=int, default=5,
+                    help="baseline = median of the last k prior runs")
+    ap.add_argument("--tolerance", type=float, default=0.35,
+                    help="default relative tolerance band")
+    ap.add_argument("--band", action="append", default=[],
+                    metavar="METRIC=FRAC",
+                    help="per-metric tolerance override (repeatable)")
+    ap.add_argument("--abs-floor", type=float, default=0.0,
+                    help="regressions additionally need |current - "
+                    "baseline| above this (guards tiny-metric jitter)")
+    ap.add_argument("--sections", default="",
+                    help="comma list; empty = every section present")
+    ap.add_argument("--run-id", default="",
+                    help="run to evaluate (default: newest in history)")
+    ap.add_argument("--markdown-out", default="",
+                    help="also write the trajectory table here")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.history)
+    if not history:
+        print(f"bench-compare: no history at {args.history}; nothing to "
+              "gate (first run).")
+        return 0
+    run_ids = runs_in_order(history)
+    run_id = args.run_id or run_ids[-1]
+    if run_id not in run_ids:
+        print(f"bench-compare: run {run_id!r} not in history", file=sys.stderr)
+        return 2
+    sections = {s for s in args.sections.split(",") if s.strip()}
+
+    def _keep(rec: Dict[str, Any]) -> bool:
+        return not sections or rec["section"] in sections
+
+    current = [r for r in history if r["run_id"] == run_id and _keep(r)]
+    if not current:
+        # a typo'd --sections (or a section that errored out and left no
+        # record) must not silently turn the gate vacuous-green
+        print(
+            f"bench-compare: run {run_id!r} has no records matching "
+            f"sections={sorted(sections) or 'all'} — nothing gated",
+            file=sys.stderr,
+        )
+        return 2
+    # baseline = runs strictly BEFORE the evaluated run: with an explicit
+    # --run-id in the middle of the history, later runs must not leak
+    # into its baseline (a future regression would mask or invert it)
+    prior_ids = run_ids[: run_ids.index(run_id)][-args.k:]
+    baseline_runs = [
+        [r for r in history if r["run_id"] == rid and _keep(r)]
+        for rid in prior_ids
+    ]
+    rows, regressed = compare_runs(
+        current, baseline_runs, tolerance=args.tolerance,
+        bands=_parse_bands(args.band), abs_floor=args.abs_floor,
+    )
+    md = render_markdown(rows, run_id, prior_ids, args.tolerance)
+    print(md)
+    if args.markdown_out:
+        with open(args.markdown_out, "w") as f:
+            f.write(md)
+    if not any(r["status"] not in ("info",) for r in rows):
+        print("bench-compare: no gateable metrics in this run.")
+        return 0
+    if not baseline_runs:
+        print("bench-compare: first run — no baseline yet, not gating.")
+        return 0
+    bad = [r for r in rows if r["status"] == "regression"]
+    if regressed:
+        print(
+            "bench-compare: REGRESSION in "
+            + ", ".join(f"{r['section']}.{r['metric']}" for r in bad),
+            file=sys.stderr,
+        )
+        return 1
+    summary = {
+        s: sum(1 for r in rows if r["status"] == s)
+        for s in ("ok", "improved", "no-baseline")
+    }
+    print(f"bench-compare: within noise ({json.dumps(summary)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

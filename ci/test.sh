@@ -100,6 +100,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
+    tests/test_bench_history.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py "$@"
@@ -281,6 +282,67 @@ EOF
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \
     JAX_PLATFORMS=cpu python bench.py
+
+echo "== perf smoke: bench history + regression gate =="
+# two consecutive tiny-shape runs (logreg headline + staging section)
+# must (a) append exactly one normalized record per section per run to
+# the history file, (b) pass the comparator within noise, and (c) fail
+# it nonzero on an injected 2x slowdown.  benchmark/{history,compare}.py
+# are the units under test; unit coverage is in tests/test_bench_history.py.
+PERF_DIR=$(mktemp -d)
+for i in 1 2; do
+    BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_MAX_ITER=10 \
+    BENCH_WORKLOADS=staging BENCH_STAGING_ROWS=40000 BENCH_ISOLATE=0 \
+    BENCH_PROBE_TIMEOUT=0 BENCH_RUN_ID="perf-smoke-$i" \
+    BENCH_HISTORY_PATH="$PERF_DIR/history.jsonl" \
+    JAX_PLATFORMS=cpu python bench.py > /dev/null
+done
+# within-noise gate: wide band + 50 ms absolute floor for a 2-core
+# shared CI box (a 20 ms metric doubling is scheduler jitter), scoped to
+# the logreg section — the staging section's sub-100ms timings and
+# pipelined-vs-serial ratio are pure scheduler noise at smoke scale
+# (their records still land in the history, asserted below); the
+# cold-fit improvement from run 1 warming the compile cache must not gate
+python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
+    --sections logreg --tolerance 0.75 --abs-floor 0.05
+# record-count contract + the injected-slowdown gate
+python - "$PERF_DIR/history.jsonl" << 'EOF'
+import json, subprocess, sys
+
+path = sys.argv[1]
+records = [json.loads(l) for l in open(path) if l.strip()]
+per_run = {}
+for r in records:
+    per_run.setdefault(r["run_id"], []).append(r["section"])
+assert set(per_run) == {"perf-smoke-1", "perf-smoke-2"}, per_run
+for rid, secs in per_run.items():
+    assert len(secs) == len(set(secs)), f"duplicate section records: {rid}"
+    assert {"logreg", "staging"} <= set(secs), (rid, secs)
+# inject a synthetic 2x slowdown of run 2 and expect the gate to trip
+from benchmark.compare import metric_direction
+
+slow = [json.loads(l) for l in open(path) if l.strip()]
+for r in slow:
+    if r["run_id"] != "perf-smoke-2":
+        continue
+    r2 = dict(r, run_id="perf-smoke-slow", metrics={
+        k: (v * 2 if metric_direction(k) == "lower" else v)
+        for k, v in r["metrics"].items()
+    })
+    with open(path, "a") as f:
+        f.write(json.dumps(r2) + "\n")
+# --k 1 pins the baseline to run 2 itself (the run that was doubled):
+# the slowdown is then exactly +100% on every gated time metric, immune
+# to the run-1-vs-run-2 compile-cache asymmetry
+rc = subprocess.call([sys.executable, "-m", "benchmark.compare",
+                      "--history", path, "--sections", "logreg",
+                      "--k", "1", "--tolerance", "0.75",
+                      "--abs-floor", "0.05"])
+assert rc != 0, "comparator must fail on a 2x slowdown"
+print("perf smoke OK: history records per section per run, gate trips "
+      "on 2x slowdown")
+EOF
+rm -rf "$PERF_DIR"
 
 echo "== pod benchmark smoke (2-process jax.distributed) =="
 python benchmark/pod/launch.py --num_processes 2 --devices_per_process 2 \

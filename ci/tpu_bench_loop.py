@@ -34,6 +34,12 @@ PROBE_INTERVAL = float(os.environ.get("PROBE_INTERVAL", 600))
 PROBE_TIMEOUT = float(os.environ.get("PROBE_TIMEOUT", 300))
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", 14400))
 BENCH_FILE = os.environ.get("BENCH_FILE", "BENCH_r05.json")
+# normalized per-section records (benchmark/history.py): bench.py
+# appends at its per-section flush cadence through the env below, and
+# run_bench appends once more from the committed artifact (idempotent)
+BENCH_HISTORY = os.environ.get(
+    "BENCH_HISTORY", os.path.join(REPO, "BENCH_HISTORY.jsonl")
+)
 LOOP_LOG = os.environ.get("LOOP_LOG", os.path.join(REPO, "tpu_bench_loop.log"))
 # never-measured-on-chip first (VERDICT r4 backlog order), rf still last
 WORKLOADS = os.environ.get(
@@ -134,6 +140,9 @@ def run_bench(have_on_chip: bool) -> bool:
     # budgeter still leaves every completed section's numbers on disk,
     # and concurrent runs never clobber each other's
     env.setdefault("BENCH_PARTIAL_PATH", out_path + ".partial.json")
+    # bench.py appends each completed section's normalized record here
+    # as it finishes — a killed run still leaves its trajectory points
+    env.setdefault("BENCH_HISTORY_PATH", BENCH_HISTORY)
     log(f"bench: starting full matrix (workloads={WORKLOADS}, "
         f"timeout={BENCH_TIMEOUT:.0f}s)")
     with open(out_path, "wb") as outf:
@@ -161,7 +170,23 @@ def run_bench(have_on_chip: bool) -> bool:
         json.dump(result, f, indent=1)
         f.write("\n")
     log(f"bench: wrote {BENCH_FILE} (platform={platform!r}, rc={rc})")
+    # belt + suspenders with bench.py's own per-section appends: the
+    # committed artifact's sections land in the history even when the
+    # child ran without the env (append is idempotent per run+section)
+    try:
+        if REPO not in sys.path:  # persistent loop: never grow sys.path
+            sys.path.insert(0, REPO)
+        from benchmark.history import append_run
+
+        added = append_run(result, BENCH_HISTORY)
+        if added:
+            log(f"bench: appended {added} history record(s) to "
+                f"{os.path.basename(BENCH_HISTORY)}")
+    except Exception as e:
+        log(f"bench: history append failed ({type(e).__name__}: {e})")
     subprocess.run(["git", "add", BENCH_FILE], cwd=REPO)
+    if os.path.exists(BENCH_HISTORY):
+        subprocess.run(["git", "add", BENCH_HISTORY], cwd=REPO)
     msg = (f"BENCH: on-chip matrix captured ({platform})" if on_chip
            else f"BENCH: matrix refresh ({platform})")
     subprocess.run(["git", "commit", "-m", msg, "--no-verify"], cwd=REPO)

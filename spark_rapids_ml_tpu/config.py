@@ -209,6 +209,25 @@ _DEFAULTS: Dict[str, Any] = {
     # <= 0 silences the log line (the solver progress gauges still
     # update every iteration).
     "heartbeat_interval_s": 30.0,
+    # Device-memory telemetry source (telemetry/memory.py): "auto" reads
+    # `device.memory_stats()` where the backend reports it (TPU/GPU) and
+    # falls back to the deterministic simulated provider (a
+    # `jax.live_arrays()` census) elsewhere — so the watermark/drift
+    # path runs on the CPU test mesh too; "real"/"simulated" force a
+    # provider, "off" disables sampling entirely.
+    "memory_provider": "auto",
+    # Background device-memory sampling cadence while a fit is active
+    # (seconds).  0 (default) = sample only at the explicit points
+    # (fit open/close, after each staging, rate-limited solver
+    # heartbeats); > 0 adds a daemon-thread sampler so long device-bound
+    # stretches can't hide an HBM peak between explicit samples.
+    "memory_sample_interval_s": 0.0,
+    # Bench-history file (benchmark/history.py): when set, bench.py
+    # appends one normalized flat-metric record per completed section
+    # per run, and `python -m benchmark.compare` gates regressions
+    # against the median of the last k runs.  Overridable per run with
+    # the BENCH_HISTORY_PATH env var; empty disables appending.
+    "bench_history_path": "",
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"
@@ -270,6 +289,10 @@ def _invalidate_traced(old: Any, new: Any) -> None:
     import jax
 
     jax.clear_caches()
+    from .telemetry.compile import note_recompile
+
+    # every same-shape call after this re-lowers: make the storm visible
+    note_recompile("traced_kernels", "precision_change")
 
 
 def _traced_keys_locked() -> tuple:

@@ -361,15 +361,23 @@ class _TpuCaller(_TpuParams, _ReadWriteMixin):
             device_data_budget_bytes,
             evict_to_fit,
         )
+        from .telemetry.memory import record_budget_decision
 
         if bool(get_config("force_streaming_stats")):
             # the answer is True regardless — do not evict a warm cache
             # for a decision the force flag already made
+            record_budget_decision("fit_dataset", need_bytes, True)
             return True
         budget = device_data_budget_bytes()
         if need_bytes + cache_resident_bytes() > budget:
             evict_to_fit(need_bytes, budget)
-        return need_bytes + cache_resident_bytes() > budget
+        over = need_bytes + cache_resident_bytes() > budget
+        # the prediction side of budget_drift_ratio (telemetry/memory.py):
+        # the measured peak watermark lands in the same fit report, so
+        # the n_dev+2 gather factors and reservation math get checked
+        # against the chips instead of stayed faith-based
+        record_budget_decision("fit_dataset", need_bytes, over)
+        return over
 
     def _supports_fold_weights(self) -> bool:
         """Whether this estimator's kernels honor the zero-weight-row

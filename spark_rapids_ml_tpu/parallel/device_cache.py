@@ -689,7 +689,14 @@ def get_or_stage(
         row_bytes += ldt.itemsize
     need = st.local_padded * row_bytes
     reserved = int(need * max(working_factor, 1.0))
-    if not cache.reserve(reserved):
+    # the reservation IS a byte-model prediction (base bytes x the
+    # n_dev+2 gather factor): record it so the measured watermark can
+    # report how much of that headroom real fits actually touch
+    from ..telemetry.memory import record_budget_decision
+
+    ok = cache.reserve(reserved)
+    record_budget_decision("device_cache", reserved, not ok)
+    if not ok:
         _note(
             "misses",
             detail=f"fp={fp[:12]} over-budget need={need} "
@@ -702,6 +709,11 @@ def get_or_stage(
             )
         return None
     _note("misses", detail=f"fp={fp[:12]} staging {need} bytes")
+    # pre-staging census: the insert-time drift below measures what THIS
+    # staging added, not whatever else already sits on the chips
+    from ..telemetry.memory import note_measured_drift, sample_devices
+
+    baseline = sum(sample_devices().values())
     try:
         Xs = st.stage(X, dtype)
         w = st.mask(dtype, weights=weight)
@@ -732,6 +744,9 @@ def get_or_stage(
     # residency costs cache capacity, never correctness)
     entry = CacheEntry(fp, ds, reserved, base_bytes=need)
     cache.insert(entry)
+    # point-in-time drift at the moment residency lands: bytes this
+    # staging ADDED vs the entry's reservation (telemetry/memory.py)
+    note_measured_drift("device_cache", reserved, baseline_bytes=baseline)
     return entry
 
 

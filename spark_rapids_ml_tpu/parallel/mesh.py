@@ -74,13 +74,23 @@ def restore_devices() -> None:
     _excluded_device_ids.clear()
 
 
-def drop_staging_programs() -> None:
+def drop_staging_programs(reason: str = "elastic_shrink") -> None:
     """Forget the compiled staging programs: the donated single-device
     updaters and the global bounded-upload pair bind CONCRETE devices,
     so after a mesh rebuild they must re-lower for the surviving device
-    set instead of dispatching to a dead chip."""
+    set instead of dispatching to a dead chip.  Counted on
+    `recompiles_total{fn="staging_programs"}` with a `recompile[...]`
+    marker in the active run's span tree (telemetry/compile.py), so an
+    elastic recovery's re-lowering storm is visible inside the fit it
+    interrupted."""
     _shard_update_fns.cache_clear()
     _chunked_upload_fns.cache_clear()
+    from ..telemetry.compile import note_recompile
+
+    # one re-lower EVENT per drop (not per cached program): the counter
+    # answers "how many recompile storms", the compile_seconds histogram
+    # answers how much each one cost
+    note_recompile("staging_programs", reason)
 
 
 def bucket_rows(n: int) -> int:
@@ -494,14 +504,18 @@ def run_staging_pipeline(
             prep["s"] += time.perf_counter() - t
             yield item
 
+    from ..telemetry.compile import compile_label
     from ..utils import prefetch_iter
 
-    for dev, lo, rows in prefetch_iter(timed(), depth):
-        if dev is None:
-            writer.write(int(lo), rows)
-        else:
-            writer.write_shard(int(dev), int(lo), rows)
-    out = writer.finish()
+    # first use of a (shape, device) pair lowers the donated updater
+    # here: attribute those compiles to the engine, not the estimator
+    with compile_label("staging"):
+        for dev, lo, rows in prefetch_iter(timed(), depth):
+            if dev is None:
+                writer.write(int(lo), rows)
+            else:
+                writer.write_shard(int(dev), int(lo), rows)
+        out = writer.finish()
     wall = time.perf_counter() - t0
     mb = writer.bytes_written / 1e6
     busy = prep["s"] + writer.put_seconds
@@ -801,19 +815,38 @@ class RowStager:
             # 1-D companions (labels/weights/masks/fold-ids) ride along a
             # dataset staging; only the feature block counts as one
             note_dataset_staging()
+            # the byte model's prediction for this staging (padded rows x
+            # row bytes) — the measured-peak watermark checks it
+            # (telemetry/memory.py budget_drift_ratio)
+            from ..telemetry.memory import record_prediction
+
+            record_prediction(
+                "staged",
+                float(self.local_padded)
+                * int(np.prod(arr.shape[1:], dtype=np.int64))
+                * np.dtype(dtype).itemsize,
+            )
         sharding = NamedSharding(self.mesh, data_pspec(arr.ndim))
-        if self.n_proc == 1:
-            if (
-                _FORCE_PIPELINED or arr.nbytes >= _PIPELINED_MIN_BYTES
-            ) and _writer_devices(
-                sharding, (self.local_padded,) + arr.shape[1:]
-            ) is not None:
-                return self._stage_pipelined(arr, dtype, sharding)
-            return self._stage_serial(arr, dtype)
-        padded = self._pad_host(arr, dtype)
-        return jax.make_array_from_process_local_data(
-            sharding, padded, (self.n_padded,) + padded.shape[1:]
-        )
+        try:
+            if self.n_proc == 1:
+                if (
+                    _FORCE_PIPELINED or arr.nbytes >= _PIPELINED_MIN_BYTES
+                ) and _writer_devices(
+                    sharding, (self.local_padded,) + arr.shape[1:]
+                ) is not None:
+                    return self._stage_pipelined(arr, dtype, sharding)
+                return self._stage_serial(arr, dtype)
+            padded = self._pad_host(arr, dtype)
+            return jax.make_array_from_process_local_data(
+                sharding, padded, (self.n_padded,) + padded.shape[1:]
+            )
+        finally:
+            if arr.ndim == 2:
+                # a staging is exactly where resident bytes step up:
+                # sample so per-fit peak watermarks see the new level
+                from ..telemetry.memory import sample_devices
+
+                sample_devices()
 
     def _pad_host(self, arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
         """Zero-padded dtype-cast host copy in the ORIGINAL row order (the

@@ -23,6 +23,18 @@
 #   heartbeat.py  progress heartbeat for long iterative solvers
 #                 (iteration/loss/throughput every
 #                 `heartbeat_interval_s`).
+#   memory.py     HBM accounting: per-device live/peak byte gauges
+#                 (`device.memory_stats()` where the backend has it, a
+#                 deterministic `jax.live_arrays()` census elsewhere),
+#                 per-fit peak watermarks, and the
+#                 `budget_drift_ratio{est=}` feedback that checks the
+#                 byte model's predictions against the chips.
+#   compile.py    compile observability: `compile_seconds{fn=,phase=}`
+#                 from a jax.monitoring listener (explicit span wrappers
+#                 where the hooks are absent) and `recompiles_total` for
+#                 every dropped-and-re-lowered program (elastic shrink,
+#                 precision flips), with `recompile[...]` markers inside
+#                 the interrupted fit's span tree.
 #
 # Span correlation lives in tracing.py: every span/instant carries
 # absolute t0/t1, the recording thread id, and the `run_id` core.py
@@ -32,6 +44,12 @@
 # Like resilience/, this package imports neither jax nor numpy at module
 # scope: reading a counter must not pay the accelerator import.
 #
+from .compile import (  # noqa: F401
+    compile_label,
+    compile_span,
+    install_jax_listener,
+    note_recompile,
+)
 from .exporters import (  # noqa: F401
     chrome_trace,
     dump_chrome_trace,
@@ -42,6 +60,15 @@ from .exporters import (  # noqa: F401
     stop_http_server,
 )
 from .heartbeat import Heartbeat  # noqa: F401
+from .memory import (  # noqa: F401
+    FitMemoryWatermark,
+    SimulatedMemoryProvider,
+    get_provider,
+    record_budget_decision,
+    record_prediction,
+    reset_memory_telemetry,
+    sample_devices,
+)
 from .registry import (  # noqa: F401
     REGISTRY,
     DictView,
@@ -59,22 +86,33 @@ from .report import FitTelemetry, solver_summary, span_tree  # noqa: F401
 
 __all__ = [
     "DictView",
+    "FitMemoryWatermark",
     "FitTelemetry",
     "Heartbeat",
     "Metric",
     "MetricsRegistry",
     "REGISTRY",
+    "SimulatedMemoryProvider",
     "chrome_trace",
+    "compile_label",
+    "compile_span",
     "counter",
     "delta",
     "dict_view",
     "dump_chrome_trace",
     "dump_prometheus",
     "gauge",
+    "get_provider",
     "histogram",
+    "install_jax_listener",
     "maybe_start_http_server",
+    "note_recompile",
     "parse_prometheus",
+    "record_budget_decision",
+    "record_prediction",
+    "reset_memory_telemetry",
     "reset_metrics",
+    "sample_devices",
     "snapshot",
     "solver_summary",
     "span_tree",

@@ -70,6 +70,12 @@ class Heartbeat:
                 _loss_gauge.set(float(loss), solver=self.label)
             except (TypeError, ValueError):
                 pass  # non-scalar diagnostics never break the solver
+        # the solver loop is where mid-fit HBM peaks live (accumulators,
+        # line-search temporaries); rate-limited so a fast loop pays one
+        # sample per interval, not per iteration
+        from .memory import maybe_sample
+
+        maybe_sample()
         if self.interval <= 0:
             return
         now = time.monotonic()

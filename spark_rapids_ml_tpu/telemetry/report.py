@@ -136,13 +136,17 @@ class FitTelemetry:
         self._t0 = 0.0
         self._t1 = 0.0
         self._overlapped = False
+        self._watermark = None
 
     @contextlib.contextmanager
     def span(self):
         from ..tracing import mint_run_id, run_context, trace
+        from .compile import compile_label, install_jax_listener
         from .exporters import maybe_start_http_server
+        from .memory import FitMemoryWatermark
 
         maybe_start_http_server()
+        install_jax_listener()
         self.run_id = mint_run_id("fit")
         self._before = REGISTRY.snapshot()
         self._t0 = time.time()
@@ -150,14 +154,20 @@ class FitTelemetry:
         with cls._active_lock:
             cls._active += 1
             self._overlapped = cls._active > 1
+        self._watermark = FitMemoryWatermark(self.run_id, self.estimator)
+        self._watermark.open()
         try:
             with run_context(self.run_id):
-                with trace(f"fit[{self.estimator}]"):
-                    yield self
+                # compile events on this thread (and adopted workers)
+                # attribute to this estimator
+                with compile_label(self.estimator):
+                    with trace(f"fit[{self.estimator}]"):
+                        yield self
         finally:
             with cls._active_lock:
                 self._overlapped = self._overlapped or cls._active > 1
                 cls._active -= 1
+            self._watermark.close()
         self._t1 = time.time()
 
     def _resilience_section(
@@ -184,6 +194,80 @@ class FitTelemetry:
             sec["recoveries"] = rec
             if "iterations_salvaged" in rec:
                 sec["iterations_salvaged"] = rec["iterations_salvaged"]
+        return sec
+
+    def _compile_section(
+        self, events: List[Any], deltas: Dict[str, Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        """Compile time + recompile count for this fit.  The recompile
+        count is RUN-EXACT (the `recompile[...]` instant markers carry
+        this run's id); the seconds come from the registry delta of
+        `compile_seconds`, filtered to this estimator's label where the
+        jax.monitoring listener attributed them (process-global samples
+        under other labels are excluded, so a concurrent fit's compiles
+        don't leak in)."""
+        sec: Dict[str, Any] = {}
+        recompiles = [
+            e
+            for e in events
+            if getattr(e, "kind", "") == "instant"
+            and e.name.startswith("recompile[")
+        ]
+        if recompiles:
+            sec["recompiles"] = len(recompiles)
+            sec["recompiled"] = sorted(
+                {e.name[len("recompile["):-1] for e in recompiles}
+            )
+        seconds = 0.0
+        count = 0
+        for ls, v in deltas.get("compile_seconds", {}).items():
+            if f"fn={self.estimator}" not in ls.split(","):
+                continue
+            if isinstance(v, dict):
+                seconds += float(v.get("sum", 0.0))
+                count += int(v.get("count", 0))
+        if count:
+            sec["seconds"] = round(seconds, 4)
+            sec["events"] = count
+        return sec
+
+    def _profile_section(self) -> Dict[str, Any]:
+        """Cross-reference the XProf capture (`profile_dir` conf) so the
+        device profile and this report's run_id stop being orphaned from
+        each other: the report names the profile directory plus any
+        artifact entries written during this fit's window."""
+        from ..config import get_config
+
+        pdir = str(get_config("profile_dir") or "")
+        if not pdir:
+            return {}
+        sec: Dict[str, Any] = {"dir": pdir}
+        try:
+            arts = []
+            # top level: trace FILES only (the 'plugins' container dir's
+            # mtime refreshes on every child write and is not itself an
+            # artifact); under plugins/profile the per-capture TIMESTAMP
+            # DIRECTORIES are the artifacts XProf consumes
+            for root, dirs_ok in (
+                (pdir, False),
+                (os.path.join(pdir, "plugins", "profile"), True),
+            ):
+                if not os.path.isdir(root):
+                    continue
+                upper = (self._t1 if self._t1 > 0 else time.time()) + 1.0
+                for name in os.listdir(root):
+                    p = os.path.join(root, name)
+                    if not dirs_ok and not os.path.isfile(p):
+                        continue
+                    # written during (± 1 s of) THIS fit's window: a
+                    # later fit sharing the profile_dir must not have
+                    # its capture attributed here
+                    if self._t0 - 1.0 <= os.path.getmtime(p) <= upper:
+                        arts.append(os.path.relpath(p, pdir))
+            if arts:
+                sec["artifacts"] = sorted(arts)
+        except OSError:
+            pass
         return sec
 
     def build(self, model: Any = None) -> Dict[str, Any]:
@@ -237,6 +321,16 @@ class FitTelemetry:
             "cache": _view_delta(deltas, "device_cache"),
             "resilience": self._resilience_section(events, deltas),
         }
+        if self._watermark is not None:
+            memory = self._watermark.section()
+            if memory:
+                report["memory"] = memory
+        comp = self._compile_section(events, deltas)
+        if comp:
+            report["compile"] = comp
+        prof = self._profile_section()
+        if prof:
+            report["profile"] = prof
         solver = solver_summary(model) if model is not None else {}
         if solver:
             report["solver"] = solver

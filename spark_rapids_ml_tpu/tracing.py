@@ -162,11 +162,18 @@ def adopt_trace_context() -> Callable[[], None]:
     rec = _records()
     depth = getattr(_tls, "depth", 0)
     run_id = getattr(_tls, "run_id", "")
+    # compile-event attribution rides along: a dispatch's XLA compiles
+    # happen on the worker thread, but they belong to the caller's label
+    # scope (telemetry/compile.py)
+    from .telemetry.compile import adopt_labels, snapshot_labels
+
+    labels = snapshot_labels()
 
     def _adopt() -> None:
         _tls.records = rec
         _tls.depth = depth
         _tls.run_id = run_id
+        adopt_labels(labels)
 
     return _adopt
 

@@ -1,0 +1,292 @@
+#
+# Bench history + regression comparator (benchmark/history.py,
+# benchmark/compare.py): payload normalization into per-section JSONL
+# records, idempotent appends, metric direction rules, and the
+# noise-aware gate — improvement / regression / within-noise /
+# first-run-no-baseline, each pinned.  Pure host-side: no jax, no mesh.
+#
+import json
+import subprocess
+import sys
+
+import pytest
+
+from benchmark.compare import compare_runs, metric_direction, render_markdown
+from benchmark.history import (
+    append_run,
+    load_history,
+    normalize_run,
+    runs_in_order,
+    section_of,
+)
+
+
+def _payload(extra, value=1000.0, vs_baseline=2.0):
+    return {
+        "metric": "logreg_fit_rows_per_sec (tiny)",
+        "value": value,
+        "unit": "rows/sec/chip",
+        "vs_baseline": vs_baseline,
+        "extra": dict(extra),
+    }
+
+
+BASE_EXTRA = {
+    "bench_run_id": "run-1",
+    "platform": "cpu x8",
+    "pca_1Mx128_fit_sec": 2.0,
+    "pca_1Mx128_rows_per_sec": 500000.0,
+    "staging_pipelined_mb_per_s": 800.0,
+    "staging_parity": True,  # bool: excluded
+    "kmeans_intended_config": "text",  # string: excluded
+    "logreg_warm_fit_sec": 0.5,
+    "logreg_error": "nope",  # *_error: excluded
+    "logreg_telemetry": {"counters": {}},  # dict: excluded
+    "total_budget_s": 900.0,  # run metadata, no section
+}
+
+
+# ---------------------------------------------------------------------------
+# history normalization
+# ---------------------------------------------------------------------------
+
+
+def test_normalize_run_sections_and_filtering():
+    recs = normalize_run(_payload(BASE_EXTRA), ts=123.0)
+    by_sec = {r["section"]: r for r in recs}
+    assert set(by_sec) == {"logreg", "pca", "staging"}
+    for r in recs:
+        assert r["run_id"] == "run-1"
+        assert r["platform"] == "cpu x8"
+        assert r["ts"] == 123.0
+    # the headline value/vs_baseline land in the logreg section
+    assert by_sec["logreg"]["metrics"]["logreg_rows_per_sec"] == 1000.0
+    assert by_sec["logreg"]["metrics"]["logreg_vs_baseline"] == 2.0
+    assert by_sec["logreg"]["metrics"]["logreg_warm_fit_sec"] == 0.5
+    # booleans, strings, *_error, *_telemetry and unmapped keys excluded
+    flat = {k for r in recs for k in r["metrics"]}
+    assert "staging_parity" not in flat
+    assert "kmeans_intended_config" not in flat
+    assert "logreg_error" not in flat
+    assert "total_budget_s" not in flat
+
+
+def test_section_of_prefix_rules():
+    assert section_of("cv_legacy_fit_sec") == "cv_cached"
+    assert section_of("cv_cached_speedup_x") == "cv_cached"
+    assert section_of("ivfpq_recall_at_10") == "ann"
+    assert section_of("ingest_mbytes_per_sec") == "streaming"
+    assert section_of("platform") is None
+
+
+def test_append_run_idempotent_per_section(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    p = _payload(BASE_EXTRA)
+    assert append_run(p, path) == 3
+    # the per-section flush cadence re-appends the same payload: no dupes
+    assert append_run(p, path) == 0
+    # a later flush with one NEW section appends only that section
+    p2 = _payload({**BASE_EXTRA, "kmeans_5Mx64_k20_fit_sec": 9.0})
+    assert append_run(p2, path) == 1
+    recs = load_history(path)
+    assert len(recs) == 4
+    assert runs_in_order(recs) == ["run-1"]
+
+
+def test_load_history_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "hist.jsonl"
+    good = normalize_run(_payload(BASE_EXTRA))[0]
+    path.write_text(
+        json.dumps(good) + "\n" + '{"torn": ' + "\n" + "not json\n"
+    )
+    assert load_history(str(path)) == [good]
+
+
+# ---------------------------------------------------------------------------
+# metric direction
+# ---------------------------------------------------------------------------
+
+
+def test_metric_direction_rules():
+    assert metric_direction("pca_1Mx128_fit_sec") == "lower"
+    assert metric_direction("cv_legacy_stagings_per_run") == "lower"
+    # throughputs must NOT match the `_sec` time suffix
+    assert metric_direction("logreg_rows_per_sec") == "higher"
+    assert metric_direction("staging_pipelined_mb_per_s") == "higher"
+    assert metric_direction("ann_cagra_qps") == "higher"
+    assert metric_direction("ivfflat_recall_at_10") == "higher"
+    assert metric_direction("dbscan_truth_ari") == "higher"
+    assert metric_direction("cv_cached_speedup_x") == "higher"
+    # counts/configs are informational: never gate
+    assert metric_direction("staging_pieces") is None
+    assert metric_direction("dbscan_clusters_found") is None
+
+
+# ---------------------------------------------------------------------------
+# the comparator gate
+# ---------------------------------------------------------------------------
+
+
+def _rec(run_id, metrics, section="pca"):
+    return {
+        "run_id": run_id,
+        "ts": 0.0,
+        "platform": "cpu x8",
+        "section": section,
+        "metrics": dict(metrics),
+    }
+
+
+def test_compare_within_noise():
+    base = [[_rec(f"r{i}", {"pca_fit_sec": 2.0 + 0.1 * i})] for i in range(3)]
+    rows, regressed = compare_runs(
+        [_rec("cur", {"pca_fit_sec": 2.2})], base, tolerance=0.25
+    )
+    assert not regressed
+    (row,) = rows
+    assert row["status"] == "ok"
+    assert row["baseline"] == 2.1  # median of 2.0/2.1/2.2
+    assert row["change"] == pytest.approx(0.0476, abs=1e-3)
+
+
+def test_compare_regression_and_direction():
+    base = [[_rec("r0", {"pca_fit_sec": 2.0, "pca_rows_per_sec": 1000.0})]]
+    # slower AND lower-throughput: both regress
+    rows, regressed = compare_runs(
+        [_rec("cur", {"pca_fit_sec": 3.0, "pca_rows_per_sec": 600.0})],
+        base,
+        tolerance=0.25,
+    )
+    assert regressed
+    assert {r["metric"]: r["status"] for r in rows} == {
+        "pca_fit_sec": "regression",
+        "pca_rows_per_sec": "regression",
+    }
+
+
+def test_compare_improvement_does_not_gate():
+    base = [[_rec("r0", {"pca_fit_sec": 2.0})]]
+    rows, regressed = compare_runs(
+        [_rec("cur", {"pca_fit_sec": 1.0})], base, tolerance=0.25
+    )
+    assert not regressed
+    assert rows[0]["status"] == "improved"
+
+
+def test_compare_first_run_no_baseline():
+    rows, regressed = compare_runs(
+        [_rec("cur", {"pca_fit_sec": 2.0, "pca_pieces": 8.0})], []
+    )
+    assert not regressed
+    statuses = {r["metric"]: r["status"] for r in rows}
+    assert statuses["pca_fit_sec"] == "no-baseline"
+    assert statuses["pca_pieces"] == "info"
+
+
+def test_compare_per_metric_band_overrides_default():
+    base = [[_rec("r0", {"pca_fit_sec": 2.0})]]
+    cur = [_rec("cur", {"pca_fit_sec": 2.4})]  # +20%
+    _, regressed = compare_runs(cur, base, tolerance=0.5)
+    assert not regressed
+    _, regressed = compare_runs(
+        cur, base, tolerance=0.5, bands={"pca_fit_sec": 0.1}
+    )
+    assert regressed
+
+
+def test_compare_abs_floor_guards_tiny_metrics():
+    """A 20 ms metric doubling on a loaded host is scheduler jitter: the
+    absolute floor keeps it from tripping the gate while a real
+    (above-floor) slowdown still does."""
+    base = [[_rec("r0", {"pca_fit_sec": 0.02, "pca_other_sec": 2.0})]]
+    cur = [_rec("cur", {"pca_fit_sec": 0.05, "pca_other_sec": 4.0})]
+    rows, regressed = compare_runs(cur, base, tolerance=0.25, abs_floor=0.05)
+    assert regressed  # the 2.0 -> 4.0 slowdown still gates
+    statuses = {r["metric"]: r["status"] for r in rows}
+    assert statuses["pca_fit_sec"] == "ok"  # +150% but only +30 ms
+    assert statuses["pca_other_sec"] == "regression"
+
+
+def test_markdown_table_orders_regressions_first():
+    base = [[_rec("r0", {"pca_fit_sec": 2.0, "pca_rows_per_sec": 1000.0})]]
+    rows, _ = compare_runs(
+        [_rec("cur", {"pca_fit_sec": 4.0, "pca_rows_per_sec": 1100.0})],
+        base,
+        tolerance=0.25,
+    )
+    md = render_markdown(rows, "cur", ["r0"], 0.25)
+    lines = [ln for ln in md.splitlines() if ln.startswith("| pca")]
+    assert "regression" in lines[0] and "pca_fit_sec" in lines[0]
+
+
+def test_cli_exit_codes(tmp_path):
+    """End to end through `python -m benchmark.compare`: 0 within noise
+    and on a missing/empty history, 1 on a regression."""
+    path = str(tmp_path / "hist.jsonl")
+    rc = subprocess.call(
+        [sys.executable, "-m", "benchmark.compare", "--history", path],
+        stdout=subprocess.DEVNULL,
+    )
+    assert rc == 0  # no history yet: bootstraps quietly
+    with open(path, "w") as f:
+        for rec in (
+            _rec("r0", {"pca_fit_sec": 2.0}),
+            _rec("r1", {"pca_fit_sec": 2.1}),
+        ):
+            f.write(json.dumps(rec) + "\n")
+    rc = subprocess.call(
+        [sys.executable, "-m", "benchmark.compare", "--history", path,
+         "--tolerance", "0.25"],
+        stdout=subprocess.DEVNULL,
+    )
+    assert rc == 0
+    with open(path, "a") as f:
+        f.write(json.dumps(_rec("r2", {"pca_fit_sec": 4.0})) + "\n")
+    rc = subprocess.call(
+        [sys.executable, "-m", "benchmark.compare", "--history", path,
+         "--tolerance", "0.25"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    assert rc == 1
+
+
+def test_cli_unmatched_sections_exit_nonzero(tmp_path):
+    """A typo'd --sections must not turn the gate vacuous-green."""
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(_rec("r0", {"pca_fit_sec": 2.0})) + "\n")
+    rc = subprocess.call(
+        [sys.executable, "-m", "benchmark.compare", "--history", path,
+         "--sections", "logerg"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    assert rc == 2
+
+
+def test_cli_run_id_baselines_only_prior_runs(tmp_path):
+    """`--run-id` pointing mid-history must baseline against runs that
+    came BEFORE it — the earliest run has no baseline at all, even
+    though later runs exist in the file."""
+    path = str(tmp_path / "hist.jsonl")
+    with open(path, "w") as f:
+        for rec in (
+            _rec("r0", {"pca_fit_sec": 2.0}),
+            _rec("r1", {"pca_fit_sec": 2.1}),
+            _rec("r2", {"pca_fit_sec": 4.0}),
+        ):
+            f.write(json.dumps(rec) + "\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmark.compare", "--history", path,
+         "--run-id", "r0", "--tolerance", "0.25"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0
+    assert "no baseline yet" in out.stdout
+    # r1 baselines against r0 only: within noise, NOT against r2's 4.0
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmark.compare", "--history", path,
+         "--run-id", "r1", "--tolerance", "0.25"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0
+    assert "median of 1 prior run(s)" in out.stdout

@@ -149,16 +149,60 @@ def test_single_sample_predict(rng):
 
 def test_stepwise_lloyd_matches_fused(rng):
     # kmeans_fit_stepwise (host-dispatched blocks, the 45s-dispatch-rule
-    # path for huge n*d*k) must reproduce the fused while_loop fit
+    # path for huge n*d*k) must reproduce the fused while_loop fit.  The
+    # contract is "same update math, trajectories match up to f32
+    # reduction order" (the stepwise docstring) — asserted in two parts.
+    # The old form of this test compared full 50-iteration trajectories
+    # on structure-free gaussian noise: BOTH fits hit max_iter still
+    # moving (tol never reached), and the blocked path's different f32
+    # summation order drifts chaotically through Lloyd's discrete
+    # assignment flips — costs agreed to ~1e-4 while individual centers
+    # differed by 1.5x, an artifact of comparing non-converged chaos,
+    # not a blocking bug.
     import jax.numpy as jnp
+    from sklearn.datasets import make_blobs
 
-    from spark_rapids_ml_tpu.ops.kmeans import kmeans_fit, kmeans_fit_stepwise
+    from spark_rapids_ml_tpu.ops.kmeans import (
+        _lloyd_block_step,
+        _pairwise_sqdist,
+        kmeans_fit,
+        kmeans_fit_stepwise,
+        kmeans_init,
+    )
 
-    X = jnp.asarray(rng.normal(size=(3000, 8)).astype(np.float32))
+    Xh, _ = make_blobs(n_samples=3000, n_features=8, centers=5,
+                       cluster_std=1.0, random_state=2)
+    X = jnp.asarray(Xh.astype(np.float32))
     w = jnp.ones((3000,), jnp.float32)
-    # random init costs no D2 passes, so the tiny budget below forces
-    # multiple Lloyd blocks per pass WITHOUT subsampling the seeding —
-    # both fits start from identical centers and only blocking differs
+
+    # (1) the math contract: one pass of blocked partial sums (three
+    # blocks, uneven tail) equals one fused assignment+update from
+    # IDENTICAL centers, up to f32 summation order
+    C0 = kmeans_init(X, w, 5, 0, "random")
+    acc = (jnp.zeros((5, 8), X.dtype), jnp.zeros((5,), X.dtype),
+           jnp.zeros((), X.dtype))
+    for s, rows in ((0, 1250), (1250, 1250), (2500, 500)):
+        acc = _lloyd_block_step(
+            acc, C0, X, w, jnp.asarray(s, jnp.int32), rows, 5
+        )
+    d2 = _pairwise_sqdist(X, C0)
+    onehot = jnp.zeros((3000, 5), X.dtype).at[
+        jnp.arange(3000), jnp.argmin(d2, axis=1)
+    ].set(1.0) * w[:, None]
+    np.testing.assert_allclose(
+        np.asarray(acc[0]), np.asarray(onehot.T @ X), rtol=1e-4, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(acc[1]), np.asarray(onehot.sum(axis=0)), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(acc[2]), float((jnp.min(d2, axis=1) * w).sum()), rtol=1e-4
+    )
+
+    # (2) end to end on clusterable data: both fits CONVERGE (the old
+    # noise dataset never did) and land on the same centers and cost;
+    # the tiny budget forces multiple Lloyd blocks per pass while the
+    # "random" init (no D2 passes) keeps the seeding identical
     c_f, cost_f, it_f = kmeans_fit(
         X, w, k=5, seed=0, max_iter=50, tol=1e-4, init="random"
     )
@@ -166,10 +210,10 @@ def test_stepwise_lloyd_matches_fused(rng):
         X, w, k=5, seed=0, max_iter=50, tol=1e-4, init="random",
         flops_budget=2e5,
     )
-    assert it_s == int(it_f)
+    assert int(it_f) < 50 and int(it_s) < 50, (it_f, it_s)
     np.testing.assert_allclose(
         np.sort(np.asarray(c_s), axis=0), np.sort(np.asarray(c_f), axis=0),
-        rtol=1e-4, atol=1e-4,
+        rtol=1e-3, atol=1e-3,
     )
     np.testing.assert_allclose(float(cost_s), float(cost_f), rtol=1e-4)
 

@@ -39,11 +39,15 @@ from spark_rapids_ml_tpu.tracing import (
 
 @pytest.fixture(autouse=True)
 def _clean():
+    from spark_rapids_ml_tpu.telemetry import reset_memory_telemetry
+
     reset_config()
     reset_trace()
+    reset_memory_telemetry()
     yield
     reset_config()
     reset_trace()
+    reset_memory_telemetry()
 
 
 # ---------------------------------------------------------------------------
@@ -481,3 +485,221 @@ def test_fit_report_never_fails_fit(rng, monkeypatch):
         pd.DataFrame({"features": list(X)})
     )
     assert m.fit_report() is not None  # report built, artifact skipped
+
+
+# ---------------------------------------------------------------------------
+# memory telemetry: providers, watermarks, budget drift
+# ---------------------------------------------------------------------------
+
+
+def test_simulated_provider_census_is_exact():
+    """The CPU container has no `memory_stats()` (RealMemoryProvider
+    reports nothing here); the simulated provider must census live
+    sharded arrays byte-exactly per device, deterministically."""
+    import jax
+
+    from spark_rapids_ml_tpu.parallel.mesh import RowStager, get_mesh
+    from spark_rapids_ml_tpu.telemetry.memory import (
+        RealMemoryProvider,
+        SimulatedMemoryProvider,
+        sample_devices,
+    )
+
+    assert not RealMemoryProvider.available()
+    prov = SimulatedMemoryProvider()
+    before = {d: s["bytes_in_use"] for d, s in prov.sample().items()}
+    mesh = get_mesh()
+    st = RowStager(800, mesh, bucketing=False)
+    Xs = st.stage(np.ones((800, 16), np.float32), np.float32)
+    jax.block_until_ready(Xs)
+    after = prov.sample()
+    per_dev = st.local_padded // mesh.devices.size * 16 * 4
+    for d in (int(dd.id) for dd in mesh.devices.flat):
+        grew = after[d]["bytes_in_use"] - before.get(d, 0)
+        assert grew >= per_dev, (d, grew, per_dev)
+        # peak is a running max
+        assert after[d]["peak_bytes_in_use"] >= after[d]["bytes_in_use"]
+    # the module-level sampler (auto -> simulated here) fills the gauges
+    set_config(memory_provider="auto")
+    live = sample_devices()
+    assert live and all(v > 0 for v in live.values())
+    snap = snapshot()
+    assert snap["device_bytes_in_use"], "per-device gauge not exported"
+    del Xs
+
+
+def test_memory_provider_off_noops():
+    from spark_rapids_ml_tpu.telemetry.memory import (
+        reset_memory_telemetry,
+        sample_devices,
+    )
+
+    set_config(memory_provider="off")
+    reset_memory_telemetry()
+    assert sample_devices() == {}
+
+
+def test_fit_report_memory_section_and_drift(rng):
+    """A plain fit on the simulated provider lands per-device peak bytes
+    and a finite budget_drift_ratio (staged-bytes prediction vs measured
+    peak) in its report."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.parallel.mesh import get_mesh
+
+    X = rng.normal(size=(300, 8)).astype(np.float32)
+    m = KMeans(k=3, seed=1, maxIter=4).fit(pd.DataFrame({"features": list(X)}))
+    mem = m.fit_report().get("memory")
+    assert mem is not None and mem["provider"] == "simulated"
+    n_dev = get_mesh().devices.size
+    assert len(mem["per_device_peak_bytes"]) == n_dev
+    assert all(v > 0 for v in mem["per_device_peak_bytes"].values())
+    assert mem["peak_total_bytes"] == sum(
+        mem["per_device_peak_bytes"].values()
+    )
+    assert mem["predicted_bytes"]["staged"] > 0
+    drift = mem["budget_drift_ratio"]["staged"]
+    assert np.isfinite(drift) and drift > 0
+    # the registry gauge carries the same ratio, labeled by estimator
+    snap = snapshot()
+    assert snap["budget_drift_ratio"]["est=KMeans:staged"] == pytest.approx(
+        drift
+    )
+
+
+def test_budget_drift_across_cache_insert_evict_cycle(rng):
+    """The device cache's n_dev+2 reservation is a byte-model prediction:
+    an insert must record it (`budget_predicted_bytes{est=device_cache}`)
+    and measure it (`budget_drift_ratio{est=device_cache}`), and the
+    records survive an evict + re-insert cycle."""
+    from spark_rapids_ml_tpu.parallel.device_cache import (
+        clear_device_cache,
+        get_device_cache,
+        get_or_stage,
+    )
+
+    clear_device_cache()
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    try:
+        entry = get_or_stage(X, None, None, np.float32, working_factor=2.0)
+        assert entry is not None
+        snap = snapshot()
+        predicted = snap["budget_predicted_bytes"]["est=device_cache"]
+        assert predicted == entry.nbytes > 0
+        drift1 = snap["budget_drift_ratio"]["est=device_cache"]
+        assert np.isfinite(drift1) and drift1 > 0
+        # evict, then re-insert: the cycle re-records both sides
+        get_device_cache().evict(entry.fingerprint)
+        del entry
+        entry2 = get_or_stage(X, None, None, np.float32, working_factor=2.0)
+        assert entry2 is not None
+        drift2 = snapshot()["budget_drift_ratio"]["est=device_cache"]
+        assert np.isfinite(drift2) and drift2 > 0
+        decisions = snapshot()["budget_decisions_total"]
+        assert decisions.get("label=device_cache,over=false", 0) >= 2
+    finally:
+        clear_device_cache()
+
+
+# ---------------------------------------------------------------------------
+# compile telemetry: listener, labels, recompiles
+# ---------------------------------------------------------------------------
+
+
+def test_compile_listener_attributes_to_label_scope():
+    """A fresh-shape jit compile inside a `compile_label` scope lands on
+    `compile_seconds{fn=<label>}` and bumps `compiles_total` (jax 0.4.x
+    ships the monitoring hooks this relies on; the explicit
+    `compile_span` path is version-independent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_ml_tpu.telemetry.compile import (
+        compile_label,
+        compile_span,
+        install_jax_listener,
+    )
+
+    if not install_jax_listener():
+        pytest.skip("jax.monitoring listener unavailable on this jax")
+    before = snapshot()
+    n = int(time.time()) % 97 + 131  # a shape this process never compiled
+    with compile_label("unit_label"):
+        jax.jit(lambda x: (x * 2).sum())(jnp.ones((n, 3)))
+    d = delta(before, snapshot())
+    fams = [ls for ls in d.get("compile_seconds", {}) if "fn=unit_label" in ls]
+    assert fams, d.get("compile_seconds")
+    assert any(
+        "fn=unit_label" in ls for ls in d.get("compiles_total", {})
+    )
+    # the explicit span path records phase=explicit + a trace span
+    reset_trace()
+    with compile_span("explicit_seam"):
+        pass
+    assert any(
+        e.name == "compile[explicit_seam]" for e in get_trace_events()
+    )
+    d2 = delta(before, snapshot())
+    assert any(
+        "fn=explicit_seam" in ls and "phase=explicit" in ls
+        for ls in d2.get("compile_seconds", {})
+    )
+
+
+def test_recompiles_once_per_elastic_relower(tmp_path, rng):
+    """Driven end to end via the `device_lost` fault kind: ONE elastic
+    recovery re-lowers the staging programs exactly ONCE —
+    `recompiles_total{fn=staging_programs,reason=elastic_shrink}` moves
+    by 1, the report's compile section counts 1 recompile, and the
+    marker sits inside the interrupted fit's span tree."""
+    from spark_rapids_ml_tpu.clustering import KMeans
+    from spark_rapids_ml_tpu.resilience import fault_inject
+    from spark_rapids_ml_tpu.resilience.elastic import reset_elastic
+
+    set_config(
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        retry_backoff_s=0.01,
+        retry_jitter=0.0,
+    )
+    X = rng.normal(size=(400, 6)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    before = snapshot()
+    try:
+        with fault_inject("kmeans_lloyd", "device_lost", times=1, skip=3):
+            m = KMeans(k=3, seed=7, maxIter=8, tol=0.0).fit(df)
+        d = delta(before, snapshot())
+        key = "fn=staging_programs,reason=elastic_shrink"
+        assert d["recompiles_total"][key] == 1
+        rep = m.fit_report()
+        assert rep["compile"]["recompiles"] == 1
+        assert rep["compile"]["recompiled"] == ["staging_programs"]
+
+        def _names(nodes, out):
+            for node in nodes:
+                out.append(node["name"])
+                _names(node.get("children", []), out)
+
+        names: list = []
+        _names(rep["spans"], names)
+        assert names.count("recompile[staging_programs]") == 1
+    finally:
+        reset_elastic()
+
+
+def test_profile_dir_cross_referenced_in_report(tmp_path, rng):
+    """With `profile_dir` set the report names the XProf capture next to
+    its run_id — the artifact and the trace stop being orphans."""
+    from spark_rapids_ml_tpu.feature import PCA
+
+    pdir = tmp_path / "xprof"
+    set_config(profile_dir=str(pdir))
+    X = rng.normal(size=(200, 6)).astype(np.float32)
+    m = (
+        PCA(k=2)
+        .setInputCol("features")
+        .setOutputCol("o")
+        .fit(pd.DataFrame({"features": list(X)}))
+    )
+    rep = m.fit_report()
+    assert rep["profile"]["dir"] == str(pdir)
+    # the jax CPU profiler wrote a capture during the fit window
+    assert rep["profile"].get("artifacts"), rep["profile"]
