@@ -52,6 +52,12 @@ _HIGHER_BETTER = (
     "_recall",
     "_ari",
     "_overlap_ratio",
+    # the fused stage-and-solve engine's overlap (fused.py): less
+    # overlap = the stage and solve phases re-serializing — a regression.
+    # `_overlap_sec` must land HERE too or the `_sec` suffix rule below
+    # would gate the absolute overlap seconds backwards
+    "_overlap_fraction",
+    "_overlap_sec",
 )
 _HIGHER_CONTAINS = ("_recall_at_",)
 

@@ -35,6 +35,7 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("cagra_", "ann"),
     ("knn_", "knn"),
     ("dbscan_", "dbscan"),
+    ("fused_", "fused_pca"),
     ("kmeans_", "kmeans"),
     ("logreg_", "logreg"),
     ("pca_", "pca"),

@@ -90,7 +90,7 @@ run_batch () { python -m pytest -q "$@"; }
 run_batch tests/test_common_estimator.py tests/test_metrics.py \
     tests/test_tuning_pipeline.py tests/test_device_cache.py \
     tests/test_pca.py tests/test_kmeans.py \
-    tests/test_linear_regression.py "$@"
+    tests/test_linear_regression.py tests/test_fused_stats.py "$@"
 run_batch tests/test_logistic_regression.py tests/test_sparse_logreg.py \
     tests/test_f32_and_weights.py tests/test_random_forest.py "$@"
 run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
@@ -284,15 +284,19 @@ BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \
     JAX_PLATFORMS=cpu python bench.py
 
 echo "== perf smoke: bench history + regression gate =="
-# two consecutive tiny-shape runs (logreg headline + staging section)
-# must (a) append exactly one normalized record per section per run to
-# the history file, (b) pass the comparator within noise, and (c) fail
-# it nonzero on an injected 2x slowdown.  benchmark/{history,compare}.py
-# are the units under test; unit coverage is in tests/test_bench_history.py.
+# two consecutive tiny-shape runs (logreg headline + staging +
+# fused_pca sections) must (a) append exactly one normalized record per
+# section per run to the history file, (b) pass the comparator within
+# noise, and (c) fail it nonzero on an injected 2x slowdown AND on an
+# injected SERIALIZATION of the fused stage-and-solve path.
+# benchmark/{history,compare}.py are the units under test; unit
+# coverage is in tests/test_bench_history.py.
 PERF_DIR=$(mktemp -d)
 for i in 1 2; do
     BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_MAX_ITER=10 \
-    BENCH_WORKLOADS=staging BENCH_STAGING_ROWS=40000 BENCH_ISOLATE=0 \
+    BENCH_WORKLOADS=staging,fused_pca BENCH_STAGING_ROWS=40000 \
+    BENCH_FUSED_ROWS=48000 BENCH_FUSED_COLS=64 BENCH_FUSED_SOLVER_ROWS=2000 \
+    BENCH_ISOLATE=0 \
     BENCH_PROBE_TIMEOUT=0 BENCH_RUN_ID="perf-smoke-$i" \
     BENCH_HISTORY_PATH="$PERF_DIR/history.jsonl" \
     JAX_PLATFORMS=cpu python bench.py > /dev/null
@@ -305,6 +309,29 @@ done
 # cold-fit improvement from run 1 warming the compile cache must not gate
 python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
     --sections logreg --tolerance 0.75 --abs-floor 0.05
+# fused-path gate: the overlap fraction is the deterministic signal
+# (interval intersection of chunk prep and device-busy windows —
+# 0.85-0.92 at this shape, run to run); timings at smoke scale are
+# jitter and get an effectively-infinite band
+python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
+    --sections fused_pca --tolerance 10 \
+    --band fused_pca_overlap_fraction=0.75 --abs-floor 0.05
+# injected serialization: staging_pipeline_depth=1 strips the producer
+# thread, the prep and accumulate windows stop co-occurring, and the
+# recorded overlap_fraction collapses to 0.0 — the comparator must trip
+BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_MAX_ITER=10 \
+    BENCH_WORKLOADS=fused_pca \
+    BENCH_FUSED_ROWS=48000 BENCH_FUSED_COLS=64 BENCH_FUSED_SOLVER_ROWS=2000 \
+    BENCH_ISOLATE=0 BENCH_PROBE_TIMEOUT=0 \
+    BENCH_RUN_ID="perf-smoke-serialized" \
+    BENCH_HISTORY_PATH="$PERF_DIR/history.jsonl" \
+    SPARK_RAPIDS_ML_TPU_STAGING_PIPELINE_DEPTH=1 \
+    JAX_PLATFORMS=cpu python bench.py > /dev/null
+if python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
+    --run-id perf-smoke-serialized --sections fused_pca --tolerance 10 \
+    --band fused_pca_overlap_fraction=0.5 --abs-floor 0.05; then
+    echo "comparator must fail when the fused path serializes"; exit 1
+fi
 # record-count contract + the injected-slowdown gate
 python - "$PERF_DIR/history.jsonl" << 'EOF'
 import json, subprocess, sys
@@ -314,10 +341,17 @@ records = [json.loads(l) for l in open(path) if l.strip()]
 per_run = {}
 for r in records:
     per_run.setdefault(r["run_id"], []).append(r["section"])
-assert set(per_run) == {"perf-smoke-1", "perf-smoke-2"}, per_run
+assert set(per_run) == {
+    "perf-smoke-1", "perf-smoke-2", "perf-smoke-serialized"
+}, per_run
 for rid, secs in per_run.items():
     assert len(secs) == len(set(secs)), f"duplicate section records: {rid}"
-    assert {"logreg", "staging"} <= set(secs), (rid, secs)
+    want = (
+        {"logreg", "fused_pca"}
+        if rid == "perf-smoke-serialized"
+        else {"logreg", "staging", "fused_pca"}
+    )
+    assert want <= set(secs), (rid, secs)
 # inject a synthetic 2x slowdown of run 2 and expect the gate to trip
 from benchmark.compare import metric_direction
 

@@ -123,18 +123,19 @@ _DEFAULTS: Dict[str, Any] = {
     # whole-process runs (CI smoke, bench rehearsals).
     "fault_inject_spec": "",
     # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
-    # fusedL2Knn analog, ops/pallas_knn.py): "auto" (default) MEASURES
-    # both kernels once per shape bucket on TPU backends and commits to
-    # the faster (ops/knn.py knn_topk_single — the same probe discipline
-    # as umap_kernel=auto; ties break to XLA, the platform prior), "off"
-    # forces the XLA materialize-then-top_k kernels, "on" forces the
-    # fused kernel everywhere (CPU runs the Pallas interpreter — slow,
-    # for tests).  Why measured, not assumed: on a v5e chip at 100k
-    # items x 10k queries x k=32 the fused kernel's VPU selection loop
-    # ran 3.5x SLOWER than XLA's matmul+top_k pipeline (BENCH_r03;
-    # knn_pallas_speedup 0.38x re-confirmed in BENCH_r05), so a
-    # blanket-on auto would pin every default fit to the slower kernel.
-    "pallas_knn": "auto",
+    # fusedL2Knn analog, ops/pallas_knn.py).  RETIRED from the default
+    # path ("win or delete", ROADMAP item 3): two on-chip rounds measured
+    # it LOSING — 3.5x slower than XLA's matmul+top_k at 100k x 10k x
+    # k=32 (BENCH_r03) and knn_pallas_speedup 0.38x re-confirmed in
+    # BENCH_r05 — and the "auto" measured probe burned a cold compile +
+    # 6 timed evaluations per shape bucket of warm-up time re-discovering
+    # that verdict every process.  "off" (default) uses the XLA
+    # blocked/coltiled kernels outright; "auto" re-enables the per-bucket
+    # measured probe (ops/knn.py knn_topk_single, the umap_kernel=auto
+    # discipline) for future backends where the tradeoff may flip; "on"
+    # forces the fused kernel everywhere (CPU runs the Pallas
+    # interpreter — slow, experiments/tests only).
+    "pallas_knn": "off",
     # MXU matmul precision for rank/threshold-critical distance kernels
     # (kNN/ANN/DBSCAN; ops/precision.py).  "highest" = exact f32 (cuML
     # parity; TPU default bf16 passes mis-rank near-tied neighbors —
@@ -152,8 +153,51 @@ _DEFAULTS: Dict[str, Any] = {
     # MXU precision for sufficient-statistics matmuls feeding a matrix
     # inversion/eigendecomposition (PCA covariance, LinReg Gram) —
     # ops/precision.py stats_precision().  "highest" = f32-exact (cuML
-    # parity); "high"/"default" trade fidelity for speed at very large d.
+    # parity); "high"/"default" trade fidelity for speed at very large d;
+    # "high_compensated" = 3-pass bf16 chunk products (~2x MXU throughput
+    # at large d, like "high") PLUS Kahan-compensated f32 chunk-level
+    # accumulation in the streamed/fused statistics paths, bounding the
+    # across-chunk error plain "high" leaves uncontrolled.
     "stats_precision": "highest",
+    # Fused stage-and-solve for one-pass sufficient-statistics estimators
+    # (PCA, LinearRegression — fused.py): each host chunk's Gram/moment/
+    # cross contribution is accumulated ON DEVICE as the chunk lands, with
+    # the producer thread prepping chunk N+1 while the mesh accumulates
+    # chunk N — the stage and solve phases collapse toward
+    # max(stage, solve) instead of adding (BENCH_r05: 220s stage + 193s
+    # solve for refconfig PCA).  "auto" (default) fuses eligible fits
+    # (dense, single-process, est. staged bytes >= fused.py's
+    # _AUTO_MIN_BYTES); "on" fuses every eligible fit regardless of size;
+    # "off" keeps the two-phase stage-then-solve path.  Ineligible
+    # consumers (device-cache CV/grid fits that refit resident data,
+    # sparse/ELL staging, multi-process, DeviceDataset inputs already on
+    # device) always keep the two-phase path.
+    "fused_stage_solve": "auto",
+    # Parallel parquet range-readers for the FUSED producer (fused.py
+    # iter_parquet_chunks): each reader decodes ONLY its row-group share
+    # of a single parquet file, so a scan with idle time (real IO,
+    # multi-core hosts) parallelizes.  Legal only on the fused path —
+    # chunks arrive in arbitrary order, which the commutative statistics
+    # sums tolerate but positional staging cannot.  Default 1 (single
+    # in-order pruned reader): the 1-core CI box measured the warm Arrow
+    # scan CPU-bound (readers=2 == readers=1 on a pruned scan, and the
+    # naive scan-and-skip variant was 2-4x WORSE); raise it on real
+    # multi-core ingest hosts.
+    "fused_parquet_readers": 1,
+    # PCA eigensolver (ops/pca.py): "full" = exact d x d covariance +
+    # eigh (cuML PCAMG parity, O(n d^2)); "randomized" = Halko
+    # randomized range-finder (O(n d l), l = k + pca_oversamples) —
+    # the tradeoff the reference's cuML MG path makes when k << d;
+    # "auto" (default) picks randomized when d is large and k small
+    # (see ops/pca.py resolve_pca_solver).
+    "pca_solver": "auto",
+    # Oversampling columns for the randomized range-finder (l = k +
+    # pca_oversamples; Halko et al. recommend 5-10).
+    "pca_oversamples": 10,
+    # Power (subspace) iterations for the randomized range-finder: each
+    # adds one O(n d l) pass and sharpens the spectrum (2 is enough for
+    # slowly-decaying spectra; 0 is fastest).
+    "pca_power_iters": 2,
     # UMAP SGD epoch kernel: "auto" picks the scatter-free structured
     # kernel on TPU backends (unsorted scatter-adds serialize on TPU; the
     # structured form replaces them with dense sums + one sorted

@@ -655,6 +655,81 @@ class _TpuEstimator(Estimator, _TpuCaller):
             supervised=self._is_supervised(),
         )
 
+    # -- fused stage-and-solve (fused.py) ------------------------------------
+
+    def _supports_fused_stats(self) -> bool:
+        """Whether this estimator can fit from chunk-accumulated
+        sufficient statistics folded in WHILE the data stages (the fused
+        stage-and-solve engine, fused.py) — PCA/LinearRegression
+        override.  Distinct from `_supports_streaming_stats` only in
+        intent: the same statistics, but accumulated mesh-sharded with
+        the host producer thread overlapped, for datasets that would
+        otherwise stage fully and then solve."""
+        return False
+
+    def _fit_fused(self, batch: _ArrayBatch) -> Dict[str, Any]:
+        """Fused fit of an in-memory host batch (estimators declaring
+        `_supports_fused_stats` implement)."""
+        raise NotImplementedError
+
+    def _fit_fused_parquet(self, path: str) -> Dict[str, Any]:
+        """Fused fit streaming chunks straight from parquet (the decode
+        is the overlapped host prep)."""
+        raise NotImplementedError
+
+    def _maybe_fit_fused(
+        self, source, est_bytes: Optional[float] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Route an eligible fit through the fused stage-and-solve path
+        (conf `fused_stage_solve`): sufficient statistics accumulate on
+        the mesh as each chunk lands instead of staging everything and
+        then solving.  Returns model attrs, or None to keep the
+        two-phase path — sparse batches, multi-process, conf off/below
+        the auto threshold, and estimators without the capability all
+        degrade.  `source` is a host `_ArrayBatch` or a parquet path.
+
+        The dispatch runs under the retry policy with the accumulators
+        treated as RE-CREATABLE state: any mid-pass failure (the
+        `fused_accumulate` fault site — OOM, device loss) restarts the
+        whole pass with fresh accumulators on the (possibly shrunken)
+        mesh, never resuming half-accumulated sums, so a retried chunk
+        can never double-count."""
+        if not self._supports_fused_stats():
+            return None
+        from .fused import fused_enabled
+
+        is_path = isinstance(source, str)
+        if not is_path:
+            from .data import _is_sparse
+
+            if _is_sparse(source.X) or self._use_sparse_kernel(source):
+                return None
+            if est_bytes is None:
+                est_bytes = (
+                    int(source.X.shape[0])
+                    * int(source.X.shape[1])
+                    * np.dtype(self._out_dtype(source.X)).itemsize
+                )
+        if est_bytes is None or not fused_enabled(est_bytes):
+            return None
+        from .fused import fused_mode
+        from .resilience import retry_call
+        from .tracing import trace
+
+        self.logger.info(
+            "Fused stage-and-solve: accumulating sufficient statistics "
+            "on the mesh while the data stages (fused_stage_solve="
+            f"{fused_mode()}, ~{est_bytes / 2**20:.0f} MiB)."
+        )
+        with trace("fused_fit", self.logger):
+            return retry_call(
+                (lambda: self._fit_fused_parquet(source))
+                if is_path
+                else (lambda: self._fit_fused(source)),
+                label="fused_fit",
+                log=self.logger,
+            )
+
     # -- streaming ingest (reference reserved-memory loader utils.py:403-522) --
 
     def _supports_streaming_stats(self) -> bool:
@@ -711,6 +786,13 @@ class _TpuEstimator(Estimator, _TpuCaller):
                     "multi-pass streamed statistics."
                 )
                 return self._run_streaming_fit(path)
+            # within budget: the fused stage-and-solve path accumulates
+            # the statistics while the parquet chunks decode — the
+            # 220s-stage + 193s-solve additivity this collapses is the
+            # refconfig gap (fused.py; conf fused_stage_solve)
+            attrs = self._maybe_fit_fused(path, est_bytes=need)
+            if attrs is not None:
+                return attrs
         ds_dev = fit_input = None
         try:
             from .resilience import maybe_inject
@@ -849,6 +931,12 @@ class _TpuEstimator(Estimator, _TpuCaller):
                             batch = self._extract(dataset)
                             self._validate_input(batch)
                         attrs = self._maybe_fit_sparse_stats(batch)
+                    if attrs is None:
+                        # fused stage-and-solve for in-memory host
+                        # batches: statistics accumulate chunk-by-chunk
+                        # as the rows land on the mesh (fused.py) —
+                        # None keeps the two-phase stage-then-solve path
+                        attrs = self._maybe_fit_fused(batch)
                     if attrs is None:
                         with trace("stage", self.logger):
                             # hand-off list: see the DeviceDataset branch

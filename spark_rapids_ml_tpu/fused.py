@@ -1,0 +1,713 @@
+#
+# Fused stage-and-solve engine — the one-pass sufficient-statistics
+# estimators (PCA, LinearRegression) solve WHILE they stage.  The
+# two-phase path pays stage + solve strictly additively (BENCH_r05:
+# refconfig PCA = 220 s stage + 193 s solve); here each host chunk's
+# Gram/moment/cross contribution is folded into a donated device
+# accumulator the moment the chunk lands on the mesh, with the host
+# producer thread (utils.prefetch_iter — the PR-2 staging pipeline's
+# overlap primitive) prepping chunk N+1 while the mesh accumulates chunk
+# N.  The full staged array never exists: HBM holds one sharded chunk +
+# the (d,d)-class accumulator, and wall time collapses toward
+# max(stage, solve).  The "Parallel-and-stream accelerator" overlap
+# pattern and Snap ML's chunk-local host/accelerator accumulate
+# (PAPERS.md) are the templates.
+#
+# Routing lives in core.py (`fused_stage_solve` conf: auto|on|off);
+# the chunk update math lives in ops/stats.py (shared with the
+# multi-pass streaming fits, incl. the Kahan-compensated
+# `stats_precision="high_compensated"` level); the randomized PCA
+# range-finder (ops/pca.py) composes: each of its tall-skinny passes is
+# one stage-overlapped accumulation here.
+#
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from .config import get_config
+from .telemetry.registry import dict_view as _dict_view
+from .utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.fused")
+
+# last fused run (read by bench.py's `fused_pca` section, the refconfig
+# stage/solve split, and the per-fit telemetry report — the report copies
+# these keys only when `stamp` lands inside the fit's window):
+#   host_prep_s   chunk decode/cast/slice time on the reader thread(s)
+#   device_acc_s  device_put + accumulate time on the consumer thread
+#   overlap_s     measured wall-clock INTERSECTION of the prep intervals
+#                 with the device-busy intervals (_interval_overlap_s)
+#   overlap_fraction  overlap_s / min(prep_s, acc_s) in [0, 1]
+FUSED_METRICS = _dict_view(
+    "fused_last",
+    "Last fused stage-and-solve run (prep/accumulate/overlap seconds)",
+)
+
+# `fused_stage_solve="auto"` fuses once the estimated staged bytes reach
+# this floor: below it one plain staging beats the per-chunk dispatch
+# overhead and the two-phase path keeps its exact single-matmul stats
+_AUTO_MIN_BYTES = 64 * 1024 * 1024
+
+# aim for at least this many chunks per pass so the producer thread has
+# something to run ahead on (one-chunk passes cannot overlap)
+_MIN_CHUNKS = 8
+_MIN_CHUNK_ROWS = 1024
+
+
+def fused_mode() -> str:
+    mode = str(get_config("fused_stage_solve")).lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"fused_stage_solve must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+def fused_enabled(est_bytes: float) -> bool:
+    """Whether the conf routes an ELIGIBLE fit (dense, single-process,
+    statistics-capable — the caller checks those) through the fused
+    engine: "on" always, "auto" once the staged-bytes estimate clears
+    `_AUTO_MIN_BYTES`, "off" never."""
+    mode = fused_mode()
+    if mode == "off":
+        return False
+    import jax
+
+    if jax.process_count() > 1:
+        # per-process chunk puts cannot assemble a global mesh array;
+        # multi-process keeps the two-phase / streamed-stats paths
+        return False
+    if mode == "on":
+        return True
+    return float(est_bytes) >= _AUTO_MIN_BYTES
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_steps(
+    kind: str, d: int, l: int, dtype_str: str,
+    precision: str, compensated: bool,
+):
+    """(weighted, unweighted) donated jitted accumulator steps per
+    (kind, shape, dtype, precision) — repeated fused fits at the same
+    shape reuse the compiled programs instead of re-tracing a fresh
+    closure every fit (measured ~80 ms/fit of re-lowering on the CPU
+    mesh).  The unweighted variant skips the `X * w` chunk-sized
+    materialization for full chunks of weightless fits (ops/stats.py).
+    `precision`/`compensated` key the conf values baked in at trace
+    time; the initial zeros accumulator is built FRESH per fit (it is
+    donated into the first step and must never be reused)."""
+    import jax
+
+    from .ops.stats import (
+        linreg_acc,
+        linreg_step_unw,
+        pca_moment_acc,
+        pca_moment_step_unw,
+        pca_projected_acc,
+        pca_projected_step_unw,
+    )
+
+    dtype = np.dtype(dtype_str)
+    if kind == "linreg":
+        _, step = linreg_acc(d, dtype)
+        unw = linreg_step_unw
+    elif kind == "pca_moments":
+        _, step = pca_moment_acc(d, dtype)
+        unw = pca_moment_step_unw
+    elif kind == "pca_projected":
+        _, step = pca_projected_acc(d, l, dtype)
+        unw = pca_projected_step_unw
+    else:
+        raise ValueError(f"unknown fused accumulator kind {kind!r}")
+    return (
+        jax.jit(step, donate_argnums=0),
+        jax.jit(unw, donate_argnums=0),
+    )
+
+
+def _acc_spec(kind: str, d: int, l: int, dtype):
+    """(fresh initial accumulator, cached (weighted, unweighted) jitted
+    steps) for `kind`."""
+    from .ops.precision import stats_compensated
+    from .ops.stats import linreg_acc, pca_moment_acc, pca_projected_acc
+
+    dtype = np.dtype(dtype)
+    if kind == "linreg":
+        acc, _ = linreg_acc(d, dtype)
+    elif kind == "pca_moments":
+        acc, _ = pca_moment_acc(d, dtype)
+    else:
+        acc, _ = pca_projected_acc(d, l, dtype)
+    steps = _jitted_steps(
+        kind, d, l, dtype.str,
+        str(get_config("stats_precision")).lower(), stats_compensated(),
+    )
+    return acc, steps
+
+
+def fused_chunk_rows(n: int, d: int, itemsize: int, n_dev: int) -> int:
+    """Rows per fused chunk: bounded by `staging_chunk_bytes` clamped to
+    the transfer-RPC ceiling (the same sizing rule as the staging
+    pipeline's pieces — mesh._staging_chunk_rows), floored so a pass
+    still yields >= `_MIN_CHUNKS` chunks to overlap, and device-aligned
+    so every chunk shards evenly over the mesh."""
+    from .parallel.mesh import _MAX_PUT_BYTES
+
+    row_bytes = max(d * itemsize, 1)
+    budget = max(
+        1,
+        min(int(get_config("staging_chunk_bytes")), _MAX_PUT_BYTES)
+        // row_bytes,
+    )
+    rows = min(budget, max(-(-n // _MIN_CHUNKS), _MIN_CHUNK_ROWS))
+    rows = min(rows, max(n, 1))
+    return -(-rows // n_dev) * n_dev
+
+
+def iter_host_chunks(
+    X: np.ndarray,
+    y: Optional[np.ndarray],
+    weight: Optional[np.ndarray],
+    chunk_rows: int,
+    dtype: np.dtype,
+    label_dtype: Optional[np.dtype] = None,
+) -> Iterable[Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]]:
+    """Fixed-shape `(X_chunk, y_chunk, w_chunk)` host chunks of an
+    in-memory batch, fully PREPARED (cast + zero-padded tail + validity
+    weights) inside `__next__` — on the fused pipeline this runs on the
+    producer thread, overlapped with the device accumulate.  Mirrors
+    `streaming.iter_chunks` semantics: padding rows carry weight 0, so
+    they are mathematically absent from every statistic."""
+    dtype = np.dtype(dtype)
+    ldt = np.dtype(label_dtype) if label_dtype is not None else dtype
+    n = int(X.shape[0])
+    for lo in range(0, n, chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        rows = hi - lo
+        if rows == chunk_rows:
+            cX = np.ascontiguousarray(X[lo:hi], dtype=dtype)
+            # None = full unweighted chunk: the engine dispatches the
+            # unweighted step (skips the X*w chunk copy entirely)
+            cw = (
+                None
+                if weight is None
+                else np.asarray(weight[lo:hi], dtype)
+            )
+            cy = (
+                None if y is None
+                else np.ascontiguousarray(
+                    np.asarray(y[lo:hi]).reshape(-1), dtype=ldt
+                )
+            )
+        else:  # zero-padded tail chunk (padding weight stays 0)
+            cX = np.zeros((chunk_rows,) + X.shape[1:], dtype)
+            cX[:rows] = X[lo:hi]
+            cw = np.zeros((chunk_rows,), dtype)
+            cw[:rows] = 1.0 if weight is None else np.asarray(
+                weight[lo:hi], dtype
+            )
+            cy = None
+            if y is not None:
+                cy = np.zeros((chunk_rows,), ldt)
+                cy[:rows] = np.asarray(y[lo:hi]).reshape(-1)
+        yield cX, cy, cw
+
+
+def _partition_row_groups(path: str, readers: int) -> Optional[list]:
+    """Split a single parquet FILE's row groups into `readers`
+    row-balanced contiguous shares.  None when the path is a dataset
+    directory or has too few groups to split — the caller then runs one
+    in-order reader."""
+    import os
+
+    if readers <= 1 or os.path.isdir(path):
+        return None
+    import pyarrow.parquet as pq
+
+    md = pq.ParquetFile(path).metadata
+    sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+    if len(sizes) < 2:
+        return None
+    readers = min(readers, len(sizes))
+    total = sum(sizes)
+    shares, cur, acc = [], [], 0
+    per = -(-total // readers)
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        if acc >= per and len(shares) < readers - 1:
+            shares.append(cur)
+            cur, acc = [], 0
+    if cur:
+        shares.append(cur)
+    return shares if len(shares) > 1 else None
+
+
+def _reader_batches(path: str, columns, chunk_rows: int, groups=None):
+    """Arrow record batches for the fused producer: a row-group-pruned
+    `ParquetFile` reader for single files (measurably leaner than the
+    dataset scanner on this path, and `groups` lets a parallel range
+    reader decode ONLY its share — never scan-and-skip), with the
+    dataset-scanner fallback for directory datasets."""
+    import os
+
+    if not os.path.isdir(path):
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        kw = {} if groups is None else {"row_groups": list(groups)}
+        yield from pf.iter_batches(
+            batch_size=chunk_rows, columns=columns, **kw
+        )
+        return
+    import pyarrow.dataset as pads
+
+    yield from pads.dataset(path, format="parquet").to_batches(
+        columns=columns, batch_size=chunk_rows
+    )
+
+
+def _range_chunks(
+    path: str,
+    features_col,
+    features_cols,
+    label_col,
+    weight_col,
+    chunk_rows: int,
+    dtype: np.dtype,
+    ldt: np.dtype,
+    groups,
+) -> Iterable[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
+    """One reader's share of the fused parquet producer: decode + prepare
+    `(X, y, w)` chunks of its row-group share
+    (`streaming.chunks_from_batches` — the exact iter_chunks decode and
+    fixed-shape chunking).  `w` is None for full unweighted chunks (the
+    engine's fast step) and the zero-weighted padding vector on the
+    share's tail chunk."""
+    from .streaming import _scan_columns, _weights_host, chunks_from_batches
+
+    columns = _scan_columns(features_col, features_cols, label_col, weight_col)
+    for cX, cy, cw, n_c in chunks_from_batches(
+        _reader_batches(path, columns, chunk_rows, groups),
+        features_col, features_cols, label_col, weight_col,
+        chunk_rows, np.dtype(dtype),
+    ):
+        if cw is None and n_c == chunk_rows:
+            w_host = None  # full unweighted chunk -> unweighted step
+        else:
+            w_host = np.asarray(_weights_host(cw, n_c, chunk_rows, dtype))
+        cy_out = None
+        if cy is not None:
+            cy_out = np.zeros((chunk_rows,), ldt)
+            cy_out[:n_c] = np.asarray(cy[:n_c]).reshape(-1)
+        yield cX, cy_out, w_host
+
+
+def iter_parquet_chunks(
+    path: str,
+    features_col,
+    features_cols,
+    label_col,
+    weight_col,
+    chunk_rows: int,
+    dtype: np.dtype,
+    label_dtype: Optional[np.dtype] = None,
+    readers: Optional[int] = None,
+    prep: Optional[Dict[str, Any]] = None,
+) -> Iterable[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
+    """Parquet producer for the fused engine: the chunk decode (the
+    dominant host cost of the refconfig fits) runs through a row-group-
+    pruned reader, optionally split across `readers` PARALLEL range-
+    reader threads (`fused_parquet_readers` conf), each decoding ONLY
+    its own row-group share.  Chunk ARRIVAL ORDER is then arbitrary —
+    which is exactly why this lives on the fused path only: the
+    statistics accumulators are commutative sums, so order is
+    irrelevant, while the two-phase staging path must place rows at
+    their global offsets and keeps its single in-order scan.  Parallel
+    readers pay off when the scan has idle time to recover (real IO, a
+    multi-core host — the parallel-sharded-reader direction of ROADMAP
+    item 4); the 1-core CI box measured the Arrow scan CPU-bound with
+    readers=2 ~= readers=1, hence the conservative default of 1.
+
+    When `prep` is given, each reader's decode time and wall intervals
+    accumulate there ({"s": float, "iv": [(t0, t1)]}) — the engine's
+    overlap measurement; interval lists from concurrent readers overlap
+    and are union-merged by the consumer."""
+    ldt = np.dtype(label_dtype) if label_dtype is not None else np.dtype(dtype)
+    if readers is None:
+        readers = max(1, int(get_config("fused_parquet_readers")))
+
+    def _timed(it):
+        if prep is None:
+            return it
+        from .parallel.mesh import timed_iter
+
+        return timed_iter(it, prep)
+
+    shares = _partition_row_groups(path, readers)
+    if shares is None:
+        yield from _timed(
+            _range_chunks(
+                path, features_col, features_cols, label_col, weight_col,
+                chunk_rows, dtype, ldt, None,
+            )
+        )
+        return
+
+    import queue
+    import threading
+    q: "queue.Queue" = queue.Queue(maxsize=len(shares) + 1)
+    _DONE = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        # bounded puts (the utils.prefetch_iter discipline): an abandoned
+        # consumer must not pin reader threads + chunk copies forever
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(groups) -> None:
+        try:
+            # per-reader interval tracking shares the one `prep` dict:
+            # "s" additions race benignly under the GIL (a lost update
+            # drops a timing sample, never chunk data); list.append is
+            # atomic
+            for item in _timed(
+                _range_chunks(
+                    path, features_col, features_cols, label_col,
+                    weight_col, chunk_rows, dtype, ldt, groups,
+                )
+            ):
+                if not _put(item):
+                    return
+            _put(_DONE)
+        except BaseException as e:  # surface reader errors on the consumer
+            _put(e)
+
+    threads = [
+        threading.Thread(target=_run, args=(g,), daemon=True)
+        for g in shares
+    ]
+    for t in threads:
+        t.start()
+    try:
+        done = 0
+        while done < len(threads):
+            item = q.get()
+            if item is _DONE:
+                done += 1
+                continue
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def _merge_intervals(iv):
+    """Sort + coalesce possibly-overlapping intervals (parallel readers
+    decode concurrently) into a disjoint sorted list."""
+    if not iv:
+        return []
+    iv = sorted(iv)
+    out = [list(iv[0])]
+    for lo, hi in iv[1:]:
+        if lo <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], hi)
+        else:
+            out.append([lo, hi])
+    return [tuple(x) for x in out]
+
+
+def _interval_overlap_s(a, b) -> float:
+    """Total length of the pairwise intersection of two sorted,
+    non-overlapping wall-clock interval lists — how long BOTH sides were
+    simultaneously active.  This is the engine's overlap measure: chunk
+    prep intervals (producer thread) against device-busy intervals
+    (put + accumulate-in-flight), so 'the solve ran inside the stage
+    window' is read off the clock directly instead of inferred from
+    duration sums (which a time-sliced single-core host systematically
+    under-attributes)."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def accumulate_chunks(
+    acc: Dict[str, Any],
+    step: Callable,
+    chunks: Iterable,
+    mesh,
+    *,
+    has_y: bool = False,
+    extra_args: Tuple = (),
+    prep: Optional[Dict[str, Any]] = None,
+) -> Tuple[Dict[str, Any], Dict[str, float]]:
+    """Drive one fused pass: fold every prepared host chunk into the
+    donated device accumulator as it lands, with chunk prep running
+    `staging_pipeline_depth` items ahead on a producer thread.
+
+    `acc`/`step` come from `_acc_spec` (`step` is the CACHED
+    (weighted, unweighted) jitted donated step pair — `_jitted_steps`);
+    the accumulator replicates over
+    `mesh`, each chunk is `device_put` row-SHARDED (one transfer per
+    device — no GSPMD replication: the put happens outside any jitted
+    program), and the jitted step's matmuls psum over the mesh.
+    `extra_args` (e.g. the randomized range-finder's Omega) replicate
+    once up front.
+
+    Returns (host float64 stats with Kahan carries folded, pass metrics:
+    wall_s/host_prep_s/device_acc_s/chunks/bytes)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from .ops.stats import acc_to_host_f64
+    from .parallel.mesh import DATA_AXIS, _staging_depth, data_pspec, timed_iter
+    from .resilience import maybe_inject
+    from .telemetry.compile import compile_label
+    from .utils import prefetch_iter
+
+    mat_sh = NamedSharding(mesh, data_pspec(2))
+    row_sh = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    rep_sh = NamedSharding(mesh, PartitionSpec())
+
+    acc = jax.device_put(acc, rep_sh)
+    extra_dev = tuple(jax.device_put(a, rep_sh) for a in extra_args)
+    step_w, step_unw = step if isinstance(step, tuple) else (step, None)
+
+    t0 = time.perf_counter()
+    # a producer that tracks its own prep (the parallel parquet readers)
+    # passes the shared dict in; otherwise the chunk iterator is wrapped
+    # here and prep time is measured on the consumer's pull
+    self_timed = prep is not None
+    if prep is None:
+        prep = {"s": 0.0, "iv": []}
+        chunks = timed_iter(chunks, prep)
+
+    depth = _staging_depth()
+    acc_s = 0.0
+    acc_iv = []
+    n_chunks = 0
+    nbytes = 0
+    # the accumulate is synced per chunk: the donated accumulator
+    # serializes steps on device anyway, and the sync (a) bounds
+    # in-flight device memory to one chunk + the accumulator and (b)
+    # keeps device_acc_s honest — the producer thread keeps decoding the
+    # NEXT chunks through the whole blocked window, which is exactly the
+    # overlap the engine exists to create
+    with compile_label("fused_stats"):
+        for cX, cy, cw in prefetch_iter(chunks, depth):
+            # the fused-path fault site: an injected OOM/device_lost here
+            # fails the WHOLE pass, and the retry (core.py fused_fit
+            # dispatch) restarts it with FRESH accumulators — re-creatable
+            # state, never resumed mid-pass, so chunks cannot double-count
+            maybe_inject("fused_accumulate")
+            ta = time.perf_counter()
+            args = [jax.device_put(cX, mat_sh)]
+            if cw is not None:
+                args.append(jax.device_put(cw, row_sh))
+            if has_y:
+                args.append(jax.device_put(cy, row_sh))
+            args.extend(extra_dev)
+            step_j = step_w if cw is not None else (step_unw or step_w)
+            acc = step_j(acc, *args)
+            jax.block_until_ready(acc)
+            tb = time.perf_counter()
+            acc_s += tb - ta
+            acc_iv.append((ta, tb))
+            n_chunks += 1
+            nbytes += (
+                cX.nbytes
+                + (cw.nbytes if cw is not None else 0)
+                + (cy.nbytes if has_y else 0)
+            )
+    host = acc_to_host_f64(acc)
+    wall = time.perf_counter() - t0
+    prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
+    return host, {
+        "wall_s": wall,
+        "host_prep_s": prep["s"],
+        "device_acc_s": acc_s,
+        "overlap_s": _interval_overlap_s(prep_iv, acc_iv),
+        "chunks": n_chunks,
+        "bytes": nbytes,
+    }
+
+
+def _record_metrics(
+    label: str, kind: str, passes: int, totals: Dict[str, float],
+    solver: Optional[str] = None,
+) -> None:
+    """Fold one fused fit's (possibly multi-pass) totals into
+    `FUSED_METRICS` + a trace event.  overlap_s is the measured
+    wall-clock intersection of the chunk-prep intervals (producer
+    thread) with the device-busy intervals (`_interval_overlap_s`);
+    overlap_fraction normalizes it by the smaller phase (1.0 = the
+    cheaper phase ran entirely inside the other's window)."""
+    wall = totals.get("wall_s", 0.0)
+    prep_s = totals.get("host_prep_s", 0.0)
+    acc_s = totals.get("device_acc_s", 0.0)
+    overlap_s = max(totals.get("overlap_s", 0.0), 0.0)
+    overlap = 0.0
+    if min(prep_s, acc_s) > 1e-9:
+        overlap = max(0.0, min(overlap_s / min(prep_s, acc_s), 1.0))
+    FUSED_METRICS.clear()
+    FUSED_METRICS.update(
+        stamp=round(time.time(), 3),
+        label=label,
+        kind=kind,
+        passes=int(passes),
+        chunks=int(totals.get("chunks", 0)),
+        bytes=int(totals.get("bytes", 0)),
+        wall_s=round(wall, 4),
+        host_prep_s=round(prep_s, 4),
+        device_acc_s=round(acc_s, 4),
+        overlap_s=round(overlap_s, 4),
+        overlap_fraction=round(overlap, 4),
+    )
+    if solver is not None:
+        FUSED_METRICS["solver"] = solver
+    from .tracing import event
+
+    event(
+        f"fused_stats[{label}]",
+        detail=(
+            f"{kind} passes={passes} chunks={totals.get('chunks', 0)} "
+            f"{totals.get('bytes', 0) / 1e6:.1f}MB wall={wall:.2f}s "
+            f"overlap={overlap:.2f}"
+        ),
+    )
+
+
+def _merge_totals(totals: Dict[str, float], m: Dict[str, float]) -> None:
+    for k, v in m.items():
+        totals[k] = totals.get(k, 0.0) + v
+
+
+def _resolve_producer(produced):
+    """A producer factory returns either a plain chunk iterable (the
+    engine times prep on its pull) or `(iterable, prep_dict)` when the
+    producer tracks its own decode time (the parallel parquet
+    readers)."""
+    if isinstance(produced, tuple):
+        return produced
+    return produced, None
+
+
+def fused_linreg_stats(
+    producer_factory: Callable[[int], Iterable],
+    d: int,
+    dtype,
+    label: str = "linreg",
+) -> Dict[str, Any]:
+    """One fused pass of the weighted Gram/moment/cross statistics
+    (ops/stats.py `linreg_acc`).  `producer_factory(n_dev)` yields
+    prepared `(X, y, w)` chunks.  Returns host float64 stats in the
+    exact shape `LinearRegression._attrs_from_stats` consumes."""
+    from .parallel.mesh import get_mesh
+
+    dtype = np.dtype(dtype)
+    mesh = get_mesh()
+    acc, step = _acc_spec("linreg", d, 0, dtype)
+    chunks, prep = _resolve_producer(producer_factory(mesh.devices.size))
+    host, m = accumulate_chunks(
+        acc, step, chunks, mesh, has_y=True, prep=prep,
+    )
+    _record_metrics(label, "linreg", 1, m)
+    return host
+
+
+def fused_pca_stats(
+    producer_factory: Callable[[int], Iterable],
+    d: int,
+    k: int,
+    dtype,
+    label: str = "pca",
+) -> Dict[str, Any]:
+    """Fused PCA statistics with solver dispatch (ops/pca.py
+    `resolve_pca_solver`):
+
+    - "full": one pass of the exact second moments ->
+      {"kind": "moments", "S", "s1", "sw"} (the shape
+      `PCA._attrs_from_moments` consumes).
+    - "randomized": the Halko range-finder run STAGE-OVERLAPPED — each
+      tall-skinny product (sketch, power iterations, final projection)
+      is one fused O(n d l) pass re-streamed through
+      `producer_factory` -> {"kind": "projected", "Q", "SQ", "s1",
+      "ssq", "sw"} for `ops.pca.pca_attrs_from_projected`.
+
+    `producer_factory(n_dev)` must return a FRESH chunk iterator per
+    call (multi-pass re-reads the source)."""
+    from .ops.pca import resolve_pca_solver
+    from .parallel.mesh import get_mesh
+
+    dtype = np.dtype(dtype)
+    mesh = get_mesh()
+    n_dev = mesh.devices.size
+    solver, l, power_iters, _reason = resolve_pca_solver(d, k, streamed=True)
+    if solver == "full":
+        acc, step = _acc_spec("pca_moments", d, 0, dtype)
+        chunks, prep = _resolve_producer(producer_factory(n_dev))
+        host, m = accumulate_chunks(acc, step, chunks, mesh, prep=prep)
+        _record_metrics(label, "pca_moments", 1, m, solver="full")
+        host["kind"] = "moments"
+        return host
+
+    totals: Dict[str, float] = {}
+
+    def projected_pass(omega: np.ndarray) -> Dict[str, Any]:
+        acc, step = _acc_spec("pca_projected", d, l, dtype)
+        chunks, prep = _resolve_producer(producer_factory(n_dev))
+        host, m = accumulate_chunks(
+            acc, step, chunks, mesh,
+            extra_args=(np.asarray(omega, dtype),), prep=prep,
+        )
+        _merge_totals(totals, m)
+        return host
+
+    # deterministic sketch (same data -> same components across refits)
+    omega = np.random.default_rng(0).standard_normal((d, l)).astype(dtype)
+    st = projected_pass(omega)
+    sw = float(st["sw"])
+    mean = st["s1"] / sw
+
+    def centered(SOm: np.ndarray, om: np.ndarray) -> np.ndarray:
+        # (A^T A) om from the raw projected moments: Σ w x (xᵀom) −
+        # sw·mean·(meanᵀom)
+        return np.asarray(SOm, np.float64) - sw * np.outer(mean, mean @ om)
+
+    Y = centered(st["SOm"], omega)
+    for _ in range(power_iters):
+        Q, _r = np.linalg.qr(Y)
+        Y = centered(projected_pass(Q.astype(dtype))["SOm"], Q)
+    Q, _r = np.linalg.qr(Y)
+    final = projected_pass(Q.astype(dtype))
+    passes = 2 + power_iters
+    _record_metrics(label, "pca_projected", passes, totals, solver="randomized")
+    return {
+        "kind": "projected",
+        "Q": Q,
+        "SQ": final["SOm"],
+        "s1": final["s1"],
+        "ssq": final["ssq"],
+        "sw": final["sw"],
+        "k": k,
+    }

@@ -99,12 +99,27 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
         self._set_params(**kwargs)
 
     def _fit_array(self, fit_input: FitInput) -> Dict[str, Any]:
-        from ..ops.pca import pca_fit
+        from ..ops.pca import pca_fit, pca_fit_randomized, resolve_pca_solver
 
         k = fit_input.params.get("n_components") or fit_input.pdesc.n
         if k > fit_input.pdesc.n:
             raise ValueError(f"k={k} exceeds the number of features {fit_input.pdesc.n}")
-        mean, components, ev, evr, sv = pca_fit(fit_input.X, fit_input.w, int(k))
+        k = int(k)
+        # solver dispatch (conf pca_solver=auto|full|randomized): the
+        # randomized range-finder scales the Gram work O(n d l) instead
+        # of O(n d^2) when k << d — the same tradeoff the reference's
+        # cuML MG path makes (ops/pca.py resolve_pca_solver)
+        solver, l, power_iters, _reason = resolve_pca_solver(
+            fit_input.pdesc.n, k
+        )
+        if solver == "randomized":
+            mean, components, ev, evr, sv = pca_fit_randomized(
+                fit_input.X, fit_input.w, k, int(l), int(power_iters)
+            )
+        else:
+            mean, components, ev, evr, sv = pca_fit(
+                fit_input.X, fit_input.w, k
+            )
         return {
             "mean_": np.asarray(mean),
             "components_": np.asarray(components),
@@ -117,6 +132,91 @@ class PCA(PCAClass, _TpuEstimator, _PCATpuParams):
 
     def _supports_streaming_stats(self) -> bool:
         return True
+
+    def _supports_fused_stats(self) -> bool:
+        # one-pass second moments: the chunk order of arrival is
+        # irrelevant, so accumulating while staging is exact
+        return True
+
+    def _resolved_k(self, d: int) -> int:
+        k = int(self._tpu_params.get("n_components") or d)
+        if k > d:
+            raise ValueError(f"k={k} exceeds the number of features {d}")
+        return k
+
+    def _fit_fused(self, batch: _ArrayBatch) -> Dict[str, Any]:
+        """Fused stage-and-solve over an in-memory host batch: the
+        moment (or randomized-projected) accumulators fold each chunk in
+        as it lands on the mesh (fused.py)."""
+        from ..fused import fused_chunk_rows, fused_pca_stats, iter_host_chunks
+
+        X = batch.X
+        dtype = self._out_dtype(X)
+        d = int(X.shape[1])
+
+        def producer(n_dev: int):
+            rows = fused_chunk_rows(
+                int(X.shape[0]), d, np.dtype(dtype).itemsize, n_dev
+            )
+            return iter_host_chunks(X, None, batch.weight, rows, dtype)
+
+        st = fused_pca_stats(producer, d, self._resolved_k(d), dtype)
+        return self._attrs_from_fused(st, dtype)
+
+    def _fit_fused_parquet(self, path: str) -> Dict[str, Any]:
+        """Fused stage-and-solve straight from parquet: the chunk decode
+        (the dominant host cost of the refconfig fits) runs on the
+        producer thread, overlapped with the on-mesh accumulation."""
+        from ..fused import (
+            fused_chunk_rows,
+            fused_pca_stats,
+            iter_parquet_chunks,
+        )
+        from ..streaming import parquet_row_count, probe_num_features
+
+        fcol, fcols, _, weight_col, dtype = self._streaming_io_params()
+        d = probe_num_features(path, fcol, fcols)
+        n = parquet_row_count(path)
+
+        def producer(n_dev: int):
+            rows = fused_chunk_rows(n, d, np.dtype(dtype).itemsize, n_dev)
+            prep = {"s": 0.0, "iv": []}  # readers self-time their decode
+            return (
+                iter_parquet_chunks(
+                    path, fcol, fcols, None, weight_col, rows, dtype,
+                    prep=prep,
+                ),
+                prep,
+            )
+
+        st = fused_pca_stats(producer, d, self._resolved_k(d), dtype)
+        return self._attrs_from_fused(st, dtype)
+
+    def _attrs_from_fused(self, st: Dict[str, Any], dtype) -> Dict[str, Any]:
+        if st.get("kind") == "projected":
+            return self._attrs_from_projected(st, dtype)
+        return self._attrs_from_moments(st, dtype)
+
+    def _attrs_from_projected(self, st: Dict[str, Any], dtype) -> Dict[str, Any]:
+        """Finalize the stage-overlapped RANDOMIZED fit: the small
+        Q-projected eigenproblem from the accumulated tall-skinny
+        moments (ops/pca.py `pca_attrs_from_projected`)."""
+        from ..ops.pca import pca_attrs_from_projected
+
+        mean, components, ev, evr, sv = pca_attrs_from_projected(
+            st["Q"], st["SQ"], st["s1"], st["ssq"], float(st["sw"]),
+            int(st["k"]),
+        )
+        dtype = np.dtype(dtype)
+        return {
+            "mean_": mean.astype(dtype),
+            "components_": components.astype(dtype),
+            "explained_variance_": ev.astype(dtype),
+            "explained_variance_ratio_": evr.astype(dtype),
+            "singular_values_": sv.astype(dtype),
+            "n_cols": int(components.shape[1]),
+            "dtype": str(dtype.name),
+        }
 
     def _supports_fold_weights(self) -> bool:
         # weighted mean/covariance + deterministic eigh (ops/pca.py

@@ -279,6 +279,67 @@ class LinearRegression(
     def _supports_streaming_stats(self) -> bool:
         return True
 
+    def _supports_fused_stats(self) -> bool:
+        # the Gram/moment/cross sums are chunk-order invariant, so
+        # accumulating while staging is exact (fused.py)
+        return True
+
+    def _fit_fused(self, batch: _ArrayBatch) -> Dict[str, Any]:
+        """Fused stage-and-solve over an in-memory host batch: the
+        weighted Gram/moment/cross statistics accumulate on the mesh as
+        each chunk lands (fused.py), then the same host solve as the
+        streamed-statistics path.  Summary rmse/mse/r2 come from the
+        one-pass SSE expansion (as on every streamed path — no staged
+        array exists for a residual pass)."""
+        from ..fused import fused_chunk_rows, fused_linreg_stats, iter_host_chunks
+
+        X = batch.X
+        dtype = self._out_dtype(X)
+        d = int(X.shape[1])
+        ldt = self._fit_label_dtype() or np.dtype(dtype)
+
+        def producer(n_dev: int):
+            rows = fused_chunk_rows(
+                int(X.shape[0]), d, np.dtype(dtype).itemsize, n_dev
+            )
+            return iter_host_chunks(
+                X, batch.y, batch.weight, rows, dtype, label_dtype=ldt
+            )
+
+        st = fused_linreg_stats(producer, d, dtype)
+        return self._attrs_from_stats(st, dtype)
+
+    def _fit_fused_parquet(self, path: str) -> Dict[str, Any]:
+        """Fused stage-and-solve straight from parquet (decode on the
+        producer thread, accumulate on the mesh)."""
+        from ..fused import (
+            fused_chunk_rows,
+            fused_linreg_stats,
+            iter_parquet_chunks,
+        )
+        from ..streaming import parquet_row_count, probe_num_features
+
+        fcol, fcols, label_col, weight_col, dtype = self._streaming_io_params()
+        if label_col is None:
+            raise ValueError("labelCol must be set for LinearRegression")
+        d = probe_num_features(path, fcol, fcols)
+        n = parquet_row_count(path)
+        ldt = self._fit_label_dtype() or np.dtype(dtype)
+
+        def producer(n_dev: int):
+            rows = fused_chunk_rows(n, d, np.dtype(dtype).itemsize, n_dev)
+            prep = {"s": 0.0, "iv": []}  # readers self-time their decode
+            return (
+                iter_parquet_chunks(
+                    path, fcol, fcols, label_col, weight_col, rows, dtype,
+                    label_dtype=ldt, prep=prep,
+                ),
+                prep,
+            )
+
+        st = fused_linreg_stats(producer, d, dtype)
+        return self._attrs_from_stats(st, dtype)
+
     def _supports_fold_weights(self) -> bool:
         # closed-form/FISTA solve over w-weighted sufficient statistics
         # (ops/linear.py SUPPORTS_ZERO_WEIGHT_ROWS): a CV fold mask is

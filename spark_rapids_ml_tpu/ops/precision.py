@@ -41,6 +41,15 @@ def distance_precision() -> jax.lax.Precision:
     return _LEVELS[name]
 
 
+# "high_compensated" runs the chunk matmuls at HIGH (3-pass bf16) and
+# additionally Kahan-compensates the f32 CHUNK-LEVEL accumulation in the
+# streamed/fused statistics paths (ops/stats.py accumulator specs): the
+# across-chunk floating-point drift plain "high" leaves uncontrolled —
+# a later chunk's small contribution can vanish entirely against a large
+# f32 running sum — is carried in a twin compensation array instead.
+_STATS_LEVELS = dict(_LEVELS, high_compensated=jax.lax.Precision.HIGH)
+
+
 def stats_precision() -> jax.lax.Precision:
     """Precision for sufficient-statistics matmuls whose output feeds a
     matrix inversion or eigendecomposition (PCA covariance, the linear-
@@ -49,10 +58,21 @@ def stats_precision() -> jax.lax.Precision:
     coefficient fidelity for almost nothing — the Gram is <1 s of device
     time even at the reference's 1M x 3000 config.  Config key
     `stats_precision`, default "highest"; "high" (3-pass bf16) trades
-    ~2^-14 relative error for ~2x on very large-d grams."""
+    ~2^-14 relative error for ~2x on very large-d grams;
+    "high_compensated" adds Kahan-compensated chunk accumulation on top
+    of the 3-pass bf16 products (see `stats_compensated`)."""
     name = str(get_config("stats_precision")).lower()
-    if name not in _LEVELS:
+    if name not in _STATS_LEVELS:
         raise ValueError(
-            f"stats_precision must be one of {sorted(_LEVELS)}; got {name!r}"
+            f"stats_precision must be one of {sorted(_STATS_LEVELS)}; "
+            f"got {name!r}"
         )
-    return _LEVELS[name]
+    return _STATS_LEVELS[name]
+
+
+def stats_compensated() -> bool:
+    """Whether the chunked statistics accumulators (streaming.py and the
+    fused stage-and-solve engine) carry a Kahan compensation term per
+    accumulated array, bounding across-chunk f32 summation error
+    independently of chunk count (`stats_precision="high_compensated"`)."""
+    return str(get_config("stats_precision")).lower() == "high_compensated"

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @jax.jit
@@ -33,3 +34,206 @@ def weighted_moments(X: jax.Array, w: jax.Array):
 def standardize(X: jax.Array, w: jax.Array, mean: jax.Array, std: jax.Array):
     """(X - mean) / std with padded rows kept at zero."""
     return ((X - mean) / std) * (w[:, None] > 0)
+
+
+# ---------------------------------------------------------------------------
+# Chunk-accumulator specs — ONE owner for the per-chunk sufficient-
+# statistics update math shared by the multi-pass streaming fits
+# (streaming.py `_pca_acc`/`_linreg_acc`) and the fused stage-and-solve
+# engine (fused.py).  Each spec is (initial accumulator dict, step fn);
+# callers jit the step with the accumulator donated.  When
+# `stats_precision="high_compensated"` every accumulated array carries a
+# Kahan compensation twin (key suffix `!c`): the across-chunk f32
+# summation error — which grows with chunk count and can swallow a small
+# chunk's contribution entirely against a large running sum — stays
+# bounded independently of how many chunks stream through.  Host
+# finalization folds the carries via `acc_to_host_f64`.
+# ---------------------------------------------------------------------------
+
+CARRY_SUFFIX = "!c"
+
+
+def _kahan_add(acc: dict, key: str, contrib):
+    """acc[key] += contrib, Kahan-compensated when the accumulator was
+    built with carries (a `key!c` twin exists).  XLA does not reassociate
+    floats by default, so the compensation survives compilation."""
+    ckey = key + CARRY_SUFFIX
+    if ckey not in acc:
+        return {key: acc[key] + contrib}
+    y = contrib - acc[ckey]
+    t = acc[key] + y
+    return {key: t, ckey: (t - acc[key]) - y}
+
+
+def _zeros_acc(shapes: dict, dtype, compensated: bool) -> dict:
+    import jax.numpy as jnp
+
+    acc = {k: jnp.zeros(s, dtype) for k, s in shapes.items()}
+    if compensated:
+        acc.update(
+            {k + CARRY_SUFFIX: jnp.zeros(s, dtype) for k, s in shapes.items()}
+        )
+    return acc
+
+
+def pca_moment_acc(d: int, dtype):
+    """(init, step(acc, X, w)) for the PCA second moments
+    (S = sum w x x^T, s1 = sum w x, sw = sum w)."""
+    from .precision import stats_compensated, stats_precision
+
+    hi = stats_precision()  # f32-exact moments by default (cuML parity)
+    comp = stats_compensated()
+
+    def step(acc, X, w):
+        import jax.numpy as jnp
+
+        Xw = X * w[:, None]
+        out = dict(acc)
+        out.update(_kahan_add(acc, "S", jnp.matmul(Xw.T, X, precision=hi)))
+        out.update(_kahan_add(acc, "s1", Xw.sum(axis=0)))
+        out.update(_kahan_add(acc, "sw", w.sum()))
+        return out
+
+    return _zeros_acc({"S": (d, d), "s1": (d,), "sw": ()}, dtype, comp), step
+
+
+def pca_projected_acc(d: int, l: int, dtype):
+    """(init, step(acc, X, w, omega)) for the RANDOMIZED range-finder's
+    projected moments: SOm = sum w x (x^T Omega) — the O(n d l) sketch of
+    the O(n d^2) second-moment matrix — plus s1, ssq (per-column
+    sum w x^2, for the exact total variance), and sw.  Composes with the
+    fused engine so the range-finder runs stage-overlapped, one pass per
+    power iteration (`omega` is the current subspace basis)."""
+    from .precision import stats_compensated, stats_precision
+
+    hi = stats_precision()
+    comp = stats_compensated()
+
+    def step(acc, X, w, omega):
+        import jax.numpy as jnp
+
+        Xw = X * w[:, None]
+        proj = jnp.matmul(X, omega, precision=hi)  # (rows, l)
+        out = dict(acc)
+        out.update(
+            _kahan_add(acc, "SOm", jnp.matmul(Xw.T, proj, precision=hi))
+        )
+        out.update(_kahan_add(acc, "s1", Xw.sum(axis=0)))
+        out.update(_kahan_add(acc, "ssq", (Xw * X).sum(axis=0)))
+        out.update(_kahan_add(acc, "sw", w.sum()))
+        return out
+
+    shapes = {"SOm": (d, l), "s1": (d,), "ssq": (d,), "sw": ()}
+    return _zeros_acc(shapes, dtype, comp), step
+
+
+def linreg_acc(d: int, dtype):
+    """(init, step(acc, X, w, y)) for the weighted Gram/moment/cross
+    statistics (ops/linear.py `linreg_sufficient_stats`)."""
+    from .precision import stats_compensated, stats_precision
+
+    hi = stats_precision()  # f32-exact stats by default (cuML parity)
+    comp = stats_compensated()
+
+    def step(acc, X, w, y):
+        import jax.numpy as jnp
+
+        Xw = X * w[:, None]
+        out = dict(acc)
+        out.update(_kahan_add(acc, "gram", jnp.matmul(Xw.T, X, precision=hi)))
+        out.update(_kahan_add(acc, "sxy", jnp.matmul(Xw.T, y, precision=hi)))
+        out.update(_kahan_add(acc, "s1", Xw.sum(axis=0)))
+        out.update(_kahan_add(acc, "sw", w.sum()))
+        out.update(_kahan_add(acc, "sy", (y * w).sum()))
+        out.update(_kahan_add(acc, "syy", (y * y * w).sum()))
+        return out
+
+    shapes = {
+        "gram": (d, d), "sxy": (d,), "s1": (d,), "sw": (),
+        "sy": (), "syy": (),
+    }
+    return _zeros_acc(shapes, dtype, comp), step
+
+
+# Unweighted step variants: a FULL chunk with no weight column has w
+# identically 1, and the weighted steps' `Xw = X * w[:, None]` then
+# materializes a full chunk-sized copy just to multiply by one — XLA
+# does not fuse elementwise producers into dot_general operands, so the
+# copy is real.  The fused engine dispatches these for full unweighted
+# chunks and the weighted step only for the padded tail / weighted fits.
+
+
+def pca_moment_step_unw(acc, X):
+    import jax.numpy as jnp
+
+    from .precision import stats_precision
+
+    hi = stats_precision()
+    out = dict(acc)
+    out.update(_kahan_add(acc, "S", jnp.matmul(X.T, X, precision=hi)))
+    out.update(_kahan_add(acc, "s1", X.sum(axis=0)))
+    out.update(
+        _kahan_add(acc, "sw", jnp.asarray(X.shape[0], acc["sw"].dtype))
+    )
+    return out
+
+
+def pca_projected_step_unw(acc, X, omega):
+    import jax.numpy as jnp
+
+    from .precision import stats_precision
+
+    hi = stats_precision()
+    proj = jnp.matmul(X, omega, precision=hi)
+    out = dict(acc)
+    out.update(_kahan_add(acc, "SOm", jnp.matmul(X.T, proj, precision=hi)))
+    out.update(_kahan_add(acc, "s1", X.sum(axis=0)))
+    out.update(_kahan_add(acc, "ssq", (X * X).sum(axis=0)))
+    out.update(
+        _kahan_add(acc, "sw", jnp.asarray(X.shape[0], acc["sw"].dtype))
+    )
+    return out
+
+
+def linreg_step_unw(acc, X, y):
+    import jax.numpy as jnp
+
+    from .precision import stats_precision
+
+    hi = stats_precision()
+    out = dict(acc)
+    out.update(_kahan_add(acc, "gram", jnp.matmul(X.T, X, precision=hi)))
+    out.update(_kahan_add(acc, "sxy", jnp.matmul(X.T, y, precision=hi)))
+    out.update(_kahan_add(acc, "s1", X.sum(axis=0)))
+    out.update(
+        _kahan_add(acc, "sw", jnp.asarray(X.shape[0], acc["sw"].dtype))
+    )
+    out.update(_kahan_add(acc, "sy", y.sum()))
+    out.update(_kahan_add(acc, "syy", (y * y).sum()))
+    return out
+
+
+def acc_to_host_f64(acc) -> dict:
+    """Device accumulator -> float64 host dict.  Kahan carries fold into
+    their primaries in f64 (`value - carry` recovers the residual of the
+    final step) and never appear in the result."""
+    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
+    out = {}
+    for k, v in host.items():
+        if k.endswith(CARRY_SUFFIX):
+            continue
+        c = host.get(k + CARRY_SUFFIX)
+        out[k] = v if c is None else v - c
+    return out
+
+
+def total_variance(ssq: np.ndarray, s1: np.ndarray, sw: float) -> float:
+    """Exact total (trace-of-covariance) variance from the accumulated
+    per-column moments: sum_j (Σ w x_j² − sw·mean_j²) / (sw − 1).  Lets
+    the randomized PCA solver report exact explained-variance ratios
+    without ever forming the d×d covariance."""
+    mean = np.asarray(s1, np.float64) / sw
+    return float(
+        (np.asarray(ssq, np.float64) - sw * mean * mean).sum()
+        / max(sw - 1.0, 1.0)
+    )

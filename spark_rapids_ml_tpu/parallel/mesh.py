@@ -479,6 +479,30 @@ class ShardedRowWriter:
         return out
 
 
+def timed_iter(producer: Iterable, prep: dict) -> Iterator:
+    """Wrap `producer` so each item's production time (the host prep the
+    pipeline overlaps: slice/cast/densify/decode) accumulates into
+    `prep["s"]`.  When `prep` carries an `"iv"` list, each item's
+    (start, end) wall interval is appended too — the fused engine
+    (fused.py) intersects those with its device-busy intervals to
+    measure the stage/solve overlap directly.  Shared by the staging
+    pipeline below and the fused engine — one owner for the prep-side
+    of every overlap measurement."""
+    it = iter(producer)
+    iv = prep.get("iv")
+    while True:
+        t = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            return
+        t1 = time.perf_counter()
+        prep["s"] += t1 - t
+        if iv is not None:
+            iv.append((t, t1))
+        yield item
+
+
 def run_staging_pipeline(
     writer: ShardedRowWriter, producer: Iterable, label: str = "stage"
 ) -> "jax.Array":
@@ -494,15 +518,7 @@ def run_staging_pipeline(
     prep = {"s": 0.0}
 
     def timed() -> Iterator:
-        it = iter(producer)
-        while True:
-            t = time.perf_counter()
-            try:
-                item = next(it)
-            except StopIteration:
-                return
-            prep["s"] += time.perf_counter() - t
-            yield item
+        return timed_iter(producer, prep)
 
     from ..telemetry.compile import compile_label
     from ..utils import prefetch_iter

@@ -10,7 +10,11 @@
 # iteration it fires on.
 #
 # Sites instrumented today: fit_kernel, transform_dispatch, stage_parquet,
-# kmeans_lloyd, lbfgs_iteration, linreg_fista.
+# kmeans_lloyd, lbfgs_iteration, linreg_fista, fused_accumulate (the
+# fused stage-and-solve chunk loop, fused.py — fires per accumulated
+# chunk; accumulators are RE-CREATABLE state, so the recovery contract is
+# restart-the-pass, never resume: tests assert a retried pass cannot
+# double-count chunks).
 #
 from __future__ import annotations
 

@@ -231,6 +231,22 @@ def _decode_batch(
         )
 
 
+def _scan_columns(
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+) -> list:
+    columns = (
+        list(features_cols) if features_cols else [features_col]
+    )
+    if label_col:
+        columns.append(label_col)
+    if weight_col:
+        columns.append(weight_col)
+    return columns
+
+
 def iter_chunks(
     path: str,
     features_col: Optional[str],
@@ -251,22 +267,37 @@ def iter_chunks(
     buffer; partial batches accumulate into a freshly allocated chunk."""
     import pyarrow.dataset as ds
 
-    columns = (
-        list(features_cols) if features_cols else [features_col]
-    )
-    if label_col:
-        columns.append(label_col)
-    if weight_col:
-        columns.append(weight_col)
+    columns = _scan_columns(features_col, features_cols, label_col, weight_col)
     dataset = ds.dataset(path, format="parquet")
+    yield from chunks_from_batches(
+        dataset.to_batches(columns=columns, batch_size=chunk_rows),
+        features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype, row_range=row_range,
+    )
 
+
+def chunks_from_batches(
+    batches,
+    features_col: Optional[str],
+    features_cols: Sequence[str],
+    label_col: Optional[str],
+    weight_col: Optional[str],
+    chunk_rows: int,
+    dtype: np.dtype,
+    row_range: Optional[Tuple[int, int]] = None,
+) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray], int]]:
+    """The chunk-assembly half of `iter_chunks`, decoupled from the Arrow
+    scanner so alternative batch sources — the fused engine's
+    row-group-pruned parallel range readers (fused.py) — reuse the exact
+    decode + fixed-shape chunking semantics.  `row_range` counts rows
+    from the start of THIS batch stream."""
     d = None  # derived from the first decoded batch (no separate probe)
     bufX = bufy = bufw = None
     fill = 0
     seen = 0  # global rows consumed so far
     lo, hi = row_range if row_range is not None else (0, None)
 
-    for batch in dataset.to_batches(columns=columns, batch_size=chunk_rows):
+    for batch in batches:
         nb = batch.num_rows
         if nb == 0:
             continue
@@ -596,58 +627,28 @@ def _sum_across_processes(host_stats: dict) -> dict:
 def _linreg_acc(d: int, dtype):
     """(initial accumulator, donated jitted step) for the weighted
     Gram/moment/cross statistics (ops/linear.py `linreg_sufficient_stats`)
-    — shared by the parquet-streaming and blocked-CSR fits."""
+    — shared by the parquet-streaming and blocked-CSR fits.  The update
+    math (incl. the optional Kahan compensation under
+    `stats_precision="high_compensated"`) lives in the shared spec
+    (ops/stats.py `linreg_acc`), the same one the fused stage-and-solve
+    engine accumulates through."""
     import jax
-    import jax.numpy as jnp
 
-    from .ops.precision import stats_precision
+    from .ops.stats import linreg_acc
 
-    def _step(acc, X, w, y):
-        Xw = X * w[:, None]
-        hi = stats_precision()  # f32-exact stats by default (cuML parity)
-        return {
-            "gram": acc["gram"] + jnp.matmul(Xw.T, X, precision=hi),
-            "sxy": acc["sxy"] + jnp.matmul(Xw.T, y, precision=hi),
-            "s1": acc["s1"] + Xw.sum(axis=0),
-            "sw": acc["sw"] + w.sum(),
-            "sy": acc["sy"] + (y * w).sum(),
-            "syy": acc["syy"] + (y * y * w).sum(),
-        }
-
-    acc = {
-        "gram": jnp.zeros((d, d), dtype),
-        "sxy": jnp.zeros((d,), dtype),
-        "s1": jnp.zeros((d,), dtype),
-        "sw": jnp.zeros((), dtype),
-        "sy": jnp.zeros((), dtype),
-        "syy": jnp.zeros((), dtype),
-    }
-    return acc, jax.jit(_step, donate_argnums=0)
+    acc, step = linreg_acc(d, dtype)
+    return acc, jax.jit(step, donate_argnums=0)
 
 
 def _pca_acc(d: int, dtype):
     """(initial accumulator, donated jitted step) for the PCA second
-    moments (S = sum w x x^T, s1, sw)."""
+    moments (S = sum w x x^T, s1, sw) — shared spec, see `_linreg_acc`."""
     import jax
-    import jax.numpy as jnp
 
-    from .ops.precision import stats_precision
+    from .ops.stats import pca_moment_acc
 
-    def _step(acc, X, w):
-        Xw = X * w[:, None]
-        hi = stats_precision()  # f32-exact moments by default (cuML parity)
-        return {
-            "S": acc["S"] + jnp.matmul(Xw.T, X, precision=hi),
-            "s1": acc["s1"] + Xw.sum(axis=0),
-            "sw": acc["sw"] + w.sum(),
-        }
-
-    acc = {
-        "S": jnp.zeros((d, d), dtype),
-        "s1": jnp.zeros((d,), dtype),
-        "sw": jnp.zeros((), dtype),
-    }
-    return acc, jax.jit(_step, donate_argnums=0)
+    acc, step = pca_moment_acc(d, dtype)
+    return acc, jax.jit(step, donate_argnums=0)
 
 
 def iter_csr_chunks(
@@ -711,19 +712,16 @@ def linreg_streaming_stats(
             acc, jnp.asarray(cX), jnp.asarray(w_host),
             jnp.asarray(np.asarray(cy, dtype)),
         )
-    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
-    return _sum_across_processes(host)
+    return _acc_to_host_f64(acc)
 
 
 def _acc_to_host_f64(acc) -> dict:
-    """Device accumulator -> float64 host dict, summed across processes
+    """Device accumulator -> float64 host dict (Kahan carries folded —
+    ops/stats.py `acc_to_host_f64`), summed across processes
     (multi-process batches hold only local rows, like the parquet path)."""
-    import jax
+    from .ops.stats import acc_to_host_f64
 
-    host = {
-        k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()
-    }
-    return _sum_across_processes(host)
+    return _sum_across_processes(acc_to_host_f64(acc))
 
 
 def linreg_stats_from_csr(
@@ -779,8 +777,7 @@ def pca_streaming_stats(
     ):
         w_host = _weights_host(cw, n_c, chunk_rows, dtype)
         acc = step(acc, jnp.asarray(cX), jnp.asarray(w_host))
-    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
-    return _sum_across_processes(host)
+    return _acc_to_host_f64(acc)
 
 
 def pca_stats_from_csr(

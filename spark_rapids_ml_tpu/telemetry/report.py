@@ -306,6 +306,45 @@ class FitTelemetry:
         except Exception:
             pass
 
+        # fused stage-and-solve metrics (fused.py FUSED_METRICS): same
+        # last-run-state discipline as STAGE_METRICS — copy only when the
+        # fused pass completed inside this fit's window and no other fit
+        # overlapped; likewise the PCA solver decision (ops/pca.py)
+        fused: Dict[str, Any] = {}
+        solver_decision: Dict[str, Any] = {}
+        try:
+            from ..fused import FUSED_METRICS
+
+            if (
+                not self._overlapped
+                and FUSED_METRICS.get("stamp", 0) >= self._t0
+            ):
+                fused = {
+                    k: FUSED_METRICS.get(k)
+                    for k in (
+                        "kind", "solver", "passes", "chunks", "bytes",
+                        "wall_s", "host_prep_s", "device_acc_s",
+                        "overlap_s", "overlap_fraction",
+                    )
+                    if FUSED_METRICS.get(k) is not None
+                }
+        except Exception:
+            pass
+        try:
+            from ..ops.pca import LAST_SOLVER_DECISION
+
+            if (
+                not self._overlapped
+                and LAST_SOLVER_DECISION.get("stamp", 0) >= self._t0
+            ):
+                solver_decision = {
+                    k: LAST_SOLVER_DECISION.get(k)
+                    for k in ("solver", "reason", "d", "k", "l", "power_iters")
+                    if LAST_SOLVER_DECISION.get(k) is not None
+                }
+        except Exception:
+            pass
+
         report: Dict[str, Any] = {
             "run_id": self.run_id,
             "estimator": self.estimator,
@@ -321,6 +360,10 @@ class FitTelemetry:
             "cache": _view_delta(deltas, "device_cache"),
             "resilience": self._resilience_section(events, deltas),
         }
+        if fused:
+            report["fused"] = fused
+        if solver_decision:
+            report["solver_decision"] = solver_decision
         if self._watermark is not None:
             memory = self._watermark.section()
             if memory:
