@@ -6,7 +6,7 @@
 # rehearsal; on chip when the tunnel is up, CPU-feasible (hours) when
 # not.  Analog of the reference's ANN benchmark
 # (python/benchmark/benchmark_runner.py approximate_nearest_neighbors +
-# its recall-vs-sklearn evaluation in benchmark/test_gen_data.py style).
+# the recall-vs-sklearn evaluation of reference benchmark/test_gen_data.py).
 #
 #   python benchmark/ann_10m.py                      # full 10M x 128
 #   ANN_ROWS=200000 python benchmark/ann_10m.py      # smoke

@@ -2,8 +2,8 @@
 #
 # TPU-pod benchmark launcher — the analog of the reference's cluster
 # benchmark orchestration (python/run_benchmark.sh modes + the
-# Databricks/Dataproc/EMR scripts with cluster specs, e.g.
-# python/benchmark/databricks/run_benchmark.sh + gpu_cluster_spec.sh).
+# Databricks/Dataproc/EMR scripts with cluster specs, e.g. the
+# reference python/benchmark/databricks/run_benchmark.sh + gpu_cluster_spec.sh).
 #
 # Two modes:
 #

@@ -21,7 +21,14 @@ set -- "${ARGS[@]+"${ARGS[@]}"}"
 echo "== lint: byte-compile all sources =="
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
 
-echo "== lint: static checks =="
+echo "== lint: graft-lint static checks (full rule set) =="
+# the project-specific analyzer (spark_rapids_ml_tpu/analysis/): builtin
+# AST lint + the registry cross-check rules (conf-key / fault-site /
+# metric-name / thread-lock / span-pairing / module-ref).  ci/lint.py is
+# a thin shim over `python -m spark_rapids_ml_tpu.analysis`; per-rule
+# `--disable r1,r2` and `--baseline known.json` pass straight through
+# (see docs/analysis.md).  The merge gate runs with NO disables and NO
+# baseline: HEAD stays at zero findings.
 python ci/lint.py
 
 echo "== pyspark (optional): install if the environment has a network =="
@@ -57,6 +64,14 @@ EOF
 
 echo "== jvm plugin gate =="
 ./ci/compile_jvm.sh
+
+echo "== docs: conf-table drift gate =="
+# generate-or-verify docs/configuration.md from config._DEFAULTS (the
+# conf-key rule runs the same verification; this step keeps the gate
+# runnable alone and prints the repair command on failure)
+python docs/gen_conf_docs.py || {
+    echo "docs/configuration.md drifted from config._DEFAULTS —"
+    echo "run: python docs/gen_conf_docs.py --write"; exit 1; }
 
 echo "== docs: generate API reference =="
 JAX_PLATFORMS=cpu python docs/gen_api_docs.py
@@ -100,7 +115,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
-    tests/test_bench_history.py \
+    tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py "$@"
@@ -120,6 +135,25 @@ for root, _dirs, files in os.walk("tests"):
 missing = actual - listed
 assert not missing, f"test files not in any ci batch: {sorted(missing)}"
 PYEOF
+
+echo "== graft-lint self-test: seeded violations fire, clean tree passes =="
+# tier-1 marker-safe: every shipped rule has a seeded-violation fixture
+# that must make the analyzer exit nonzero, and the real tree must stay
+# at ZERO findings (test_repo_tree_is_clean — the merge-gate acceptance).
+# Intentionally ALSO in a tier-1 batch above (the batch-completeness
+# guard requires it there); this dedicated step keeps the analyzer gate
+# visible and runnable in isolation.
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q
+
+echo "== jit-audit sanitizer: solver jit hygiene on the CPU mesh =="
+# re-traces every call-time jit the audited solvers create (L-BFGS,
+# stepwise KMeans Lloyd, fused PCA full+randomized, FISTA elastic-net):
+# captured constants bounded at 16 KB, declared donations actually
+# consumed, zero ITERATION-driven compiles (a 12-iteration fit must
+# compile exactly what a 4-iteration fit does), and metric label
+# cardinality within the METRIC_CATALOG bounds.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m spark_rapids_ml_tpu.analysis --jit-audit
 
 echo "== fault-injection smoke: every recovery path on the CPU mesh =="
 # tier-1 marker-safe: exercises guarded dispatch, the retry policy's

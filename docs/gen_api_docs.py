@@ -45,6 +45,7 @@ MODULES = [
     "spark_rapids_ml_tpu.streaming",
     "spark_rapids_ml_tpu.fused",
     "spark_rapids_ml_tpu.telemetry",
+    "spark_rapids_ml_tpu.analysis",
     "spark_rapids_ml_tpu.tracing",
     "spark_rapids_ml_tpu.sklearn_api",
     "spark_rapids_ml_tpu.spark_interop",

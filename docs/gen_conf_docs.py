@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+#
+# Conf-table drift gate CLI — generate-or-verify the docs/configuration.md
+# key table from `config._DEFAULTS`, the same way gen_api_docs.py gates
+# the API pages.  Thin shim: the logic lives in
+# spark_rapids_ml_tpu/analysis/confdocs.py (the graft-lint conf-key rule
+# runs the same verification on every analysis pass).
+#
+#   python docs/gen_conf_docs.py           # verify; exit 1 on drift
+#   python docs/gen_conf_docs.py --write   # repair the table in place
+#
+# Like ci/lint.py, the analysis subpackage loads under a stub parent so
+# the package-root __init__ (and its jax import) never runs.
+#
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+if "spark_rapids_ml_tpu" not in sys.modules:
+    _pkg = types.ModuleType("spark_rapids_ml_tpu")
+    _pkg.__path__ = [os.path.join(REPO, "spark_rapids_ml_tpu")]
+    sys.modules["spark_rapids_ml_tpu"] = _pkg
+
+from spark_rapids_ml_tpu.analysis.confdocs import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,34 @@
+#
+# graft-lint — project-specific static analysis + jit-audit sanitizer.
+# Turns the drift classes eight PRs of review kept re-fixing by hand
+# (CHANGES.md) into CI-enforced invariants: the codebase is
+# cross-checked against its OWN registries (`config._DEFAULTS`,
+# `resilience.faults.KNOWN_SITES`, `telemetry.registry.METRIC_CATALOG`,
+# the docs tables), and a runtime sanitizer re-traces the solvers' jits
+# to bound captured constants, verify donations and forbid steady-state
+# recompiles (jit_audit.py).
+#
+#   python -m spark_rapids_ml_tpu.analysis            # full static pass
+#   python -m spark_rapids_ml_tpu.analysis --jit-audit  # runtime sanitizer
+#
+# Rule catalog, suppression syntax and how to add a rule:
+# docs/analysis.md.  The static pass is stdlib-only (AST + tokenize);
+# only the sanitizer imports jax.
+#
+from .framework import (
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = [
+    "Finding",
+    "Project",
+    "Rule",
+    "all_rules",
+    "load_baseline",
+    "run_analysis",
+]

@@ -1,0 +1,71 @@
+#
+# CLI for the graft-lint analyzer:
+#
+#   python -m spark_rapids_ml_tpu.analysis [--disable r1,r2]
+#       [--baseline findings.json] [--root DIR] [--list-rules]
+#   python -m spark_rapids_ml_tpu.analysis --jit-audit
+#
+# Exit 0 = clean, 1 = findings (or sanitizer violations), 2 = usage.
+# ci/lint.py is a thin shim over the static mode; ci/test.sh runs the
+# sanitizer as its own job on the CPU mesh.
+#
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .framework import Project, all_rules, load_baseline, run_analysis
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_ml_tpu.analysis",
+        description="graft-lint: project-specific static analysis",
+    )
+    ap.add_argument(
+        "--disable", default="",
+        help="comma list of rule names to skip (see --list-rules)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="JSON baseline of tolerated findings "
+        '([{"file","rule","message"}, ...])',
+    )
+    ap.add_argument(
+        "--root", default=None,
+        help="analyze this tree instead of the repo (tests/fixtures)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "--jit-audit", action="store_true",
+        help="run the runtime jit sanitizer instead of the static rules "
+        "(imports jax; run on the CPU mesh in CI)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:22s} {rule.description}")
+        return 0
+    if args.jit_audit:
+        from .jit_audit import run_sanitizer
+
+        return run_sanitizer()
+
+    project = Project(root=args.root)
+    findings = run_analysis(
+        project=project,
+        disable=[d.strip() for d in args.disable.split(",") if d.strip()],
+        baseline=load_baseline(args.baseline) if args.baseline else None,
+    )
+    for f in findings:
+        print(f.render())
+    print(f"graft-lint: {len(findings)} problem(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,238 @@
+#
+# Conf-table drift gate — generate-or-verify the docs/configuration.md
+# key table from `config._DEFAULTS`, the same way docs/gen_api_docs.py
+# gates the API pages.  Three invariants:
+#
+#   1. every `_DEFAULTS` key has exactly one table row
+#   2. no row names a key that no longer exists
+#   3. each row's Default cell equals the actual default (human byte
+#      forms like `512 MiB` compare by value, so readable cells stay)
+#
+# Hand-written Meaning prose is PRESERVED: verify never judges it, and
+# generate only appends template rows for missing keys (meaning seeded
+# from the comment block above the key in config.py) or rewrites a
+# Default cell that drifted.  `docs/gen_conf_docs.py` is the CLI shim
+# (`--write` regenerates in place; default verifies and exits nonzero
+# on drift); the graft-lint conf-key rule runs `verify` on every
+# analysis pass.
+#
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from .framework import Project
+
+DOC_REL = "docs/configuration.md"
+CONF_REL = "spark_rapids_ml_tpu/config.py"
+
+_HEADER = "| Key | Default | Meaning |"
+_ROW_RE = re.compile(r"^\|\s*`(?P<key>[^`]+)`\s*\|\s*(?P<default>[^|]*?)\s*\|")
+_BYTES_RE = re.compile(r"^(\d+(?:\.\d+)?)\s*([KMGT])iB$")
+_MULT = {"K": 1024, "M": 1024 ** 2, "G": 1024 ** 3, "T": 1024 ** 4}
+
+
+def parse_default_cell(cell: str) -> Tuple[bool, Any]:
+    """(parsed?, value) for a Default table cell.  Accepts the canonical
+    reprs plus human byte sizes (`512 MiB`)."""
+    s = cell.strip().strip("`").strip()
+    if s in ("True", "False"):
+        return True, s == "True"
+    if s == "None":
+        return True, None
+    if (len(s) >= 2 and s[0] == s[-1] and s[0] in "\"'"):
+        return True, s[1:-1]
+    m = _BYTES_RE.match(s)
+    if m:
+        return True, int(float(m.group(1)) * _MULT[m.group(2)])
+    try:
+        return True, int(s)
+    except ValueError:
+        pass
+    try:
+        return True, float(s)
+    except ValueError:
+        return False, None
+
+
+def defaults_match(doc_value: Any, actual: Any) -> bool:
+    if isinstance(actual, bool) or isinstance(doc_value, bool):
+        return doc_value is actual
+    if isinstance(actual, (int, float)) and isinstance(doc_value, (int, float)):
+        return float(doc_value) == float(actual)
+    return doc_value == actual
+
+
+def render_default(value: Any) -> str:
+    """Canonical Default cell for a generated/repaired row."""
+    if isinstance(value, bool) or value is None:
+        return f"`{value}`"
+    if isinstance(value, int) and value >= 1024 ** 2:
+        for unit, mult in (("GiB", 1024 ** 3), ("MiB", 1024 ** 2)):
+            if value % mult == 0:
+                return f"`{value // mult} {unit}`"
+    if isinstance(value, str):
+        return f'`"{value}"`'
+    if isinstance(value, float):
+        return f"`{value:g}`"
+    return f"`{value!r}`"
+
+
+def _table_rows(
+    lines: List[str],
+) -> Tuple[Optional[int], List[Tuple[int, str, str]]]:
+    """(header line number, [(line number, key, default cell), ...])."""
+    header = None
+    rows: List[Tuple[int, str, str]] = []
+    for i, line in enumerate(lines, 1):
+        if header is None:
+            if line.strip() == _HEADER:
+                header = i
+            continue
+        if not line.startswith("|"):
+            break
+        m = _ROW_RE.match(line)
+        if m and set(m.group("key")) != {"-"}:
+            rows.append((i, m.group("key"), m.group("default")))
+    return header, rows
+
+
+def _comment_meanings(conf_text: str) -> Dict[str, str]:
+    """{key: meaning} scraped from the comment block above each key in
+    config.py's `_DEFAULTS` literal — the seed text for generated rows."""
+    out: Dict[str, str] = {}
+    pending: List[str] = []
+    in_defaults = False
+    for line in conf_text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("_DEFAULTS"):
+            in_defaults = True
+            continue
+        if not in_defaults:
+            continue
+        if stripped == "}":
+            break
+        if stripped.startswith("#"):
+            pending.append(stripped.lstrip("#").strip())
+            continue
+        m = re.match(r"[\"']([\w]+)[\"']\s*:", stripped)
+        if m:
+            out[m.group(1)] = " ".join(pending).replace("|", "\\|")
+        if not stripped.startswith("#"):
+            pending = []
+    return out
+
+
+def verify(project: Optional[Project] = None) -> List[Tuple[int, str]]:
+    """Drift problems as (docs/configuration.md line, message)."""
+    project = project or Project()
+    defaults = project.conf_defaults()
+    doc = project.file(DOC_REL)
+    problems: List[Tuple[int, str]] = []
+    if doc is None:
+        return [(1, f"{DOC_REL} is missing")]
+    header, rows = _table_rows(doc.lines)
+    if header is None:
+        return [(1, f"no `{_HEADER}` table found in {DOC_REL}")]
+    seen: Dict[str, int] = {}
+    for line, key, cell in rows:
+        if key in seen:
+            problems.append((line, f"duplicate row for conf key `{key}`"))
+            continue
+        seen[key] = line
+        if key not in defaults:
+            problems.append(
+                (line, f"row for `{key}`, which is not in config._DEFAULTS")
+            )
+            continue
+        ok, value = parse_default_cell(cell)
+        if not ok:
+            problems.append(
+                (line, f"unparseable Default cell {cell!r} for `{key}`")
+            )
+        elif not defaults_match(value, defaults[key]):
+            problems.append(
+                (line,
+                 f"Default cell {cell!r} for `{key}` != actual default "
+                 f"{defaults[key]!r}")
+            )
+    for key in defaults:
+        if key not in seen:
+            problems.append(
+                (header, f"conf key `{key}` has no docs/configuration.md row")
+            )
+    return problems
+
+
+def generate(project: Optional[Project] = None) -> str:
+    """The repaired configuration.md text: existing rows kept verbatim
+    (meaning prose untouched) unless their Default cell drifted, rows
+    for deleted keys dropped, template rows appended for new keys."""
+    project = project or Project()
+    defaults = project.conf_defaults()
+    doc = project.file(DOC_REL)
+    conf = project.file(CONF_REL)
+    assert doc is not None and conf is not None
+    meanings = _comment_meanings(conf.text)
+    header, rows = _table_rows(doc.lines)
+    assert header is not None
+    by_line = {line: (key, cell) for line, key, cell in rows}
+    last_row_line = max(by_line) if by_line else header + 1
+    out: List[str] = []
+    seen: set = set()
+    for i, line in enumerate(doc.lines, 1):
+        emit = True
+        if i in by_line:
+            key, cell = by_line[i]
+            if key not in defaults or key in seen:
+                emit = False  # stale/duplicate row: drop it
+            else:
+                seen.add(key)
+                ok, value = parse_default_cell(cell)
+                if not ok or not defaults_match(value, defaults[key]):
+                    line = re.sub(
+                        r"^(\|\s*`[^`]+`\s*\|)[^|]*(\|)",
+                        lambda m: f"{m.group(1)} "
+                                  f"{render_default(defaults[key])} "
+                                  f"{m.group(2)}",
+                        line,
+                        count=1,
+                    )
+        if emit:
+            out.append(line)
+        # append template rows for new keys at the table's end even
+        # when the last existing row was itself stale and dropped
+        if i == last_row_line:
+            for key in defaults:
+                if key not in {k for _, k, _ in rows}:
+                    meaning = meanings.get(key, "*Undocumented.*")
+                    out.append(
+                        f"| `{key}` | {render_default(defaults[key])} "
+                        f"| {meaning} |"
+                    )
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="generate-or-verify docs/configuration.md from "
+        "config._DEFAULTS"
+    )
+    ap.add_argument(
+        "--write", action="store_true",
+        help="repair the table in place instead of verifying",
+    )
+    args = ap.parse_args(argv)
+    project = Project()
+    if args.write:
+        text = generate(project)
+        (project.root / DOC_REL).write_text(text)
+        print(f"wrote {DOC_REL}")
+        return 0
+    problems = verify(project)
+    for line, msg in problems:
+        print(f"{DOC_REL}:{line}: {msg}")
+    print(f"conf-docs: {len(problems)} problem(s)")
+    return 1 if problems else 0

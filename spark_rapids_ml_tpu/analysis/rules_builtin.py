@@ -1,0 +1,137 @@
+#
+# Builtin lint rules — the four AST checks ci/lint.py carried since PR 0
+# (unused imports, bare `except:`, mutable default arguments,
+# placeholder-less f-strings), folded into the framework so they share
+# the suppression/baseline/--disable machinery with the project rules.
+# ci/lint.py is now a thin shim over `python -m spark_rapids_ml_tpu.analysis`.
+#
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from .framework import Finding, Project, Rule, SourceFile
+
+
+class _BuiltinVisitor(ast.NodeVisitor):
+    """One shared walk per file; each rule filters its own problems."""
+
+    def __init__(self) -> None:
+        self.imported: Dict[str, ast.AST] = {}
+        self.used: set = set()
+        self.problems: List[Tuple[int, str, str]] = []  # (line, rule, msg)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imported.setdefault(name, node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imported.setdefault(a.asname or a.name, node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.problems.append((node.lineno, "bare-except", "bare `except:`"))
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        for d in list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.problems.append(
+                    (d.lineno, "mutable-default", "mutable default argument")
+                )
+
+    def visit_FunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_FormattedValue(self, node: ast.FormattedValue) -> None:
+        # do NOT recurse into format_spec: a literal spec like `.4f`
+        # parses as a nested placeholder-less JoinedStr
+        self.visit(node.value)
+
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        if not any(isinstance(v, ast.FormattedValue) for v in node.values):
+            self.problems.append(
+                (node.lineno, "fstring-placeholder",
+                 "f-string without placeholders")
+            )
+        self.generic_visit(node)
+
+
+def _visit(sf: SourceFile) -> _BuiltinVisitor:
+    v = sf.cache.get("builtin_visitor")
+    if v is None:
+        v = _BuiltinVisitor()
+        if sf.tree is not None:
+            v.visit(sf.tree)
+        sf.cache["builtin_visitor"] = v
+    return v
+
+
+class _ProblemRule(Rule):
+    """A rule whose findings come straight off the shared visitor."""
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            for line, rule, msg in _visit(sf).problems:
+                if rule == self.name:
+                    yield Finding(sf.rel, line, rule, msg)
+
+
+class BareExceptRule(_ProblemRule):
+    name = "bare-except"
+    description = "`except:` with no exception type swallows KeyboardInterrupt"
+
+
+class MutableDefaultRule(_ProblemRule):
+    name = "mutable-default"
+    description = "mutable default argument shared across calls"
+
+
+class FStringPlaceholderRule(_ProblemRule):
+    name = "fstring-placeholder"
+    description = "f-string without placeholders (stray `f` prefix)"
+
+
+class UnusedImportRule(Rule):
+    name = "unused-import"
+    description = "imported name never referenced in the module"
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if sf.path.name == "__init__.py":
+                continue  # re-export modules import for the package surface
+            v = _visit(sf)
+            for name, node in v.imported.items():
+                if name in v.used or name == "annotations":
+                    continue
+                # doctest/docstring references keep names "used" in
+                # spirit; only flag imports whose name appears nowhere in
+                # the source text beyond the import line itself
+                if sf.text.count(name) <= 1:
+                    yield Finding(
+                        sf.rel, node.lineno, self.name,
+                        f"unused import `{name}`",
+                    )
+
+
+RULES = [
+    UnusedImportRule(),
+    BareExceptRule(),
+    MutableDefaultRule(),
+    FStringPlaceholderRule(),
+]

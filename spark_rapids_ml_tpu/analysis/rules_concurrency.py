@@ -1,0 +1,399 @@
+#
+# Concurrency rules — the threading conventions the resilience and
+# telemetry layers live by, machine-checked:
+#
+#   thread-lock    (a) in a module that declares a module-level lock,
+#                  module-level mutable containers may only be mutated
+#                  under a `with <lock>:` block or inside a `*_locked`
+#                  helper (the "caller must hold _lock" convention) —
+#                  an unguarded `.append`/`[k] = v` is exactly the race
+#                  the PR-1/PR-5 reviews kept catching by hand;
+#                  (b) a `threading.Thread(target=...)` whose target
+#                  touches the thread-local trace buffers (trace()/
+#                  event()) must adopt the caller's context via
+#                  `adopt_trace_context` — otherwise every span the
+#                  worker records is swallowed by its own thread-local
+#                  storage (the PR-1 watchdog bug)
+#   span-pairing   span/scope context managers (trace, run_context,
+#                  compile_span, compile_label, device_profile,
+#                  fault_inject) must actually be ENTERED: a bare
+#                  `trace("x")` call discards the context manager and
+#                  silently records nothing, and a manual `__enter__()`
+#                  without a `finally`-guarded `__exit__` leaks the
+#                  span on the exception path
+#
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, Project, Rule, SourceFile, resolve_import
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "discard", "remove", "pop",
+    "popitem", "clear", "update", "setdefault",
+}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_MUTABLE_CTORS = {
+    "list", "dict", "set", "defaultdict", "OrderedDict", "deque", "Counter",
+}
+# tracing entry points whose records land in thread-local buffers
+_TRACE_TOUCHERS = {"trace", "event", "note_recompile"}
+
+
+def _is_lockish(expr: ast.expr, locks: Set[str]) -> bool:
+    """Whether a `with` context expression looks like a lock acquire:
+    a module-level lock name, or any name/attribute mentioning `lock`
+    (`self._lock`, `mesh._buffers_lock`, `cond`...)."""
+    if isinstance(expr, ast.Name):
+        return expr.id in locks or "lock" in expr.id.lower()
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in locks or "lock" in expr.attr.lower()
+    return False
+
+
+class _LockScan(ast.NodeVisitor):
+    """Find mutations of module-level mutable containers outside lock
+    guards, in one pass carrying (in_function, lock_held, fn_locked)."""
+
+    def __init__(self, mutables: Set[str], locks: Set[str]) -> None:
+        self.mutables = mutables
+        self.locks = locks
+        self.problems: List[Tuple[int, str]] = []
+        self._depth = 0       # function nesting depth
+        self._lock_held = False
+        self._fn_locked = False
+
+    def _walk_body(self, body, lock_held: bool) -> None:
+        prev = self._lock_held
+        self._lock_held = lock_held
+        for stmt in body:
+            self.visit(stmt)
+        self._lock_held = prev
+
+    def visit_FunctionDef(self, node) -> None:
+        prev = (self._depth, self._lock_held, self._fn_locked)
+        self._depth += 1
+        # a nested def runs later: the lexical lock is NOT held then
+        self._lock_held = False
+        self._fn_locked = self._fn_locked or node.name.endswith("_locked")
+        for stmt in node.body:
+            self.visit(stmt)
+        self._depth, self._lock_held, self._fn_locked = prev
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_With(self, node: ast.With) -> None:
+        held = self._lock_held or any(
+            _is_lockish(item.context_expr, self.locks)
+            for item in node.items
+        )
+        for item in node.items:
+            self.visit(item.context_expr)
+        self._walk_body(node.body, held)
+
+    def _flag(self, lineno: int, name: str, how: str) -> None:
+        if self._depth and not self._lock_held and not self._fn_locked:
+            self.problems.append((lineno, f"{how} of module-level `{name}`"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _MUTATORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in self.mutables
+        ):
+            self._flag(node.lineno, f.value.id, f"unguarded `.{f.attr}()`")
+        self.generic_visit(node)
+
+    def _check_target(self, target: ast.expr, lineno: int) -> None:
+        if isinstance(target, ast.Subscript) and isinstance(
+            target.value, ast.Name
+        ) and target.value.id in self.mutables:
+            self._flag(lineno, target.value.id, "unguarded item assignment")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check_target(t, node.lineno)
+        self.generic_visit(node)
+
+
+def _module_level_names(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(mutable container names, lock names) assigned at module scope."""
+    mutables: Set[str] = set()
+    locks: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        is_mut = isinstance(value, (ast.List, ast.Dict, ast.Set))
+        is_lock = False
+        if isinstance(value, ast.Call):
+            fn = value.func
+            ctor = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            is_mut = is_mut or ctor in _MUTABLE_CTORS
+            is_lock = ctor in _LOCK_CTORS
+        for n in names:
+            if is_mut:
+                mutables.add(n)
+            if is_lock:
+                locks.add(n)
+    return mutables, locks
+
+
+class ThreadLockRule(Rule):
+    name = "thread-lock"
+    description = (
+        "module-level mutable state mutated under its lock; thread "
+        "targets touching trace buffers adopt the caller's context"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.package_files():
+            if sf.tree is None:
+                continue
+            mutables, locks = _module_level_names(sf.tree)
+            if locks and mutables:
+                scan = _LockScan(mutables, locks)
+                scan.visit(sf.tree)
+                for line, msg in scan.problems:
+                    yield Finding(
+                        sf.rel, line, self.name,
+                        f"{msg} outside a lock guard (module declares "
+                        f"lock(s) {sorted(locks)}); wrap in `with "
+                        "<lock>:` or move into a `*_locked` helper",
+                    )
+            yield from self._check_threads(sf)
+
+    # -- half B: Thread targets vs adopt_trace_context --------------------
+
+    def _check_threads(self, sf: SourceFile) -> Iterable[Finding]:
+        touchers = self._trace_names(sf)
+        funcs: Dict[str, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, node)
+        enclosing: Dict[int, ast.AST] = {}
+        self._map_enclosing(sf.tree, None, enclosing)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and self._is_thread_ctor(node)):
+                continue
+            target = next(
+                (kw.value for kw in node.keywords if kw.arg == "target"), None
+            )
+            if not isinstance(target, ast.Name):
+                continue
+            tfn = funcs.get(target.id)
+            if tfn is None or not self._touches_tracing(
+                tfn, funcs, touchers, depth=2
+            ):
+                continue
+            creator = enclosing.get(id(node))
+            scope_ok = any(
+                self._references(scope, "adopt_trace_context")
+                for scope in (tfn, creator)
+                if scope is not None
+            )
+            if not scope_ok:
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    f"Thread target `{target.id}` records trace events "
+                    "but neither it nor its creator calls "
+                    "`adopt_trace_context` — its spans land in the "
+                    "worker's own thread-local buffer and vanish",
+                )
+
+    def _map_enclosing(self, node, fn, out: Dict[int, ast.AST]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = fn
+            self._map_enclosing(child, fn, out)
+
+    def _is_thread_ctor(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread":
+            return True
+        return isinstance(f, ast.Name) and f.id == "Thread"
+
+    def _trace_names(self, sf: SourceFile) -> Set[str]:
+        """Local names bound to tracing's buffer-touching entry points."""
+        names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            mod = resolve_import(sf, node) or ""
+            if not (mod.endswith("/tracing.py")
+                    or mod.endswith("telemetry/compile.py")):
+                continue
+            for a in node.names:
+                if a.name in _TRACE_TOUCHERS:
+                    names.add(a.asname or a.name)
+        return names
+
+    def _touches_tracing(
+        self, fn, funcs: Dict[str, ast.AST], touchers: Set[str], depth: int
+    ) -> bool:
+        if not touchers:
+            return False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else ""
+            )
+            if callee in touchers:
+                return True
+            if depth > 0 and callee in funcs and funcs[callee] is not fn:
+                if self._touches_tracing(
+                    funcs[callee], funcs, touchers, depth - 1
+                ):
+                    return True
+        return False
+
+    def _references(self, scope, name: str) -> bool:
+        return any(
+            isinstance(n, ast.Name) and n.id == name
+            or isinstance(n, ast.Attribute) and n.attr == name
+            for n in ast.walk(scope)
+        )
+
+
+# span/scope context-manager factories, by defining module suffix
+_SPAN_FACTORIES = {
+    "/tracing.py": {"trace", "run_context", "device_profile"},
+    "telemetry/compile.py": {"compile_span", "compile_label"},
+    "resilience/faults.py": {"fault_inject"},
+}
+
+
+class SpanPairingRule(Rule):
+    name = "span-pairing"
+    description = (
+        "span context managers entered via `with` (a discarded factory "
+        "call records nothing); manual __enter__ paired on all paths"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            factories = self._span_names(sf)
+            ok_calls = self._sanctioned_calls(sf.tree)
+            for node in ast.walk(sf.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in factories
+                    and id(node) not in ok_calls
+                ):
+                    yield Finding(
+                        sf.rel, node.lineno, self.name,
+                        f"`{node.func.id}(...)` creates a span context "
+                        "manager that is never entered — use `with "
+                        f"{node.func.id}(...):` (or enter_context)",
+                    )
+            yield from self._check_manual_enter(sf)
+
+    def _span_names(self, sf: SourceFile) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            mod = resolve_import(sf, node) or ""
+            for suffix, fns in _SPAN_FACTORIES.items():
+                if mod.endswith(suffix):
+                    for a in node.names:
+                        if a.name in fns:
+                            names.add(a.asname or a.name)
+        return names
+
+    def _sanctioned_calls(self, tree) -> Set[int]:
+        """ids of factory-call nodes in a sanctioned position: a `with`
+        item, an `enter_context(...)` argument, a return value (factory
+        wrappers), a decorator, or an assignment whose target name is
+        itself later entered (`cm = trace(..)` ... `with cm:`)."""
+        ok: Set[int] = set()
+        entered_names: Set[str] = set()
+        assigns: List[Tuple[str, int]] = []  # (target name, call node id)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ok.add(id(item.context_expr))
+                    if isinstance(item.context_expr, ast.Name):
+                        entered_names.add(item.context_expr.id)
+            elif isinstance(node, ast.Return) and node.value is not None:
+                ok.add(id(node.value))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "enter_context":
+                    for a in node.args:
+                        ok.add(id(a))
+                        if isinstance(a, ast.Name):
+                            entered_names.add(a.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.decorator_list:
+                    ok.add(id(d))
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name) and isinstance(
+                    node.value, ast.Call
+                ):
+                    assigns.append((t.id, id(node.value)))
+        for name, call_id in assigns:
+            if name in entered_names:
+                ok.add(call_id)
+        return ok
+
+    def _check_manual_enter(self, sf: SourceFile) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            enters = [
+                n for n in ast.walk(node)
+                if isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "__enter__"
+            ]
+            if not enters:
+                continue
+            exits_in_finally = any(
+                isinstance(n, ast.Try) and any(
+                    isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Attribute)
+                    and c.func.attr == "__exit__"
+                    for stmt in n.finalbody
+                    for c in ast.walk(stmt)
+                )
+                for n in ast.walk(node)
+            )
+            if not exits_in_finally:
+                yield Finding(
+                    sf.rel, enters[0].lineno, self.name,
+                    "manual `__enter__()` without a `finally`-guarded "
+                    "`__exit__` — the span leaks on the exception path",
+                )
+
+
+RULES = [ThreadLockRule(), SpanPairingRule()]

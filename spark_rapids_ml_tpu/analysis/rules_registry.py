@@ -1,0 +1,427 @@
+#
+# Registry cross-check rules — the codebase judged against its own
+# declarations:
+#
+#   conf-key      every `get_config("k")` / `set_config(k=...)` literal
+#                 and every `SPARK_RAPIDS_ML_TPU_<KEY>` env reference
+#                 resolves to `config._DEFAULTS`, and the
+#                 docs/configuration.md table stays in sync with the
+#                 defaults (key set AND default values — confdocs.py)
+#   fault-site    every `maybe_inject(...)` site literal is registered
+#                 in `faults.KNOWN_SITES`, every registered site is
+#                 instrumented and listed in docs/resilience.md, and
+#                 `fault_inject(...)` arms only sites that exist (a
+#                 typo'd site never fires — the fault "passes" silently)
+#   metric-name   every counter/gauge/histogram/dict_view registration
+#                 and every labeled sample call matches the one
+#                 canonical declaration in
+#                 `telemetry.registry.METRIC_CATALOG` (name, kind, and
+#                 exact label set — Prometheus label-set drift within a
+#                 family breaks every aggregation over it)
+#
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .framework import Finding, Project, Rule, SourceFile, resolve_import
+
+_ENV_RE = re.compile(r"SPARK_RAPIDS_ML_TPU_([A-Z][A-Z0-9_]*)")
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing identifier of the call target (`get_config` for both
+    `get_config(...)` and `config.get_config(...)`)."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _str_arg(node: ast.Call, idx: int = 0) -> Optional[str]:
+    if len(node.args) > idx and isinstance(node.args[idx], ast.Constant):
+        v = node.args[idx].value
+        if isinstance(v, str):
+            return v
+    return None
+
+
+def _line_of_offset(text: str, offset: int) -> int:
+    return text.count("\n", 0, offset) + 1
+
+
+class ConfKeyRule(Rule):
+    name = "conf-key"
+    description = (
+        "conf literals resolve to config._DEFAULTS; the "
+        "docs/configuration.md table matches the defaults"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        defaults = project.conf_defaults()
+        if not defaults:
+            yield Finding(
+                "spark_rapids_ml_tpu/config.py", 1, self.name,
+                "could not parse `_DEFAULTS` — the conf registry is the "
+                "anchor every conf check resolves against",
+            )
+            return
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            yield from self._check_calls(sf, defaults)
+        for sf in project.files + project.docs:
+            yield from self._check_env_refs(sf, defaults)
+        # docs half: the configuration.md table is generated-or-verified
+        # from _DEFAULTS (docs/gen_conf_docs.py shares this code)
+        from . import confdocs
+
+        for line, msg in confdocs.verify(project):
+            yield Finding("docs/configuration.md", line, self.name, msg)
+
+    def _check_calls(
+        self, sf: SourceFile, defaults: Dict
+    ) -> Iterable[Finding]:
+        if sf.rel == "spark_rapids_ml_tpu/config.py":
+            return  # the registry itself
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cn = _call_name(node)
+            if cn == "get_config":
+                key = _str_arg(node)
+                has_default = len(node.args) > 1 or any(
+                    kw.arg == "default" for kw in node.keywords
+                )
+                if key is not None and key not in defaults and not has_default:
+                    yield Finding(
+                        sf.rel, node.lineno, self.name,
+                        f"unknown conf key `{key}` (not in config._DEFAULTS)",
+                    )
+            elif cn == "set_config":
+                for kw in node.keywords:
+                    if kw.arg is not None and kw.arg not in defaults:
+                        yield Finding(
+                            sf.rel, node.lineno, self.name,
+                            f"unknown conf key `{kw.arg}` "
+                            "(not in config._DEFAULTS)",
+                        )
+
+    def _check_env_refs(
+        self, sf: SourceFile, defaults: Dict
+    ) -> Iterable[Finding]:
+        if sf.rel == "spark_rapids_ml_tpu/config.py":
+            return
+        for m in _ENV_RE.finditer(sf.text):
+            key = m.group(1).lower()
+            if key not in defaults:
+                yield Finding(
+                    sf.rel, _line_of_offset(sf.text, m.start()), self.name,
+                    f"env var `{m.group(0)}` names no conf key "
+                    f"(`{key}` not in config._DEFAULTS)",
+                )
+
+
+class FaultSiteRule(Rule):
+    name = "fault-site"
+    description = (
+        "fault-injection sites registered in faults.KNOWN_SITES, "
+        "instrumented, and listed in docs/resilience.md"
+    )
+
+    _FAULTS = "spark_rapids_ml_tpu/resilience/faults.py"
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        sites = project.known_fault_sites()
+        kinds = project.fault_kinds()
+        if not sites:
+            yield Finding(
+                self._FAULTS, 1, self.name,
+                "could not parse `KNOWN_SITES` — the fault-site registry "
+                "is the anchor every site check resolves against",
+            )
+            return
+        instrumented: Set[str] = set()
+        deferred: List[Tuple[SourceFile, ast.Call, str, str]] = []
+        for sf in project.files:
+            if sf.tree is None or sf.rel == self._FAULTS:
+                continue
+            # a `fault_inject` inside `with pytest.raises(...)` exists
+            # to BE rejected (tests of the arm validation itself) —
+            # exempt, so the registry rules need no suppressions
+            raises_spans = [
+                (w.lineno, getattr(w, "end_lineno", w.lineno))
+                for w in ast.walk(sf.tree)
+                if isinstance(w, ast.With) and any(
+                    isinstance(i.context_expr, ast.Call)
+                    and isinstance(i.context_expr.func, ast.Attribute)
+                    and i.context_expr.func.attr == "raises"
+                    for i in w.items
+                )
+            ]
+            local_sites: Set[str] = set()
+            calls: List[Tuple[ast.Call, str]] = []
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                cn = _call_name(node)
+                if cn not in ("maybe_inject", "fault_inject"):
+                    continue
+                if cn == "fault_inject" and any(
+                    a <= node.lineno <= b for a, b in raises_spans
+                ):
+                    continue
+                site = _str_arg(node)
+                if site is None:
+                    if sf.in_package:
+                        yield Finding(
+                            sf.rel, node.lineno, self.name,
+                            f"non-literal `{cn}` site defeats the "
+                            "registry cross-check",
+                        )
+                    continue
+                calls.append((node, cn))
+                if cn == "maybe_inject":
+                    local_sites.add(site)
+                    if sf.in_package:
+                        instrumented.add(site)
+            for node, cn in calls:
+                site = _str_arg(node)
+                kind = _str_arg(node, 1) or next(
+                    (kw.value.value for kw in node.keywords
+                     if kw.arg == "kind"
+                     and isinstance(kw.value, ast.Constant)),
+                    None,
+                )
+                if cn == "maybe_inject" and sf.in_package:
+                    if site not in sites:
+                        yield Finding(
+                            sf.rel, node.lineno, self.name,
+                            f"dispatch site `{site}` is not registered in "
+                            "faults.KNOWN_SITES",
+                        )
+                elif cn == "fault_inject":
+                    # arming a site nothing instruments never fires: the
+                    # site must be registered, or instrumented by this
+                    # very file (tests exercising the machinery itself)
+                    deferred.append((sf, node, site, "site"))
+                    if kind is not None and kinds and kind not in kinds:
+                        yield Finding(
+                            sf.rel, node.lineno, self.name,
+                            f"unknown fault kind `{kind}` "
+                            "(not in faults.FAULT_KINDS)",
+                        )
+            sf.cache["fault_local_sites"] = local_sites
+        for sf, node, site, _ in deferred:
+            local = sf.cache.get("fault_local_sites", set())
+            if site not in sites and site not in local:
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    f"`fault_inject({site!r}, ...)` arms a site no "
+                    "dispatch instruments (not in KNOWN_SITES, no "
+                    "maybe_inject in this file) — the fault never fires",
+                )
+        # registry-side checks: every site instrumented + documented
+        faults_sf = project.file(self._FAULTS)
+        anchor = 1
+        if faults_sf is not None:
+            for i, line in enumerate(faults_sf.lines, 1):
+                if "KNOWN_SITES" in line:
+                    anchor = i
+                    break
+        resil = project.file("docs/resilience.md")
+        for site in sorted(sites):
+            if site not in instrumented:
+                yield Finding(
+                    self._FAULTS, anchor, self.name,
+                    f"registered site `{site}` has no `maybe_inject` "
+                    "dispatch site in the package (dead registration)",
+                )
+            if resil is not None and f"`{site}`" not in resil.text:
+                yield Finding(
+                    "docs/resilience.md", 1, self.name,
+                    f"registered fault site `{site}` is not listed in "
+                    "docs/resilience.md",
+                )
+
+
+# registration helpers exported by telemetry/registry.py
+_REG_FUNCS = {"counter", "gauge", "histogram", "dict_view"}
+# Metric/DictView sample methods that take **labels
+_SAMPLE_METHODS = {"inc", "dec", "set", "observe", "value"}
+
+
+class MetricNameRule(Rule):
+    name = "metric-name"
+    description = (
+        "metric registrations and label sets match "
+        "telemetry.registry.METRIC_CATALOG"
+    )
+
+    _REGISTRY = "spark_rapids_ml_tpu/telemetry/registry.py"
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        catalog = project.metric_catalog()
+        if not catalog:
+            yield Finding(
+                self._REGISTRY, 1, self.name,
+                "could not parse `METRIC_CATALOG` — the metric registry "
+                "is the anchor every metric check resolves against",
+            )
+            return
+        # pass 1: per-module registration-alias and metric-variable maps
+        mod_vars: Dict[str, Dict[str, str]] = {}  # rel -> {var: metric name}
+        infos: List[Tuple[SourceFile, Dict[str, str], List[ast.Call]]] = []
+        for sf in project.package_files():
+            if sf.tree is None or sf.rel == self._REGISTRY:
+                continue
+            aliases = self._registration_aliases(sf)
+            reg_calls: List[ast.Call] = []
+            var_map: Dict[str, str] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call) and self._reg_func(
+                    node, aliases
+                ):
+                    reg_calls.append(node)
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                    if isinstance(t, ast.Name) and isinstance(
+                        node.value, ast.Call
+                    ) and self._reg_func(node.value, aliases):
+                        mname = _str_arg(node.value)
+                        if mname:
+                            var_map[t.id] = mname
+            mod_vars[sf.rel] = var_map
+            infos.append((sf, aliases, reg_calls))
+        # pass 2: imported metric variables resolve through mod_vars
+        registered: Set[str] = set()
+        for sf, aliases, reg_calls in infos:
+            var_map = dict(mod_vars.get(sf.rel, {}))
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ImportFrom):
+                    target = resolve_import(sf, node)
+                    if target in mod_vars:
+                        for a in node.names:
+                            src = mod_vars[target].get(a.name)
+                            if src:
+                                var_map[a.asname or a.name] = src
+            # registrations: name/kind vs catalog
+            for call in reg_calls:
+                fn = self._reg_func(call, aliases)
+                mname = _str_arg(call)
+                if mname is None:
+                    yield Finding(
+                        sf.rel, call.lineno, self.name,
+                        f"non-literal metric name in `{fn}(...)` defeats "
+                        "the catalog cross-check",
+                    )
+                    continue
+                registered.add(mname)
+                spec = catalog.get(mname)
+                if spec is None:
+                    yield Finding(
+                        sf.rel, call.lineno, self.name,
+                        f"metric `{mname}` is not declared in "
+                        "telemetry.registry.METRIC_CATALOG",
+                    )
+                    continue
+                want_kind = "view" if fn == "dict_view" else fn
+                if spec.get("kind") != want_kind:
+                    yield Finding(
+                        sf.rel, call.lineno, self.name,
+                        f"metric `{mname}` registered as {want_kind} but "
+                        f"cataloged as {spec.get('kind')}",
+                    )
+            # labeled sample calls vs the declared label set
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SAMPLE_METHODS
+                ):
+                    continue
+                recv = node.func.value
+                mname = None
+                if isinstance(recv, ast.Name):
+                    mname = var_map.get(recv.id)
+                elif isinstance(recv, ast.Call) and self._reg_func(
+                    recv, aliases
+                ):
+                    mname = _str_arg(recv)
+                if mname is None:
+                    continue
+                spec = catalog.get(mname)
+                if spec is None or spec.get("kind") == "view":
+                    continue  # views label only by `key`, internally
+                if any(kw.arg is None for kw in node.keywords):
+                    continue  # **labels expansion: not statically checkable
+                declared = set(spec.get("labels", ()))
+                used = {
+                    kw.arg for kw in node.keywords if kw.arg is not None
+                }
+                if node.func.attr == "value":
+                    used -= {"default"}
+                if used != declared:
+                    yield Finding(
+                        sf.rel, node.lineno, self.name,
+                        f"`{mname}.{node.func.attr}()` labels "
+                        f"{sorted(used)} != cataloged {sorted(declared)}",
+                    )
+        # catalog completeness: a declared family nobody registers is a
+        # stale entry (metric renamed/removed without updating the table)
+        reg_sf = project.file(self._REGISTRY)
+        for mname in sorted(set(catalog) - registered):
+            line = 1
+            if reg_sf is not None:
+                for i, text in enumerate(reg_sf.lines, 1):
+                    if f'"{mname}"' in text:
+                        line = i
+                        break
+            yield Finding(
+                self._REGISTRY, line, self.name,
+                f"cataloged metric `{mname}` is never registered in the "
+                "package (stale catalog entry)",
+            )
+
+    def _registration_aliases(self, sf: SourceFile) -> Dict[str, str]:
+        """{local name: registration func} for names imported from
+        telemetry/registry.py (directly or through the telemetry
+        package facade), plus local names bound to the REGISTRY object
+        (whose .counter/... methods register too)."""
+        aliases: Dict[str, str] = {}
+        sources = (self._REGISTRY, "spark_rapids_ml_tpu/telemetry/__init__.py")
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if (resolve_import(sf, node) or "") not in sources:
+                continue
+            for a in node.names:
+                if a.name in _REG_FUNCS:
+                    aliases[a.asname or a.name] = a.name
+                elif a.name == "REGISTRY":
+                    aliases[a.asname or a.name] = "REGISTRY"
+        return aliases
+
+    def _reg_func(
+        self, node: ast.Call, aliases: Dict[str, str]
+    ) -> Optional[str]:
+        """The canonical registration function a call resolves to, if
+        any (`counter`/`gauge`/`histogram`/`dict_view`)."""
+        f = node.func
+        if isinstance(f, ast.Name):
+            fn = aliases.get(f.id)
+            return fn if fn in _REG_FUNCS else None
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr in _REG_FUNCS
+            and isinstance(f.value, ast.Name)
+            and aliases.get(f.value.id) == "REGISTRY"
+        ):
+            return f.attr
+        return None
+
+
+RULES = [ConfKeyRule(), FaultSiteRule(), MetricNameRule()]

@@ -2,9 +2,9 @@
 # Model-quality metrics — the analog of reference metrics/ (~570 LoC):
 # `EvalMetricInfo` (metrics/__init__.py:20-40), `MulticlassMetrics`
 # (driver-side reconstruction of the Spark multiclass metrics from
-# distributed confusion counts, metrics/MulticlassMetrics.py), and
-# `RegressionMetrics`/`_SummarizerBuffer` (Spark SummarizerBuffer moments,
-# metrics/RegressionMetrics.py).  Workers emit per-shard partials (here:
+# distributed confusion counts, reference metrics/MulticlassMetrics.py),
+# and `RegressionMetrics`/`_SummarizerBuffer` (Spark SummarizerBuffer
+# moments, reference metrics/RegressionMetrics.py).  Workers emit per-shard partials (here:
 # jnp segment sums fetched to host); the driver-side math below matches
 # Spark's MulticlassClassificationEvaluator / RegressionEvaluator exactly.
 #

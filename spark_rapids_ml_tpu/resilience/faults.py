@@ -9,12 +9,13 @@
 # occurrence counts, so each injected failure is reproducible down to the
 # iteration it fires on.
 #
-# Sites instrumented today: fit_kernel, transform_dispatch, stage_parquet,
-# kmeans_lloyd, lbfgs_iteration, linreg_fista, fused_accumulate (the
-# fused stage-and-solve chunk loop, fused.py — fires per accumulated
-# chunk; accumulators are RE-CREATABLE state, so the recovery contract is
-# restart-the-pass, never resume: tests assert a retried pass cannot
-# double-count chunks).
+# The instrumented sites are registered in `KNOWN_SITES` below (the
+# canonical list docs/resilience.md mirrors and the graft-lint
+# fault-site rule enforces).  One contract worth repeating here:
+# `fused_accumulate` (the fused stage-and-solve chunk loop, fused.py)
+# fires per accumulated chunk; accumulators are RE-CREATABLE state, so
+# the recovery contract is restart-the-pass, never resume — tests
+# assert a retried pass cannot double-count chunks.
 #
 from __future__ import annotations
 
@@ -29,6 +30,28 @@ from ..utils import get_logger
 logger = get_logger("spark_rapids_ml_tpu.resilience")
 
 _lock = threading.Lock()
+
+# The canonical fault-site registry.  Every `maybe_inject("<site>")`
+# literal in the package must be registered here, every registered site
+# must be instrumented by at least one dispatch-site call, and
+# docs/resilience.md must list each one — all three cross-checked by the
+# graft-lint `fault-site` rule (spark_rapids_ml_tpu/analysis/), so the
+# site list can no longer silently diverge between code and docs.
+# Tests arm ad-hoc sites freely as long as the same file instruments
+# them with its own `maybe_inject` call.
+KNOWN_SITES = frozenset({
+    "fit_kernel",
+    "transform_dispatch",
+    "stage_parquet",
+    "kmeans_lloyd",
+    "lbfgs_iteration",
+    "linreg_fista",
+    "fused_accumulate",
+})
+
+# Injectable fault kinds (`_Fault` validates against this; the docs and
+# the `fault_inject_spec` conf comment enumerate the same set)
+FAULT_KINDS = ("oom", "timeout", "preemption", "hang", "device_lost")
 
 
 class SimulatedPreemption(RuntimeError):
@@ -46,7 +69,7 @@ class _Fault:
     __slots__ = ("kind", "times", "skip", "seconds")
 
     def __init__(self, kind: str, times: int, skip: int, seconds: float) -> None:
-        if kind not in ("oom", "timeout", "preemption", "hang", "device_lost"):
+        if kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind: {kind!r}")
         self.kind = kind
         self.times = int(times)

@@ -70,10 +70,12 @@ from .memory import (  # noqa: F401
     sample_devices,
 )
 from .registry import (  # noqa: F401
+    METRIC_CATALOG,
     REGISTRY,
     DictView,
     Metric,
     MetricsRegistry,
+    check_cardinality,
     counter,
     delta,
     dict_view,
@@ -89,10 +91,12 @@ __all__ = [
     "FitMemoryWatermark",
     "FitTelemetry",
     "Heartbeat",
+    "METRIC_CATALOG",
     "Metric",
     "MetricsRegistry",
     "REGISTRY",
     "SimulatedMemoryProvider",
+    "check_cardinality",
     "chrome_trace",
     "compile_label",
     "compile_span",

@@ -246,13 +246,19 @@ def sample_devices() -> Dict[int, int]:
         key = str(did)
         out[did] = s["bytes_in_use"]
         _in_use_g.set(s["bytes_in_use"], device=key)
-        peak = max(
-            _process_peak.get(key, 0),
-            s["peak_bytes_in_use"],
-            s["bytes_in_use"],
-        )
-        _process_peak[key] = peak
-        _peak_g.set(peak, device=key)
+        # read-max-write under the lock: the daemon sampler and explicit
+        # sample points race here, and a lost update would let a peak
+        # regress.  The gauge set stays inside too — otherwise a stale
+        # peak computed before losing the race could overwrite a newer
+        # one on the exported family
+        with _lock:
+            peak = max(
+                _process_peak.get(key, 0),
+                s["peak_bytes_in_use"],
+                s["bytes_in_use"],
+            )
+            _process_peak[key] = peak
+            _peak_g.set(peak, device=key)
     _samples_c.inc(provider=prov.name)
     for wm in watermarks:
         wm._observe(stats)

@@ -29,6 +29,93 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
+# ---------------------------------------------------------------------------
+# Canonical metric catalog.  Every metric family the package registers
+# MUST be declared here: name -> {kind, labels, cardinality}.  The
+# graft-lint `metric-name` rule (spark_rapids_ml_tpu/analysis/)
+# cross-checks every registration call and every `.inc/.set/.observe`
+# label set against this table, so a counter minted ad hoc in some
+# module — or a label set that drifts from the registration — fails CI
+# instead of silently forking the Prometheus surface.  `cardinality`
+# bounds the DISTINCT labelsets a family may accumulate at runtime
+# (`check_cardinality()`, asserted by the jit-audit sanitizer job and
+# tests): labels must stay enumerable — site names, estimator names,
+# device ordinals — never run ids or timestamps.
+#
+# Kinds: counter / gauge / histogram, plus "view" — a gauge family
+# fronted by a legacy `dict_view` mapping (labeled only by `key`).
+# ---------------------------------------------------------------------------
+METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
+    # resilience
+    "retries_total": {
+        "kind": "counter", "labels": ("label", "action"), "cardinality": 64,
+    },
+    "dispatch_timeouts_total": {
+        "kind": "counter", "labels": ("label",), "cardinality": 32,
+    },
+    "faults_injected_total": {
+        "kind": "counter", "labels": ("site", "kind"), "cardinality": 64,
+    },
+    "checkpoint_saves_total": {
+        "kind": "counter", "labels": (), "cardinality": 1,
+    },
+    "checkpoint_resumes_total": {
+        "kind": "counter", "labels": (), "cardinality": 1,
+    },
+    "device_health_probes_total": {
+        "kind": "counter", "labels": (), "cardinality": 1,
+    },
+    "device_probe_failures_total": {
+        "kind": "counter", "labels": (), "cardinality": 1,
+    },
+    # telemetry: memory / budget drift
+    "device_bytes_in_use": {
+        "kind": "gauge", "labels": ("device",), "cardinality": 256,
+    },
+    "device_bytes_peak": {
+        "kind": "gauge", "labels": ("device",), "cardinality": 256,
+    },
+    "budget_drift_ratio": {
+        "kind": "gauge", "labels": ("est",), "cardinality": 64,
+    },
+    "budget_predicted_bytes": {
+        "kind": "gauge", "labels": ("est",), "cardinality": 64,
+    },
+    "budget_decisions_total": {
+        "kind": "counter", "labels": ("label", "over"), "cardinality": 64,
+    },
+    "memory_samples_total": {
+        "kind": "counter", "labels": ("provider",), "cardinality": 4,
+    },
+    # telemetry: compile tracking
+    "compile_seconds": {
+        "kind": "histogram", "labels": ("fn", "phase"), "cardinality": 256,
+    },
+    "compiles_total": {
+        "kind": "counter", "labels": ("fn",), "cardinality": 64,
+    },
+    "recompiles_total": {
+        "kind": "counter", "labels": ("fn", "reason"), "cardinality": 64,
+    },
+    # telemetry: solver progress / fit accounting
+    "solver_iteration": {
+        "kind": "gauge", "labels": ("solver",), "cardinality": 16,
+    },
+    "solver_loss": {
+        "kind": "gauge", "labels": ("solver",), "cardinality": 16,
+    },
+    "fit_duration_seconds": {
+        "kind": "histogram", "labels": ("estimator",), "cardinality": 32,
+    },
+    # legacy dict-view families (gauges labeled by `key`)
+    "staging_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
+    "staging_counts": {"kind": "view", "labels": ("key",), "cardinality": 32},
+    "device_cache": {"kind": "view", "labels": ("key",), "cardinality": 32},
+    "recovery": {"kind": "view", "labels": ("key",), "cardinality": 16},
+    "fused_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
+    "pca_solver_last": {"kind": "view", "labels": ("key",), "cardinality": 16},
+}
+
 _DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
     10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
@@ -324,6 +411,30 @@ def delta(
     return out
 
 
+def check_cardinality(
+    registry: Optional["MetricsRegistry"] = None,
+) -> List[str]:
+    """Live label-cardinality audit against METRIC_CATALOG: returns one
+    problem string per family whose DISTINCT labelset count exceeds its
+    declared bound (a label fed from an unbounded value — a run id, a
+    timestamp — blows past it immediately).  Run by the jit-audit
+    sanitizer CI job after exercising the solvers, and by tests."""
+    reg = registry or REGISTRY
+    problems: List[str] = []
+    for m in reg.metrics():
+        spec = METRIC_CATALOG.get(m.name)
+        if spec is None:
+            continue  # private/test registries may carry their own names
+        n = len(m.samples())
+        bound = int(spec.get("cardinality", 0) or 0)
+        if bound and n > bound:
+            problems.append(
+                f"metric {m.name!r}: {n} distinct labelsets exceed the "
+                f"declared cardinality bound {bound}"
+            )
+    return problems
+
+
 # the process-global default registry every module-level view and counter
 # registers with; tests may build private MetricsRegistry instances
 REGISTRY = MetricsRegistry()
@@ -338,9 +449,11 @@ reset_metrics = REGISTRY.reset
 
 __all__ = [
     "DictView",
+    "METRIC_CATALOG",
     "Metric",
     "MetricsRegistry",
     "REGISTRY",
+    "check_cardinality",
     "counter",
     "delta",
     "dict_view",

@@ -363,74 +363,50 @@ def test_host_dispatched_lbfgs_matches_fused(rng):
         ) < 1e-5
 
 
-def test_host_dispatched_lbfgs_no_constant_capture(rng, monkeypatch):
+def test_host_dispatched_lbfgs_no_constant_capture(rng):
     # the host-driven evaluation must take the dataset as a jit ARGUMENT:
     # jitting a closure over the concrete arrays captures them as lowered
     # constants (at the refconfig 1M x 3000 scale that was a 12 GB
     # host-side materialization during lowering — jax's "large amount of
     # constants were captured" warning, observed live on chip).
     #
-    # Measured DIRECTLY: every function the host-dispatch path jits is
-    # re-traced with make_jaxpr and the bytes of its captured consts are
-    # bounded at 16 KB — at test scale the dataset alone is 128 KB, so a
-    # closure-capture regression trips the bound loudly.  (The first
-    # form of this test flipped `jax_captured_constants_warn_bytes` and
-    # promoted jax's warning to an error; that config knob does not
-    # exist on the jax 0.4.x line this container ships, so the test
-    # died in AttributeError before asserting anything.)
-    import jax as real_jax
-
+    # Measured DIRECTLY via the shared jit-audit harness (this test's
+    # original inline proxy grew into analysis/jit_audit.py): every
+    # call-time jit on the host-dispatch path is re-traced with
+    # make_jaxpr and its captured-const bytes bounded at 16 KB — at
+    # test scale the dataset alone is 128 KB, so a closure-capture
+    # regression trips the bound loudly.  (The first form of this test
+    # flipped `jax_captured_constants_warn_bytes` and promoted jax's
+    # warning to an error; that config knob does not exist on the jax
+    # 0.4.x line this container ships, so the test died in
+    # AttributeError before asserting anything.)
+    from spark_rapids_ml_tpu.analysis.jit_audit import (
+        assert_clean,
+        audit_jits,
+    )
     from spark_rapids_ml_tpu.config import reset_config, set_config
-    from spark_rapids_ml_tpu.ops import logistic as logistic_mod
 
     n, d = 2000, 16
     X = rng.normal(size=(n, d)).astype(np.float32)
     y = (X[:, 0] > 0).astype(np.float64)
 
-    captured = []  # (const_bytes, fn_name) per jitted function
-
-    class _JitAudit:
-        """jax proxy whose jit() re-traces each function on first call
-        and records the total bytes of constants its jaxpr captured."""
-
-        def __getattr__(self, name):
-            return getattr(real_jax, name)
-
-        def jit(self, fn=None, **kw):
-            if fn is None:
-                return lambda f: self.jit(f, **kw)
-            jitted = real_jax.jit(fn, **kw)
-            seen = []
-
-            def wrapper(*args, **kwargs):
-                if not seen:
-                    seen.append(True)
-                    closed = real_jax.make_jaxpr(fn)(*args, **kwargs)
-                    nbytes = sum(
-                        np.asarray(c).nbytes for c in closed.consts
-                    )
-                    captured.append((nbytes, getattr(fn, "__name__", "?")))
-                return jitted(*args, **kwargs)
-
-            return wrapper
-
-    # host_lbfgs_fit builds its jitted oracle at CALL time through the
-    # module-global `jax`, so the audit proxy sees exactly the programs
-    # the host-dispatch path creates (module-level @jax.jit functions
-    # were bound at import and are data-as-argument by construction)
-    monkeypatch.setattr(logistic_mod, "jax", _JitAudit())
+    # host_lbfgs_fit builds its jitted oracle at CALL time, so the audit
+    # sees exactly the programs the host-dispatch path creates
+    # (module-level @jax.jit functions were bound at import and are
+    # data-as-argument by construction)
     set_config(dispatch_flops_limit=1e6)
     try:
-        m = LogisticRegression(maxIter=40).fit((X, y))
+        with audit_jits(
+            modules=("spark_rapids_ml_tpu.ops.logistic",)
+        ) as report:
+            m = LogisticRegression(maxIter=40).fit((X, y))
         assert m.summary.totalIterations > 0
     finally:
         reset_config()
-    assert captured, "the audit proxy never saw a jitted evaluation"
-    worst, name = max(captured)
-    assert worst < 16 * 1024, (
-        f"jitted {name!r} captured {worst} bytes of constants — the "
-        "dataset must ride the evaluation as an argument, not a closure"
-    )
+    # expect_records guards against the vacuous pass (the proxy must
+    # have seen the jitted evaluation); assert_clean enforces the
+    # report's 16 KB captured-const bound
+    assert_clean(report, expect_records=True)
 
 
 def test_host_dispatched_lbfgs_elasticnet(rng):

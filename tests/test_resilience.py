@@ -266,6 +266,8 @@ def test_fault_inject_conf_spec():
 
 def test_fault_inject_unknown_kind_rejected():
     with pytest.raises(ValueError, match="unknown fault kind"):
+        # deliberately invalid kind: the arm must be rejected (the
+        # fault-site rule exempts fault_inject under pytest.raises)
         with fault_inject("s", "segfault"):
             pass
 
