@@ -47,7 +47,7 @@ WORKLOADS = [
     for w in os.environ.get(
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
-        "streaming,refconfig,rf",
+        "serving,streaming,refconfig,rf",
     ).split(",")
 ]
 
@@ -59,7 +59,10 @@ WORKLOADS = [
 # other cpu workload would change their numbers.
 if (
     WORKLOADS
-    and all(w in ("staging", "cv_cached", "fused_pca") for w in WORKLOADS)
+    and all(
+        w in ("staging", "cv_cached", "fused_pca", "serving")
+        for w in WORKLOADS
+    )
     and os.environ.get("JAX_PLATFORMS", "") == "cpu"
     and "xla_force_host_platform_device_count"
     not in os.environ.get("XLA_FLAGS", "")
@@ -970,6 +973,96 @@ def bench_fused_pca(extra: dict):
         shutil.rmtree(td, ignore_errors=True)
 
 
+def bench_serving(extra: dict):
+    """Sustained-QPS serving bench (spark_rapids_ml_tpu/serving/):
+    logreg / PCA / kNN transform traffic through the micro-batched,
+    device-resident server vs SEQUENTIAL per-request transforms (each
+    request paying the full chunked transform driver on its own).  The
+    coalescing win is the headline (`*_speedup_x`, acceptance >= 3x at
+    batchable load); per-model p50/p99 come from the server's exact
+    latency samples and land in the history with lower-is-better
+    direction rules (benchmark/compare.py)."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.config import set_config
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.knn import NearestNeighbors
+    from spark_rapids_ml_tpu.serving import ServingServer
+
+    n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", 300))
+    d = int(os.environ.get("BENCH_SERVING_COLS", 64))
+    n_fit = min(N_ROWS, 20_000)
+    rng = _rng(29)
+    X = rng.standard_normal((n_fit, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(
+        np.float32
+    )
+    import pandas as pd
+
+    df = pd.DataFrame({"features": list(X), "label": y})
+    models = {}
+    models["logreg"] = (
+        LogisticRegression(maxIter=20).fit(df),
+        None,
+    )
+    models["pca"] = (
+        PCA(k=16).setInputCol("features").setOutputCol("proj").fit(df),
+        None,
+    )
+    knn = NearestNeighbors(k=8).fit(X[:2000])
+
+    def nn_transform(Q):
+        dist, pos = knn._search(np.asarray(Q, np.float32), 8)
+        return {"distances": dist, "indices": pos}
+
+    models["knn"] = (knn, nn_transform)
+
+    set_config(serving_max_wait_ms=5.0)
+    server = ServingServer()
+    for name, (model, fn) in models.items():
+        server.register(name, model, n_features=d, transform=fn)
+    server.start()
+    try:
+        rows = [rng.standard_normal((1, d)).astype(np.float32)
+                for _ in range(n_req)]
+        for name, (model, fn) in models.items():
+            seq_fn = fn if fn is not None else model._transform_array
+            seq_fn(rows[0])  # warm compiles out of both timings
+            server.transform(name, rows[0], timeout=300)
+            t0 = time.perf_counter()
+            for r in rows:
+                seq_fn(r)
+            seq_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            futs = [server.submit(name, r) for r in rows]
+            for f in futs:
+                f.result(timeout=300)
+            srv_s = time.perf_counter() - t0
+            rep = server.report()[name]
+            extra[f"serving_{name}_qps"] = round(n_req / max(srv_s, 1e-9), 1)
+            extra[f"serving_{name}_seq_qps"] = round(
+                n_req / max(seq_s, 1e-9), 1
+            )
+            extra[f"serving_{name}_speedup_x"] = round(
+                seq_s / max(srv_s, 1e-9), 2
+            )
+            extra[f"serving_{name}_p50_ms"] = rep.get("p50_ms")
+            extra[f"serving_{name}_p99_ms"] = rep.get("p99_ms")
+        totals = server.report()["_totals"]
+        extra["serving_requests_per_model"] = n_req
+        extra["serving_batches"] = totals["batches"]
+        extra["serving_pinned_bytes"] = totals["pinned_bytes"]
+        from spark_rapids_ml_tpu.serving.server import REJECTIONS
+
+        extra["serving_rejections"] = int(
+            sum(REJECTIONS.samples().values())
+        )
+    finally:
+        server.stop()
+        server.registry.clear()
+
+
 def bench_cv_cached(extra: dict):
     """Device-resident dataset cache (parallel/device_cache.py): a
     k-fold CrossValidator run on the stage-once cached driver vs the
@@ -1527,7 +1620,7 @@ def _cpu_shrink() -> None:
     if "BENCH_ROWS" not in os.environ:
         N_ROWS = min(N_ROWS, 200_000)
     if "BENCH_WORKLOADS" not in os.environ:
-        WORKLOADS[:] = ["pca", "fused_pca", "staging", "streaming"]
+        WORKLOADS[:] = ["pca", "fused_pca", "staging", "serving", "streaming"]
 
 
 def _workload_order() -> list:
@@ -1668,6 +1761,7 @@ def main() -> None:
         "umap": bench_umap,
         "staging": bench_staging,
         "cv_cached": bench_cv_cached,
+        "serving": bench_serving,
         "streaming": bench_streaming,
         "refconfig": bench_refconfig,
         "rf": bench_rf,

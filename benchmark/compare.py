@@ -39,6 +39,10 @@ _LOWER_BETTER = (
     "_sec",
     "_seconds",
     "_stagings_per_run",
+    # serving latency percentiles (bench.py `serving` section): a p50/p99
+    # that climbs is an SLO regression even when QPS holds
+    "_p50_ms",
+    "_p99_ms",
 )
 _HIGHER_BETTER = (
     "_per_sec",

@@ -41,6 +41,7 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("pca_", "pca"),
     ("rf_", "rf"),
     ("refconfig_", "refconfig"),
+    ("serving_", "serving"),
     ("staging_", "staging"),
     ("streaming_", "streaming"),
     ("ingest_", "streaming"),

@@ -115,6 +115,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
+    tests/test_serving.py \
     tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
@@ -257,6 +258,77 @@ assert parsed[retry_key] >= 1.0, retry_key
 assert rep["resilience"]["retries"] >= 1
 print(f"telemetry smoke OK: {len(instants)} marker(s), "
       f"{len(parsed)} prometheus samples, report at {rep['run_id']}")
+EOF
+
+echo "== serving smoke: sustained small-QPS through the micro-batch server =="
+# tier-1 marker-safe: logreg + PCA pinned on the 8-dev CPU mesh, 120
+# single-row requests each at batchable load must (a) all complete with
+# ZERO admission rejections, (b) beat sequential per-request transforms
+# >= 3x QPS, (c) report per-model p50/p99 under a (generous, loaded-CI)
+# bound, and (d) leave the serving prometheus families scrapeable.
+# tests/test_serving.py covers coalescing parity, LRU re-pin and the
+# fault-injected degradations; this step keeps the serving gate
+# runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.serving import ServingServer
+from spark_rapids_ml_tpu.telemetry import dump_prometheus, parse_prometheus
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4000, 32)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+df = pd.DataFrame({"features": list(X), "label": y})
+models = {
+    "logreg": LogisticRegression(maxIter=15).fit(df),
+    "pca": PCA(k=8).setInputCol("features").setOutputCol("proj").fit(df),
+}
+set_config(serving_max_wait_ms=5.0)
+server = ServingServer()
+for name, m in models.items():
+    server.register(name, m)
+server.start()
+n = 120
+rows = [rng.normal(size=(1, 32)).astype(np.float32) for _ in range(n)]
+for name, m in models.items():
+    m._transform_array(rows[0])
+    server.transform(name, rows[0], timeout=300)  # warm both paths
+    t0 = time.perf_counter()
+    for r in rows:
+        m._transform_array(r)
+    seq_qps = n / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    futs = [server.submit(name, r) for r in rows]
+    for f in futs:
+        f.result(timeout=300)
+    srv_qps = n / (time.perf_counter() - t0)
+    rep = server.report()[name]
+    assert srv_qps >= 3.0 * seq_qps, (name, srv_qps, seq_qps)
+    assert rep["rejections_queue_full"] == 0, rep
+    assert 0 < rep["p50_ms"] <= rep["p99_ms"] < 5000, rep
+    print(f"serving smoke {name}: {srv_qps:.0f} qps vs {seq_qps:.0f} "
+          f"sequential ({srv_qps/seq_qps:.1f}x), p50 {rep['p50_ms']:.1f}ms "
+          f"p99 {rep['p99_ms']:.1f}ms")
+parsed = parse_prometheus(dump_prometheus())
+pre = "spark_rapids_ml_tpu_"
+for fam, labels in (
+    ("serving_request_latency_seconds_count",
+     (("model", "pca"), ("phase", "total"))),
+    ("serving_batch_rows_count", (("model", "pca"),)),
+    ("serving_requests_total", (("model", "logreg"),)),
+    ("serving_pinned_models", ()),
+):
+    assert (pre + fam, labels) in parsed, fam
+assert not any(k[0] == pre + "serving_rejections_total" for k in parsed)
+server.stop()
+print("serving smoke OK: zero rejections, families scrapeable")
 EOF
 
 echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="

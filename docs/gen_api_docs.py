@@ -51,6 +51,7 @@ MODULES = [
     "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.parallel",
     "spark_rapids_ml_tpu.resilience",
+    "spark_rapids_ml_tpu.serving",
 ]
 
 

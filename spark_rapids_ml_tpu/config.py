@@ -272,6 +272,34 @@ _DEFAULTS: Dict[str, Any] = {
     # against the median of the last k runs.  Overridable per run with
     # the BENCH_HISTORY_PATH env var; empty disables appending.
     "bench_history_path": "",
+    # Small-batch direct staging fast path (parallel/mesh.py): a 2-D
+    # host array below the pipelined-engine threshold stages as plain
+    # per-device slices + one device_put per shard — no full padded host
+    # copy, no interleave-permutation copy, no jitted update programs.
+    # Byte-identical to the serial path; the serving layer's 1-row..
+    # few-row micro-batches live on it.  Off restores the legacy
+    # pad/layout/global-put path everywhere.
+    "staging_small_direct": True,
+    # Serving micro-batch coalescer (serving/): hard cap on the rows one
+    # coalesced dispatch may carry.  The effective cap is
+    # min(serving_max_batch_rows, host_batch_bytes / row_bytes) — the
+    # same byte model every staged transfer is sized by — and an
+    # OOM-degraded server halves it further (floor: one row per device).
+    "serving_max_batch_rows": 4096,
+    # Longest a queued serving request may wait for co-batchable traffic
+    # before its batch dispatches anyway (milliseconds).  Raising it
+    # trades p50 latency for larger coalesced batches (higher QPS).
+    "serving_max_wait_ms": 2.0,
+    # Admission control (serving/): requests beyond this many queued
+    # across all models are rejected with a typed ServingOverload
+    # instead of growing the queue without bound (the caller sheds load
+    # or retries with backoff).
+    "serving_max_queue": 1024,
+    # Opt-in serving HTTP JSON endpoint (serving/http.py): a stdlib
+    # server on this port exposes POST /v1/models/<name>:transform plus
+    # the per-model latency report.  Binds LOOPBACK like the
+    # `telemetry_port` endpoint; 0 = off (in-process ServingClient only).
+    "serving_port": 0,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

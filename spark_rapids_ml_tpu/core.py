@@ -1162,6 +1162,26 @@ class _TpuModel(Model, _TpuCaller):
             )
         return outs
 
+    def _fetch_transform_outputs(self, st, dev) -> Dict[str, np.ndarray]:
+        """Fetch a `_transform_device` output dict back to host: device
+        arrays trim their padding and restore the input row order via
+        the staging layout (`RowStager.fetch`); host-computed outputs
+        (degenerate-model paths) head-trim.  The one fetch contract
+        shared by the chunked `_transform_mesh` driver below and the
+        serving dispatcher (serving/server.py), which stages coalesced
+        micro-batches itself and reuses the model's compiled
+        `_transform_device` program over them."""
+        import jax
+
+        return {
+            col: (
+                st.fetch(v)
+                if isinstance(v, jax.Array)
+                else st.trim_host(np.asarray(v))
+            )
+            for col, v in dev.items()
+        }
+
     def _transform_mesh(self, X: np.ndarray) -> Optional[Dict[str, np.ndarray]]:
         """Distributed, batched inference (reference strategy 6, SURVEY
         §2.12: non-barrier data-parallel transform).  Rows are chunked by
@@ -1248,14 +1268,7 @@ class _TpuModel(Model, _TpuCaller):
             columns appended (the retry would duplicate their rows)."""
             lo_p, hi_p, st, dev = pending
             with trace(f"transform_chunk[{lo_p}:{hi_p}]", self.logger):
-                fetched = {
-                    col: (
-                        st.fetch(v)
-                        if isinstance(v, jax.Array)
-                        else st.trim_host(np.asarray(v))
-                    )
-                    for col, v in dev.items()
-                }
+                fetched = self._fetch_transform_outputs(st, dev)
             for col, v in fetched.items():
                 outs.setdefault(col, []).append(v)
 

@@ -460,6 +460,12 @@ class DeviceDatasetCache:
         # without this ledger two concurrent misses could both pass
         # reserve() against the same headroom and overcommit the budget
         self._pending = 0
+        # long-lived NON-dataset residency booked against the same
+        # budget (the serving model registry's pinned weights,
+        # serving/registry.py): tag -> bytes.  Counted by every budget
+        # comparison but never LRU-evicted from here — the owning layer
+        # decides what to drop and releases the claim itself.
+        self._external: Dict[str, int] = {}
 
     def lookup(self, fingerprint: str) -> Optional[CacheEntry]:
         with self._mu:
@@ -476,10 +482,15 @@ class DeviceDatasetCache:
             return sum(e.nbytes for e in self._entries.values())
 
     def claimed_bytes(self) -> int:
-        """Resident bytes PLUS in-flight reservations — what every
-        budget comparison must see."""
+        """Resident bytes PLUS in-flight reservations PLUS external
+        (non-dataset) residency claims — what every budget comparison
+        must see."""
         with self._mu:
-            return self.resident_bytes() + self._pending
+            return (
+                self.resident_bytes()
+                + self._pending
+                + sum(self._external.values())
+            )
 
     def _evict_lru(self) -> bool:
         with self._mu:
@@ -550,6 +561,47 @@ class DeviceDatasetCache:
         self._sync_metrics()
         return True
 
+    def reserve_external(self, tag: str, need_bytes: int) -> bool:
+        """Book `need_bytes` of budget-accounted residency for a
+        non-dataset consumer (keyed by `tag`; a repeat reservation for
+        the same tag REPLACES the old claim), LRU-evicting dataset
+        entries to make room — residency is re-creatable, a pinned
+        serving model is not re-creatable cheaply mid-request.  On False
+        nothing is claimed (the old claim for `tag`, if any, stays) and
+        the caller degrades: the serving registry evicts its own LRU
+        pins and retries.  External claims are visible to every budget
+        comparison (`claimed_bytes`, hence `cache_resident_bytes()` and
+        core's `_over_device_budget`) but are never evicted from this
+        side — only `release_external` drops them."""
+        budget = cache_budget_bytes()
+        need_bytes = int(need_bytes)
+        with self._mu:
+            old = self._external.get(tag, 0)
+            extra = need_bytes - old
+            if extra > budget:
+                return False
+            while self.claimed_bytes() + extra > budget:
+                if not self._evict_lru():
+                    break
+            if self.claimed_bytes() + extra > budget:
+                return False
+            self._external[tag] = need_bytes
+        _note("external_reserves", detail=f"tag={tag} bytes={need_bytes}")
+        return True
+
+    def release_external(self, tag: str) -> int:
+        """Drop an external residency claim; returns the bytes freed
+        (0 for an unknown tag).  Idempotent."""
+        with self._mu:
+            freed = self._external.pop(tag, 0)
+        if freed:
+            _note("external_releases", detail=f"tag={tag} bytes={freed}")
+        return freed
+
+    def external_bytes(self) -> int:
+        with self._mu:
+            return sum(self._external.values())
+
     def insert(self, entry: CacheEntry) -> None:
         with self._mu:
             self._clock += 1
@@ -587,11 +639,25 @@ def get_device_cache() -> DeviceDatasetCache:
 
 
 def clear_device_cache() -> None:
-    """Release every resident entry (tests; explicit operator reset;
-    the OOM-recovery paths in core.py call this so resident entries
-    cannot starve a retried fit)."""
+    """Release every resident DATASET entry (tests; explicit operator
+    reset; the OOM-recovery paths in core.py call this so resident
+    entries cannot starve a retried fit).  External claims (pinned
+    serving models) survive: they are not re-creatable mid-request and
+    their owner (serving/registry.py) runs its own eviction."""
     if _global_cache is not None:
         _global_cache.clear()
+
+
+def reserve_external(tag: str, need_bytes: int) -> bool:
+    """Module-level facade over `DeviceDatasetCache.reserve_external`
+    on the global cache (the serving registry's entry point)."""
+    return get_device_cache().reserve_external(tag, need_bytes)
+
+
+def release_external(tag: str) -> int:
+    if _global_cache is None:
+        return 0
+    return _global_cache.release_external(tag)
 
 
 def cache_resident_bytes() -> int:
@@ -765,4 +831,6 @@ __all__ = [
     "get_device_cache",
     "get_or_stage",
     "invalidate_for_devices",
+    "release_external",
+    "reserve_external",
 ]

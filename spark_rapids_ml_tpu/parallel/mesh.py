@@ -677,18 +677,27 @@ class RowStager:
         self, n_local_rows: int, mesh: Mesh,
         bucketing: Optional[bool] = None,
         interleave: Optional[bool] = None,
+        telemetry: bool = True,
     ) -> None:
         """`bucketing` pads the row count to the shape-bucket grid for
         compile sharing; `interleave` round-robins rows over devices so
         bucketed padding doesn't starve the tail devices of valid rows.
         Pass `interleave=False` for order-sensitive consumers (top-k tie
         breaking): the contiguous layout keeps original row order on the
-        devices while bucketed padding still shares compiles."""
+        devices while bucketed padding still shares compiles.
+        `telemetry=False` skips the per-staging instrumentation
+        (dataset-staging counter, byte-model prediction, device-memory
+        census) — for request-rate consumers like the serving
+        dispatcher, where a ~ms `jax.live_arrays()` census per 1-row
+        micro-batch would eat the latency SLO and a fit-scale
+        `dataset_stagings` bump per request would skew a counter defined
+        as one full feature-block staging."""
         _ensure_distributed()
         self.mesh = mesh
         self.n_proc = jax.process_count()
         self._replicated_input = False
         self._interleave = False
+        self._telemetry = bool(telemetry)
         if self.n_proc == 1:
             from ..config import get_config
 
@@ -766,7 +775,7 @@ class RowStager:
     @classmethod
     def for_replicated(
         cls, n_rows: int, mesh: Mesh, bucketing: Optional[bool] = None,
-        interleave: Optional[bool] = None,
+        interleave: Optional[bool] = None, telemetry: bool = True,
     ) -> "RowStager":
         """Stager for host arrays REPLICATED on every process (model
         attributes, transform inputs the caller holds in full).  Each
@@ -776,7 +785,7 @@ class RowStager:
         _ensure_distributed()
         if jax.process_count() == 1:
             return cls(n_rows, mesh, bucketing=bucketing,
-                       interleave=interleave)
+                       interleave=interleave, telemetry=telemetry)
         pid, n_proc = jax.process_index(), jax.process_count()
         from jax.experimental import multihost_utils
 
@@ -801,6 +810,7 @@ class RowStager:
         st.n_proc = n_proc
         st._replicated_input = True
         st._interleave = False  # multi-process blocks stay contiguous
+        st._telemetry = bool(telemetry)
         st._lo = int(counts[:pid].sum())
         st._init_layout(counts, mesh)
         # n_valid for a replicated stager is the full input length the
@@ -827,7 +837,7 @@ class RowStager:
             raise ValueError(
                 f"array has {arr.shape[0]} rows, stager expects {self.n_local}"
             )
-        if arr.ndim == 2:
+        if arr.ndim == 2 and self._telemetry:
             # 1-D companions (labels/weights/masks/fold-ids) ride along a
             # dataset staging; only the feature block counts as one
             note_dataset_staging()
@@ -851,13 +861,21 @@ class RowStager:
                     sharding, (self.local_padded,) + arr.shape[1:]
                 ) is not None:
                     return self._stage_pipelined(arr, dtype, sharding)
+                if not _FORCE_PIPELINED and self._small_direct_eligible():
+                    devices = _writer_devices(
+                        sharding, (self.local_padded,) + arr.shape[1:]
+                    )
+                    if devices is not None:
+                        return self._stage_small_direct(
+                            arr, dtype, sharding, devices
+                        )
                 return self._stage_serial(arr, dtype)
             padded = self._pad_host(arr, dtype)
             return jax.make_array_from_process_local_data(
                 sharding, padded, (self.n_padded,) + padded.shape[1:]
             )
         finally:
-            if arr.ndim == 2:
+            if arr.ndim == 2 and self._telemetry:
                 # a staging is exactly where resident bytes step up:
                 # sample so per-fit peak watermarks see the new level
                 from ..telemetry.memory import sample_devices
@@ -889,6 +907,49 @@ class RowStager:
         padded = self._pad_host(arr, dtype)
         sharding = NamedSharding(self.mesh, data_pspec(padded.ndim))
         return _chunked_device_put(self._to_layout(padded), sharding)
+
+    def _small_direct_eligible(self) -> bool:
+        from ..config import get_config
+
+        return bool(get_config("staging_small_direct"))
+
+    def _stage_small_direct(
+        self, arr: np.ndarray, dtype: np.dtype, sharding, devices
+    ) -> jax.Array:
+        """Small-batch fast path (sub-`_PIPELINED_MIN_BYTES` arrays): the
+        serial path pays a full padded host copy (`_pad_host`), a second
+        full copy for the interleave permutation (`_to_layout`) and a
+        global sharded device_put — machinery sized for dataset stagings,
+        not for the 1-row.. few-row micro-batches the serving layer
+        (serving/) dispatches at request rate.  Here each device shard's
+        rows slice straight out of the caller's array (the interleave
+        permutation fused into a strided basic slice, the cast fused
+        into the assignment), land in one small zero-padded shard
+        buffer, and `jax.device_put` moves each buffer to exactly its
+        device — no jitted update programs, no GSPMD, no full-array
+        copy.  Byte-identical to `_stage_serial` for every layout
+        (asserted by tests/test_staging_pipeline.py); gated by the
+        `staging_small_direct` conf."""
+        n_dev = len(devices)
+        s = self.local_padded // n_dev
+        shard_shape = (s,) + arr.shape[1:]
+        n_local = self.n_local
+        pieces = []
+        for d_i in range(n_dev):
+            if self._interleave:
+                # laid-out shard row p holds original row p*n_dev + d_i
+                start, step = d_i, n_dev
+                cnt = max(0, -(-(n_local - d_i) // n_dev))
+            else:
+                start, step = d_i * s, 1
+                cnt = min(max(n_local - d_i * s, 0), s)
+            piece = np.zeros(shard_shape, dtype)
+            if cnt:
+                piece[:cnt] = arr[start : start + cnt * step : step]
+            pieces.append(jax.device_put(piece, devices[d_i]))
+        return jax.make_array_from_single_device_arrays(
+            (self.local_padded,) + arr.shape[1:], sharding, pieces
+        )
 
     def _stage_pipelined(
         self, arr: np.ndarray, dtype: np.dtype, sharding

@@ -47,6 +47,12 @@ KNOWN_SITES = frozenset({
     "lbfgs_iteration",
     "linreg_fista",
     "fused_accumulate",
+    # the serving dispatcher's coalesced micro-batch dispatch
+    # (serving/server.py): an injected OOM shrinks the coalescing batch
+    # cap, a device_lost routes through elastic recovery and re-pins
+    # every resident model on the shrunken mesh — no queued request is
+    # lost either way
+    "serving_dispatch",
 })
 
 # Injectable fault kinds (`_Fault` validates against this; the docs and

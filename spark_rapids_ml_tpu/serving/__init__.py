@@ -1,0 +1,50 @@
+#
+# serving/ — the online inference subsystem: transform/predict traffic
+# from device-resident models (ROADMAP item 1, "millions of users" means
+# serving, not just fits).  Four pieces:
+#
+#   registry.py   model residency: a registered model's weight arrays
+#                 replicate onto the serving mesh ONCE (budget-accounted
+#                 through parallel/device_cache.py's external-reservation
+#                 ledger, LRU-evicted under pressure, transparently
+#                 re-pinned on the next request), so no request pays a
+#                 weight re-upload.
+#   server.py     the micro-batch coalescer + async dispatcher:
+#                 concurrent small requests per model concatenate into
+#                 one padded device batch under the `serving_max_wait_ms`
+#                 SLO, with admission control (`serving_max_queue` ->
+#                 typed ServingOverload) and policy-driven degradation
+#                 (OOM shrinks the batch cap, device loss re-pins on the
+#                 elastic-shrunken mesh, transients back off — queued
+#                 requests survive).
+#   http.py       the opt-in stdlib HTTP JSON endpoint (`serving_port`
+#                 conf; loopback by default, like `telemetry_port`).
+#
+# Metrics land in the telemetry registry (`serving_request_latency_
+# seconds{model,phase}`, `serving_batch_rows`, `serving_rejections_
+# total`, pin lifecycle counters) and export through the existing
+# /metrics endpoint; `ServingServer.report()` renders per-model p50/p99.
+# See docs/serving.md for architecture, SLO tuning, and the degradation
+# table.
+#
+#   from spark_rapids_ml_tpu.serving import ServingServer, ServingClient
+#   server = ServingServer()
+#   server.register("pca", pca_model)
+#   server.start()
+#   client = ServingClient(server)
+#   projected = client.transform("pca", rows)
+#
+from .registry import ModelRegistry, PinnedModel  # noqa: F401
+from .server import (  # noqa: F401
+    ServingClient,
+    ServingOverload,
+    ServingServer,
+)
+
+__all__ = [
+    "ModelRegistry",
+    "PinnedModel",
+    "ServingClient",
+    "ServingOverload",
+    "ServingServer",
+]

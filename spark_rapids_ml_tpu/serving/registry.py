@@ -1,0 +1,348 @@
+#
+# Serving model registry — device residency for the inference side.  A
+# fitted model's transform path normally re-uploads its weight arrays on
+# every call (`jnp.asarray(self.components_...)` inside
+# `_transform_device`) and nothing accounts for the HBM those weights
+# occupy.  Here a model is PINNED once: its ndarray attributes move onto
+# the serving mesh as replicated device arrays (a shallow copy of the
+# model carries them, the caller's object is never mutated), so every
+# subsequent micro-batch dispatch reuses the resident weights and the
+# compiled `_transform_device` program for its shape bucket — zero
+# weight re-staging across requests (asserted by tests/test_serving.py).
+#
+# Residency is budget-accounted: a pin books `sum(weight bytes) x n_dev`
+# (replication puts one copy in every device's HBM) through
+# `parallel/device_cache.py`'s external-reservation ledger, so fit-side
+# staging decisions see serving residency and vice versa; under pressure
+# the registry LRU-evicts its own pins (the dataset cache LRU-evicts its
+# entries) and an evicted model transparently RE-PINS on its next
+# request.  After an elastic mesh shrink (resilience/elastic.py) the
+# dispatcher calls `repin_all`: every resident model re-replicates onto
+# the surviving device set.
+#
+# Models that manage their own staging (kNN, DBSCAN, UMAP — no
+# `_transform_device`) register as HOST-path models: their requests
+# still coalesce into micro-batches, but dispatch goes through the
+# model's own `_transform_array` and no residency is claimed.
+#
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..telemetry.registry import counter, gauge
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.serving")
+
+PINS = counter(
+    "serving_pins_total",
+    "Serving model-pin lifecycle events (pin/repin/evict/unpin) by model",
+)
+PINNED_MODELS = gauge(
+    "serving_pinned_models", "Models currently pinned on the serving mesh"
+)
+PINNED_BYTES = gauge(
+    "serving_pinned_bytes",
+    "Budget-accounted bytes of pinned serving-model residency",
+)
+
+
+def _external_tag(name: str) -> str:
+    return f"serving:{name}"
+
+
+# arrays below this stay host-resident when a model pins: reading an
+# element of a pinned (device) array builds a python scalar through a
+# blocking device fetch, which binomial logreg does per dispatch
+# (`self.intercept_[0]`) — scalars and tiny vectors (intercepts,
+# variance ratios) are exactly the attrs transforms read elementwise,
+# and their per-call upload is noise next to one weight matrix
+_PIN_MIN_BYTES = 64
+
+
+class PinnedModel:
+    """One registered model, ready to dispatch: the pinned shallow copy
+    (device-resident weight arrays when `device` is True), the mesh it
+    is replicated on, and its accounting size."""
+
+    __slots__ = (
+        "name", "model", "device", "mesh", "dtype", "n_features",
+        "nbytes", "last_used", "transform_fn",
+    )
+
+    def __init__(self, name: str, model: Any, device: bool, mesh,
+                 dtype: np.dtype, n_features: Optional[int],
+                 nbytes: int, transform_fn=None) -> None:
+        self.name = name
+        self.model = model
+        self.device = device
+        self.mesh = mesh
+        self.dtype = np.dtype(dtype)
+        self.n_features = n_features
+        self.nbytes = int(nbytes)
+        self.last_used = time.monotonic()
+        # host-path dispatch callable (X) -> {col: array}; None for
+        # device-pinned models (they dispatch via _transform_device)
+        self.transform_fn = transform_fn
+
+
+class ModelRegistry:
+    """Name-keyed registry of serveable models.  `register` keeps the
+    caller's HOST model (the re-pin source) and pins it; `resolve`
+    returns the pinned entry, transparently re-pinning one that was
+    LRU-evicted under budget pressure.  All mutations hold the instance
+    lock; pinning itself (device transfers) runs outside it so a slow
+    replication cannot stall concurrent resolves of other models."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._host: Dict[str, Dict[str, Any]] = {}  # name -> registration
+        self._pinned: Dict[str, PinnedModel] = {}
+
+    # -- registration --------------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        model: Any,
+        dtype: Any = np.float32,
+        n_features: Optional[int] = None,
+        transform: Any = None,
+    ) -> PinnedModel:
+        """Register `model` under `name` and pin it.  Models with a
+        device transform (`_transform_device`) pin device-resident;
+        models without one (kNN and friends manage their own staging)
+        register as host-path — coalesced micro-batching still applies,
+        residency accounting does not.  `transform` overrides the
+        host-path dispatch callable (`(X) -> {col: array}`; default
+        `model._transform_array`) — the kNN hook, whose query surface is
+        `kneighbors`, not transform."""
+        from ..core import _TpuModel
+
+        if not isinstance(model, _TpuModel):
+            raise TypeError(
+                f"serving requires a fitted _TpuModel, got {type(model)!r}"
+            )
+        has_device = (
+            type(model)._transform_device is not _TpuModel._transform_device
+        )
+        if not has_device and transform is None and (
+            type(model)._transform_array is _TpuModel._transform_array
+        ):
+            raise ValueError(
+                f"model {name!r} implements neither _transform_device nor "
+                "_transform_array; pass transform=<callable> to serve it"
+            )
+        if n_features is None:
+            nc = model._get_model_attributes().get("n_cols")
+            if nc is not None:
+                n_features = int(nc)
+        with self._mu:
+            self._host[name] = {
+                "model": model,
+                "dtype": np.dtype(dtype),
+                "n_features": n_features,
+                "transform": transform,
+            }
+        return self._pin(name, event="pin")
+
+    def unregister(self, name: str) -> None:
+        with self._mu:
+            self._host.pop(name, None)
+        self._drop(name, event="unpin")
+
+    def names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._host)
+
+    def info(self, name: str) -> Dict[str, Any]:
+        """Registration facts for the admission check — never pins."""
+        with self._mu:
+            reg = self._host.get(name)
+            if reg is None:
+                raise KeyError(f"no serving model registered as {name!r}")
+            return dict(reg)
+
+    def pin_feature_width(self, name: str, d: int) -> int:
+        """Adopt the first observed request width for a model registered
+        WITHOUT `n_features`, atomically; returns the canonical width.
+        Without this, two concurrent first requests of different widths
+        would coalesce into one batch and the np.concatenate failure
+        would poison the valid request alongside the bad one — admission
+        must reject the mismatch instead."""
+        with self._mu:
+            reg = self._host.get(name)
+            if reg is None:
+                raise KeyError(f"no serving model registered as {name!r}")
+            if reg.get("n_features") is None:
+                reg["n_features"] = int(d)
+            return int(reg["n_features"])
+
+    def pinned_names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._pinned)
+
+    # -- resolution ----------------------------------------------------------
+
+    def resolve(self, name: str) -> PinnedModel:
+        """The pinned entry for `name`, re-pinning an evicted model (a
+        cache-miss-shaped event: the host model is the re-pin source)."""
+        with self._mu:
+            if name not in self._host:
+                raise KeyError(f"no serving model registered as {name!r}")
+            entry = self._pinned.get(name)
+            if entry is not None:
+                entry.last_used = time.monotonic()
+                return entry
+        return self._pin(name, event="repin")
+
+    # -- pinning -------------------------------------------------------------
+
+    def _pin(self, name: str, event: str) -> PinnedModel:
+        from ..core import _TpuModel
+        from ..parallel.device_cache import reserve_external
+        from ..parallel.mesh import get_mesh
+
+        with self._mu:
+            reg = dict(self._host[name])
+        model = reg["model"]
+        has_device = (
+            type(model)._transform_device is not _TpuModel._transform_device
+        )
+        if not has_device:
+            entry = PinnedModel(
+                name, model, device=False, mesh=None,
+                dtype=reg["dtype"], n_features=reg["n_features"], nbytes=0,
+                transform_fn=reg.get("transform") or model._transform_array,
+            )
+            with self._mu:
+                self._pinned[name] = entry
+            PINS.inc(model=name, event=event)
+            self._sync_gauges()
+            return entry
+        mesh = get_mesh()
+        pinned_model, nbytes = self._replicate_arrays(model, mesh)
+        # book the residency BEFORE publishing: under pressure, evict our
+        # own LRU pins (never the one being pinned) until it fits — the
+        # dataset-cache side of the ledger LRU-evicts its entries first
+        while not reserve_external(_external_tag(name), nbytes):
+            if not self._evict_lru(exclude=name):
+                raise RuntimeError(
+                    f"serving model {name!r} (~{nbytes/2**20:.1f} MiB "
+                    "replicated) does not fit the device budget even "
+                    "with every other pin evicted"
+                )
+        entry = PinnedModel(
+            name, pinned_model, device=True, mesh=mesh,
+            dtype=reg["dtype"], n_features=reg["n_features"], nbytes=nbytes,
+        )
+        with self._mu:
+            self._pinned[name] = entry
+        PINS.inc(model=name, event=event)
+        from ..tracing import event as trace_event
+
+        trace_event(
+            f"serving_pin[{name}]",
+            detail=f"{event} bytes={nbytes} n_dev={mesh.devices.size}",
+            log=logger,
+        )
+        self._sync_gauges()
+        return entry
+
+    def _replicate_arrays(self, model: Any, mesh) -> tuple:
+        """A shallow copy of `model` whose ndarray attributes are
+        replicated jax arrays on `mesh`.  Returns (pinned model, bytes):
+        bytes = one replica per device, the cluster-wide honest cost the
+        external reservation books.  Dtypes go through jnp.asarray's
+        canonicalization so the pinned weights match what the unpinned
+        transform's per-call `jnp.asarray` would have produced."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        pinned = copy.copy(model)
+        attrs = dict(model._get_model_attributes())
+        sharding = NamedSharding(mesh, PartitionSpec())
+        replica_bytes = 0
+        for key, val in attrs.items():
+            if not isinstance(val, np.ndarray) or val.dtype == object:
+                continue
+            if val.nbytes < _PIN_MIN_BYTES:
+                # stays host numpy (see _PIN_MIN_BYTES): elementwise
+                # reads of a pinned array would pay a BLOCKING device
+                # round-trip per dispatch on the latency-critical path
+                continue
+            dev = jax.device_put(jnp.asarray(val), sharding)
+            replica_bytes += int(dev.nbytes)
+            attrs[key] = dev
+            if hasattr(pinned, key):
+                setattr(pinned, key, dev)
+        pinned._model_attributes = attrs
+        return pinned, replica_bytes * int(mesh.devices.size)
+
+    # -- eviction ------------------------------------------------------------
+
+    def _evict_lru(self, exclude: Optional[str] = None) -> bool:
+        with self._mu:
+            candidates = [
+                e for e in self._pinned.values()
+                if e.device and e.name != exclude
+            ]
+            if not candidates:
+                return False
+            victim = min(candidates, key=lambda e: e.last_used)
+        self._drop(victim.name, event="evict")
+        return True
+
+    def _drop(self, name: str, event: str) -> None:
+        from ..parallel.device_cache import release_external
+
+        with self._mu:
+            entry = self._pinned.pop(name, None)
+        if entry is None:
+            return
+        if entry.device:
+            release_external(_external_tag(name))
+        PINS.inc(model=name, event=event)
+        self._sync_gauges()
+
+    def repin_all(self, reason: str = "elastic") -> None:
+        """Drop every device-resident pin and re-pin on the CURRENT
+        active mesh — the dispatcher's device-loss hook: arrays
+        replicated over a lost chip are unreadable, and the re-pin lands
+        every model on the survivors (resilience/elastic.py shrank the
+        mesh before this runs)."""
+        with self._mu:
+            names = [e.name for e in self._pinned.values() if e.device]
+        logger.warning(
+            f"serving: re-pinning {len(names)} model(s) on the current "
+            f"mesh ({reason})"
+        )
+        for name in names:
+            self._drop(name, event="evict")
+            self._pin(name, event="repin")
+
+    def clear(self) -> None:
+        with self._mu:
+            names = list(self._pinned)
+        for name in names:
+            self._drop(name, event="unpin")
+        with self._mu:
+            self._host.clear()
+
+    def pinned_bytes(self) -> int:
+        with self._mu:
+            return sum(e.nbytes for e in self._pinned.values())
+
+    def _sync_gauges(self) -> None:
+        with self._mu:
+            PINNED_MODELS.set(len(self._pinned))
+            PINNED_BYTES.set(sum(e.nbytes for e in self._pinned.values()))
+
+
+__all__ = ["ModelRegistry", "PinnedModel"]

@@ -1,0 +1,763 @@
+#
+# Micro-batched transform server — the online inference front end
+# (ROADMAP item 1).  The Snap ML hierarchy (PAPERS.md) applied to this
+# runtime: request handling stays on host threads, compute coalesces
+# onto the chips.  Concurrent single-row/small-batch requests for one
+# model queue per model, a dispatcher thread concatenates them into ONE
+# padded micro-batch (Clipper-style adaptive batching under the
+# `serving_max_wait_ms` SLO knob), stages it through the small-batch
+# direct fast path (parallel/mesh.py `_stage_small_direct`), runs the
+# pinned model's `_transform_device` over the mesh, and scatters the
+# per-request row slices back to each caller's future.
+#
+# The dispatcher is ASYNC with a bounded in-flight depth of two batches:
+# batch N+1's host prep + device transfer ride the wire while batch N
+# computes and fetches (the same one-deep pipeline `_transform_mesh`
+# uses), so the sync point is always a fetch of finished work.
+# Admission control bounds the queue (`serving_max_queue` -> typed
+# `ServingOverload`), and every failure degrades instead of dropping
+# requests: an OOM halves the coalescing cap (floor: one row per
+# device), a device loss routes through elastic recovery
+# (resilience/elastic.py) and re-pins every resident model on the
+# shrunken mesh, transients back off — queued requests survive all
+# three, bounded by the retry policy's attempt budget.
+#
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from ..config import get_config
+from ..telemetry.registry import counter, histogram
+from ..tracing import adopt_trace_context, event, trace
+from ..utils import get_logger
+from .registry import ModelRegistry, PinnedModel
+
+logger = get_logger("spark_rapids_ml_tpu.serving")
+
+# sub-millisecond to seconds: serving latencies sit far below the
+# default fit-scale buckets
+_LATENCY_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+_BATCH_BUCKETS = (
+    1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+    1024.0, 2048.0, 4096.0, 8192.0,
+)
+
+LATENCY = histogram(
+    "serving_request_latency_seconds",
+    "Per-request serving latency by phase (queue|dispatch|total)",
+    buckets=_LATENCY_BUCKETS,
+)
+BATCH_ROWS = histogram(
+    "serving_batch_rows",
+    "Rows per coalesced serving dispatch",
+    buckets=_BATCH_BUCKETS,
+)
+REQUESTS = counter(
+    "serving_requests_total", "Admitted serving requests by model"
+)
+REJECTIONS = counter(
+    "serving_rejections_total",
+    "Rejected serving requests by model and reason",
+)
+
+# exact per-model latency samples for the p50/p99 report (the registry
+# histogram's buckets are for Prometheus; percentiles in the per-model
+# report come from real samples, bounded per model)
+_REPORT_SAMPLES = 4096
+
+# clean batches between each doubling of an OOM-shrunk coalescing cap
+# back toward the configured value
+_CAP_REGROW_BATCHES = 32
+
+
+class ServingOverload(RuntimeError):
+    """Typed admission-control rejection: the request queue is at
+    `serving_max_queue` (or the server is not accepting).  Callers shed
+    load or retry with backoff; the request was NOT enqueued."""
+
+    def __init__(self, model: str, reason: str, detail: str = "") -> None:
+        super().__init__(
+            f"serving overloaded ({reason}) for model {model!r}"
+            + (f": {detail}" if detail else "")
+        )
+        self.model = model
+        self.reason = reason
+
+
+class _Request:
+    __slots__ = ("model", "X", "rows", "t_enqueue", "future", "attempts")
+
+    def __init__(self, model: str, X: np.ndarray) -> None:
+        self.model = model
+        self.X = X
+        self.rows = int(X.shape[0])
+        self.t_enqueue = time.perf_counter()
+        self.future: Future = Future()
+        # failed dispatch/collect rounds THIS request has been through:
+        # the retry budget is per request, so one model's poisoned batch
+        # can neither exhaust another model's attempts nor ride interleaved
+        # successes to retry forever
+        self.attempts = 0
+
+
+class _InFlight:
+    """One dispatched micro-batch riding the async pipeline: the
+    requests it carries, the staging layout, and the in-flight device
+    outputs (or already-host outputs for host-path models)."""
+
+    __slots__ = ("name", "model", "reqs", "rows", "stager", "dev",
+                 "host_outs", "t_dispatch")
+
+    def __init__(self, name, model, reqs, rows, stager, dev, host_outs,
+                 t_dispatch) -> None:
+        self.name = name
+        # the dispatched model rides the flight: collect must fetch with
+        # the SAME object the device outputs came from — a registry
+        # re-resolve there could re-pin an evicted model (a full weight
+        # re-replication on the latency-critical fetch path) or raise
+        # for one unregistered between dispatch and collect, failing
+        # finished, fetchable work
+        self.model = model
+        self.reqs = reqs
+        self.rows = rows
+        self.stager = stager
+        self.dev = dev
+        self.host_outs = host_outs
+        self.t_dispatch = t_dispatch
+
+
+class ServingServer:
+    """The in-process serving runtime: a model registry, per-model
+    request queues, and one dispatcher thread.  `register` models, then
+    `start()`; submit work through a `ServingClient` (or `transform`
+    directly).  `stop()` drains the queue before the thread exits."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None) -> None:
+        self.registry = registry or ModelRegistry()
+        self._cv = threading.Condition()
+        self._queues: Dict[str, Deque[_Request]] = {}
+        self._queued = 0
+        self._running = False
+        self._paused = False
+        self._thread: Optional[threading.Thread] = None
+        # True once the dispatcher's final cv-guarded exit check passed:
+        # start() reads it UNDER the cv to decide revive-vs-spawn, so a
+        # stop() whose join timed out mid-drain can never race a SECOND
+        # dispatcher onto the same queues
+        self._loop_done = True
+        self._http = None
+        # degradation state: the OOM-shrunk coalescing cap (None = use
+        # the configured/byte-model cap), re-grown after sustained clean
+        # batches so one transient OOM does not cap QPS for the process
+        # lifetime
+        self._shrunk_cap: Optional[int] = None
+        self._clean_batches = 0
+        self._batches = 0
+        self._lat: Dict[str, Deque[float]] = {}
+        # per-INSTANCE request/rejection counts for report(): the
+        # registry counters are process-global by Prometheus design, and
+        # a fresh server must not report a predecessor's history
+        self._req_counts: Dict[str, int] = {}
+        self._rej_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()  # report/latency state
+
+    # -- registration (delegates; kept here so one object serves) ----------
+
+    def register(self, name: str, model: Any, dtype: Any = np.float32,
+                 n_features: Optional[int] = None,
+                 transform: Any = None) -> None:
+        self.registry.register(name, model, dtype=dtype,
+                               n_features=n_features, transform=transform)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServingServer":
+        with self._cv:
+            if self._running:
+                return self
+            self._running = True
+            spawn = self._loop_done
+            if spawn:
+                self._loop_done = False
+            self._cv.notify_all()
+        if not spawn:
+            # a previous stop() timed out mid-drain and its dispatcher
+            # is still looping: setting _running under the cv revived it
+            # (its exit check holds the same lock), so it resumes
+            # serving — a second thread would race it on the queues.
+            # The HTTP front end was torn down by that stop() and must
+            # come back with the revive.
+            self._maybe_start_http()
+            return self
+        # the dispatcher records spans/markers: adopt the starter's trace
+        # buffer + run context so serving dispatch timings and resilience
+        # markers land where the operator is looking
+        adopt = adopt_trace_context()
+
+        def _worker() -> None:
+            adopt()
+            self._loop()
+
+        self._thread = threading.Thread(
+            target=_worker, name="serving-dispatcher", daemon=True
+        )
+        self._thread.start()
+        self._maybe_start_http()
+        return self
+
+    def _maybe_start_http(self) -> None:
+        port = int(get_config("serving_port") or 0)
+        if port > 0 and self._http is None:
+            from .http import start_serving_http
+
+            self._http = start_serving_http(self, port)
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        with self._cv:
+            if not self._running:
+                return
+            self._running = False
+            if not drain:
+                doomed = [r for q in self._queues.values() for r in q]
+                for q in self._queues.values():
+                    q.clear()
+                self._queued = 0
+            else:
+                doomed = []
+            self._cv.notify_all()
+        for r in doomed:
+            REJECTIONS.inc(model=r.model, reason="stopped")
+            r.future.set_exception(
+                ServingOverload(r.model, "stopped", "server shut down")
+            )
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.error(
+                    f"serving dispatcher did not exit within {timeout:.0f}s "
+                    "(drain backlog or wedged fetch); it will finish "
+                    "draining in the background — start() would revive "
+                    "it, not spawn a second dispatcher"
+                )
+            else:
+                self._thread = None
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+            self._http = None
+
+    def pause(self) -> None:
+        """Hold dispatch (requests keep queueing) — maintenance windows
+        and deterministic coalescing in tests."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, name: str, X: Any) -> Future:
+        """Enqueue one transform request; returns a Future resolving to
+        `{output_col: np.ndarray}` with one row per input row.  Raises
+        `ServingOverload` at the admission gate (never enqueued) and
+        KeyError/ValueError for unknown models / wrong feature width."""
+        info = self.registry.info(name)  # KeyError for unknown models
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(
+                f"serving input must be a non-empty (rows, features) "
+                f"block, got shape {X.shape}"
+            )
+        want = info.get("n_features")
+        if want is None:
+            # width-blind registration: the first request's width becomes
+            # canonical, so mixed-width traffic is rejected HERE instead
+            # of poisoning a coalesced batch at np.concatenate
+            want = self.registry.pin_feature_width(name, int(X.shape[1]))
+        if int(X.shape[1]) != int(want):
+            raise ValueError(
+                f"model {name!r} expects {want} features, got {X.shape[1]}"
+            )
+        req = _Request(name, X)
+        with self._cv:
+            if not self._running:
+                REJECTIONS.inc(model=name, reason="stopped")
+                raise ServingOverload(name, "stopped", "server not running")
+            if self._queued >= self._max_queue():
+                REJECTIONS.inc(model=name, reason="queue_full")
+                with self._lock:
+                    self._rej_counts[name] = (
+                        self._rej_counts.get(name, 0) + 1
+                    )
+                raise ServingOverload(
+                    name, "queue_full",
+                    f"{self._queued} requests queued "
+                    f"(serving_max_queue={self._max_queue()})",
+                )
+            self._queues.setdefault(name, collections.deque()).append(req)
+            self._queued += 1
+            self._cv.notify_all()
+        REQUESTS.inc(model=name)
+        with self._lock:
+            self._req_counts[name] = self._req_counts.get(name, 0) + 1
+        return req.future
+
+    def transform(self, name: str, X: Any,
+                  timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """Blocking convenience over `submit`."""
+        return self.submit(name, X).result(timeout=timeout)
+
+    # -- report --------------------------------------------------------------
+
+    def report(self) -> Dict[str, Any]:
+        """Per-model serving report: request/batch counts, mean batch
+        rows, and exact p50/p99 latency over the last `_REPORT_SAMPLES`
+        requests — the operator-facing SLO view (docs/serving.md)."""
+        out: Dict[str, Any] = {}
+        with self._lock:
+            samples = {k: list(v) for k, v in self._lat.items()}
+            req_counts = dict(self._req_counts)
+            rej_counts = dict(self._rej_counts)
+        for name in self.registry.names():
+            lat = samples.get(name, [])
+            entry: Dict[str, Any] = {
+                # per-instance counts: the prometheus families are
+                # process-global, a fresh server must not report a
+                # predecessor's history
+                "requests": req_counts.get(name, 0),
+                "rejections_queue_full": rej_counts.get(name, 0),
+                "pinned": name in self.registry.pinned_names(),
+            }
+            if lat:
+                srt = sorted(lat)
+
+                def _pct(p: float) -> float:
+                    i = min(len(srt) - 1, int(round(p * (len(srt) - 1))))
+                    return srt[i]
+
+                entry.update(
+                    latency_samples=len(srt),
+                    p50_ms=round(_pct(0.50) * 1e3, 3),
+                    p99_ms=round(_pct(0.99) * 1e3, 3),
+                    mean_ms=round(sum(srt) / len(srt) * 1e3, 3),
+                )
+            out[name] = entry
+        out["_totals"] = {
+            "batches": self._batches,
+            "queued": self._queued,
+            "pinned_bytes": self.registry.pinned_bytes(),
+        }
+        return out
+
+    # -- sizing --------------------------------------------------------------
+
+    def _max_queue(self) -> int:
+        return max(1, int(get_config("serving_max_queue")))
+
+    def _max_wait_s(self) -> float:
+        return max(0.0, float(get_config("serving_max_wait_ms"))) / 1e3
+
+    def _safe_info(self, name: str) -> Optional[Dict[str, Any]]:
+        """Registration facts, or None for a model unregistered while
+        requests were still queued — the dispatcher must keep running
+        and FAIL those requests (via the dispatch-time KeyError), never
+        die on the lookup."""
+        try:
+            return self.registry.info(name)
+        except KeyError:
+            return None
+
+    def _batch_cap(self, info: Optional[Dict[str, Any]]) -> int:
+        """Rows one coalesced dispatch may carry: the configured cap,
+        bounded by the byte model every staged transfer is sized by
+        (`host_batch_bytes` / row bytes), then by the OOM-degraded
+        shrink cap."""
+        from ..streaming import chunk_rows_for
+
+        cap = max(1, int(get_config("serving_max_batch_rows")))
+        d = info.get("n_features") if info else None
+        if d:
+            cap = min(
+                cap,
+                int(chunk_rows_for(int(d), np.dtype(info["dtype"]).itemsize)),
+            )
+        if self._shrunk_cap is not None:
+            cap = min(cap, self._shrunk_cap)
+        return max(1, cap)
+
+    def _oom_floor(self) -> int:
+        """Smallest useful coalescing cap: one row per active device
+        (the same floor the transform chunk loop shrinks to)."""
+        from ..parallel.mesh import active_devices
+
+        return max(1, len(active_devices()))
+
+    # -- dispatcher ----------------------------------------------------------
+
+    def _ready_name_locked(self, now: float, draining: bool) -> Optional[str]:
+        """The queued model whose head request is due: past the max-wait
+        SLO, a full batch already queued, or the server draining.  Oldest
+        head wins, so no model starves behind a hot one."""
+        wait = self._max_wait_s()
+        best = None
+        best_t = None
+        for name, q in self._queues.items():
+            if not q:
+                continue
+            head = q[0]
+            info = self._safe_info(name)
+            cap = self._batch_cap(info)
+            rows = 0
+            for r in q:
+                rows += r.rows
+                if rows >= cap:
+                    break
+            due = (
+                draining
+                or info is None  # unregistered: dispatch fails it NOW
+                or (now - head.t_enqueue) >= wait
+                or rows >= cap
+            )
+            if due and (best_t is None or head.t_enqueue < best_t):
+                best, best_t = name, head.t_enqueue
+        return best
+
+    def _take_batch_locked(self, name: str) -> List[_Request]:
+        q = self._queues[name]
+        cap = self._batch_cap(self._safe_info(name))
+        reqs: List[_Request] = []
+        rows = 0
+        while q and (not reqs or rows + q[0].rows <= cap):
+            r = q.popleft()
+            self._queued -= 1
+            if r.future.cancelled():
+                continue  # the caller gave up while it queued
+            reqs.append(r)
+            rows += r.rows
+        return reqs
+
+    def _requeue_front(self, reqs: List[_Request]) -> None:
+        with self._cv:
+            for r in reversed(reqs):
+                self._queues.setdefault(
+                    r.model, collections.deque()
+                ).appendleft(r)
+                self._queued += 1
+            self._cv.notify_all()
+
+    def _next_deadline_locked(self, now: float) -> float:
+        if self._paused and self._running:
+            return 0.5  # resume() notifies; no deadline to honor
+        wait = self._max_wait_s()
+        deadline = None
+        for q in self._queues.values():
+            if q:
+                due = q[0].t_enqueue + wait
+                deadline = due if deadline is None else min(deadline, due)
+        if deadline is None:
+            return 0.5
+        return max(1e-4, min(deadline - now, 0.5))
+
+    def _loop(self) -> None:
+        pending: Optional[_InFlight] = None
+        while True:
+            batch: Optional[List[_Request]] = None
+            with self._cv:
+                while True:
+                    now = time.perf_counter()
+                    draining = not self._running
+                    name = (
+                        None if self._paused and self._running
+                        else self._ready_name_locked(now, draining)
+                    )
+                    if name is not None:
+                        # `or None`: a queue of nothing-but-cancelled
+                        # requests yields an empty take — loop back
+                        batch = self._take_batch_locked(name) or None
+                        break
+                    if pending is not None:
+                        break  # collect finished work instead of idling
+                    if draining and self._queued == 0:
+                        break
+                    self._cv.wait(timeout=self._next_deadline_locked(now))
+            if batch is None and pending is None:
+                with self._cv:
+                    if not self._running and self._queued == 0:
+                        # final exit decision under the cv: start() reads
+                        # _loop_done under the same lock, so revive and
+                        # exit cannot interleave into a dead server
+                        self._loop_done = True
+                        return
+                continue
+            # phase-separated failure attribution: a dispatch error
+            # belongs to THIS batch only — the pending batch of a
+            # (possibly different) model is already computing and stays
+            # in flight to collect next round, so a fatal error for one
+            # model can never fail another model's healthy work
+            current: Optional[_InFlight] = None
+            phase = "dispatch"
+            try:
+                current = self._dispatch(batch) if batch else None
+                phase = "collect"
+                if pending is not None:
+                    self._collect(pending)
+                    self._batches += 1
+                    self._note_clean_batch()
+                pending = current
+            except Exception as e:
+                if phase == "dispatch":
+                    recover = list(batch or [])
+                else:
+                    # the fetch is the shared sync point: both in-flight
+                    # batches are suspect and re-dispatch from the queue
+                    recover = list(pending.reqs)
+                    if current is not None:
+                        recover.extend(current.reqs)
+                    pending = None
+                self._recover_guarded(e, recover)
+
+    # -- dispatch / collect --------------------------------------------------
+
+    def _dispatch(self, reqs: List[_Request]) -> _InFlight:
+        """Stage one coalesced batch and launch its device program (jax
+        dispatch is async — the transfer/compute are in flight when this
+        returns).  Host-path models (no `_transform_device`) compute
+        synchronously here instead."""
+        from ..parallel.mesh import RowStager
+        from ..resilience import maybe_inject
+
+        name = reqs[0].model
+        pinned: PinnedModel = self.registry.resolve(name)
+        rows = sum(r.rows for r in reqs)
+        t0 = time.perf_counter()
+        with trace(f"serving_dispatch[{name}]", logger):
+            maybe_inject("serving_dispatch")
+            X = (
+                reqs[0].X
+                if len(reqs) == 1
+                else np.concatenate([r.X for r in reqs], axis=0)
+            )
+            BATCH_ROWS.observe(rows, model=name)
+            if not pinned.device:
+                X = np.ascontiguousarray(X, dtype=pinned.dtype)
+                outs = pinned.transform_fn(X)
+                return _InFlight(
+                    name, pinned.model, reqs, rows, None, None, outs, t0
+                )
+            # telemetry=False: the per-staging instrumentation (device
+            # census, dataset_stagings bump, byte prediction) is fit-
+            # scale bookkeeping a request-rate micro-batch must not pay
+            st = RowStager.for_replicated(
+                rows, pinned.mesh, telemetry=False
+            )
+            Xs = st.stage(np.ascontiguousarray(X), pinned.dtype)
+            dev = pinned.model._transform_device(Xs)
+        return _InFlight(name, pinned.model, reqs, rows, st, dev, None, t0)
+
+    def _collect(self, flight: _InFlight) -> None:
+        """Fetch one in-flight batch (the sync point) and scatter each
+        request's row slice to its future.  Futures resolve only after
+        EVERY column fetched, so a mid-fetch failure retries the whole
+        batch without partial results escaping."""
+        if flight.host_outs is not None:
+            outs = flight.host_outs
+        else:
+            with trace(f"serving_collect[{flight.name}]", logger):
+                outs = flight.model._fetch_transform_outputs(
+                    flight.stager, flight.dev
+                )
+        t_done = time.perf_counter()
+        lo = 0
+        with self._lock:
+            lat = self._lat.setdefault(
+                flight.name, collections.deque(maxlen=_REPORT_SAMPLES)
+            )
+        for r in flight.reqs:
+            sl = {c: v[lo : lo + r.rows] for c, v in outs.items()}
+            lo += r.rows
+            if r.future.done():
+                # cancelled by the caller while queued/in flight, or
+                # resolved by an earlier partially-scattered attempt a
+                # failure requeued — either way, publishing would raise
+                # InvalidStateError and poison the co-batched requests
+                continue
+            q_s = max(flight.t_dispatch - r.t_enqueue, 0.0)
+            d_s = max(t_done - flight.t_dispatch, 0.0)
+            tot = max(t_done - r.t_enqueue, 0.0)
+            LATENCY.observe(q_s, model=flight.name, phase="queue")
+            LATENCY.observe(d_s, model=flight.name, phase="dispatch")
+            LATENCY.observe(tot, model=flight.name, phase="total")
+            with self._lock:
+                lat.append(tot)
+            try:
+                r.future.set_result(sl)
+            except Exception:
+                pass  # cancelled in the race window above; result dropped
+
+    # -- degradation ---------------------------------------------------------
+
+    def _recover_guarded(self, e: Exception, reqs: List[_Request]) -> None:
+        """The last line of defense: a recovery that ITSELF blows up
+        must fail the recovered requests and keep the dispatcher alive —
+        a dead dispatcher turns every queued future into a permanent
+        hang (and every HTTP handler thread into a 504)."""
+        try:
+            self._recover(e, reqs)
+        except Exception as e2:
+            logger.error(
+                f"serving recovery failed ({type(e2).__name__}: {e2}); "
+                f"failing {len(reqs)} request(s)"
+            )
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e2)
+
+    def _note_clean_batch(self) -> None:
+        """Success-driven cap recovery: after enough clean batches the
+        OOM-shrunk coalescing cap doubles back toward the configured
+        value — one transient OOM must not cap QPS for the rest of the
+        process (the memory pressure that caused it is long gone)."""
+        if self._shrunk_cap is None:
+            return
+        self._clean_batches += 1
+        if self._clean_batches < _CAP_REGROW_BATCHES:
+            return
+        self._clean_batches = 0
+        grown = self._shrunk_cap * 2
+        if grown >= int(get_config("serving_max_batch_rows")):
+            self._shrunk_cap = None
+            logger.info("serving coalescing cap fully restored")
+        else:
+            self._shrunk_cap = grown
+
+    def _recover(self, e: Exception, reqs: List[_Request]) -> None:
+        """Policy-driven degradation for a failed dispatch/collect: the
+        in-flight requests are requeued at the FRONT (order preserved,
+        nothing lost) and the failure class picks the repair — mirroring
+        core.py's transform chunk loop, with the batch cap playing the
+        chunk-size role.  The attempt budget is PER REQUEST: one model's
+        poisoned batch can neither exhaust another model's attempts nor
+        ride interleaved successes to retry forever."""
+        from ..resilience import RetryPolicy
+        from ..resilience.retry import RETRIES
+
+        policy = RetryPolicy.from_config()
+        action = policy.classify(e)
+        limit = max(policy.max_attempts, 2)
+        floor_hit = (
+            action == "oom"
+            and (self._shrunk_cap or 1 << 30) <= self._oom_floor()
+        )
+        doomed: List[_Request] = []
+        alive: List[_Request] = []
+        for r in reqs:
+            r.attempts += 1
+            if action == "fatal" or floor_hit or r.attempts >= limit:
+                doomed.append(r)
+            else:
+                alive.append(r)
+        if doomed:
+            logger.error(
+                f"serving dispatch failed permanently "
+                f"({type(e).__name__}: {e}); failing {len(doomed)} "
+                "request(s)"
+            )
+            for r in doomed:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        if not alive:
+            return
+        RETRIES.inc(label="serving_dispatch", action=action)
+        event(
+            "retry[serving_dispatch]",
+            detail=f"action={action} requeued={len(alive)}",
+            log=logger,
+        )
+        self._requeue_front(alive)
+        # a repair that fails (a re-pin that no longer fits the degraded
+        # mesh, a probe error) must not unwind past the requeue: the
+        # requests are back in the queue, the next dispatch surfaces the
+        # same failure, and the attempt budget converges to give_up
+        try:
+            if action == "oom":
+                # resident datasets are re-creatable pressure; the pinned
+                # models are the serving working set and stay
+                from ..parallel.device_cache import clear_device_cache
+
+                clear_device_cache()
+                cap = self._shrunk_cap or max(
+                    1, int(get_config("serving_max_batch_rows"))
+                )
+                self._shrunk_cap = max(self._oom_floor(), cap // 2)
+                self._clean_batches = 0
+                logger.warning(
+                    "serving dispatch exhausted device memory; coalescing "
+                    f"cap shrunk to {self._shrunk_cap} rows"
+                )
+            elif action == "device_loss":
+                from ..resilience.elastic import recover_from_device_loss
+
+                if recover_from_device_loss(logger):
+                    # the shrunken mesh is live: every resident model
+                    # re-replicates onto the survivors and the queue
+                    # drains there — no request is lost to the dead chip
+                    self.registry.repin_all("device_loss")
+                logger.warning(
+                    "serving dispatch lost a device; queue drains on the "
+                    "current mesh"
+                )
+            elif action == "preemption":
+                from ..resilience.retry import _default_preemption_hook
+
+                _default_preemption_hook()
+            else:  # transient
+                attempt = max((r.attempts for r in alive), default=1)
+                time.sleep(policy.backoff(attempt))
+        except Exception as re_err:
+            logger.error(
+                f"serving {action} repair failed ({type(re_err).__name__}: "
+                f"{re_err}); requests stay queued for the next attempt"
+            )
+
+
+class ServingClient:
+    """The in-process client surface: `transform` blocks, `submit`
+    returns a Future.  Exists so call sites talk to a stable client API
+    whether the server is in-process or fronted by the HTTP endpoint
+    (serving/http.py speaks the same request shape)."""
+
+    def __init__(self, server: ServingServer) -> None:
+        self._server = server
+
+    def submit(self, model: str, X: Any) -> Future:
+        return self._server.submit(model, X)
+
+    def transform(self, model: str, X: Any,
+                  timeout: Optional[float] = None) -> Any:
+        """Transform rows; a single-output model returns the bare array
+        (matching `Model.transform`'s array-input contract), multi-output
+        models return `{col: array}`."""
+        outs = self._server.transform(model, X, timeout=timeout)
+        if len(outs) == 1:
+            return next(iter(outs.values()))
+        return outs
+
+    def models(self) -> List[str]:
+        return self._server.registry.names()
+
+
+__all__ = ["ServingClient", "ServingOverload", "ServingServer"]

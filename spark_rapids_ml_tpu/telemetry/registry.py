@@ -107,6 +107,33 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "fit_duration_seconds": {
         "kind": "histogram", "labels": ("estimator",), "cardinality": 32,
     },
+    # serving layer (serving/): request latency split by phase, batch
+    # coalescing sizes, admission-control rejections, and model-pin
+    # lifecycle.  Labels stay enumerable: model names are
+    # operator-chosen registry keys, phases/reasons/events are fixed
+    # vocabularies.
+    "serving_request_latency_seconds": {
+        "kind": "histogram", "labels": ("model", "phase"),
+        "cardinality": 96,
+    },
+    "serving_batch_rows": {
+        "kind": "histogram", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_requests_total": {
+        "kind": "counter", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_rejections_total": {
+        "kind": "counter", "labels": ("model", "reason"), "cardinality": 64,
+    },
+    "serving_pins_total": {
+        "kind": "counter", "labels": ("model", "event"), "cardinality": 96,
+    },
+    "serving_pinned_models": {
+        "kind": "gauge", "labels": (), "cardinality": 1,
+    },
+    "serving_pinned_bytes": {
+        "kind": "gauge", "labels": (), "cardinality": 1,
+    },
     # legacy dict-view families (gauges labeled by `key`)
     "staging_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "staging_counts": {"kind": "view", "labels": ("key",), "cardinality": 32},

@@ -89,6 +89,56 @@ def test_stage_parity_1d_labels_f64(num_workers, force_pipelined):
     assert np.array_equal(serial, _host(st.stage(y, np.float64)))
 
 
+@pytest.mark.parametrize("n,d,src_dt,out_dt", [
+    (1, 8, np.float32, np.float32),       # one serving request row
+    (13, 8, np.float64, np.float32),      # cast fused into the slice
+    (500, 16, np.float32, np.float32),    # bucketed + interleaved
+    (300, 6, np.float64, np.float64),     # f64 end-to-end
+])
+def test_small_direct_parity(n, d, src_dt, out_dt, num_workers):
+    """The small-batch direct fast path (`_stage_small_direct` — per-
+    device slices + one device_put per shard, no padded host copy, no
+    jitted update programs) is byte-identical to the serial path for
+    both layouts; `staging_small_direct=off` restores the legacy path.
+    The serving layer's micro-batches depend on this gate."""
+    rng = np.random.default_rng(n * d)
+    X = rng.standard_normal((n, d)).astype(src_dt)
+    m = get_mesh(num_workers)
+    for interleave in (None, False):
+        st = RowStager(n, m, interleave=interleave)
+        assert X.nbytes < mesh_mod._PIPELINED_MIN_BYTES  # gate actually hit
+        serial = _host(st._stage_serial(X, np.dtype(out_dt)))
+        direct = st._stage_small_direct(
+            X, np.dtype(out_dt),
+            mesh_mod.NamedSharding(m, mesh_mod.data_pspec(2)),
+            _writer_devices(
+                mesh_mod.NamedSharding(m, mesh_mod.data_pspec(2)),
+                (st.local_padded, d),
+            ),
+        )
+        assert np.array_equal(serial, _host(direct))
+        # the production gate routes stage() through the fast path...
+        staged = st.stage(X, out_dt)
+        assert np.array_equal(serial, _host(staged))
+        assert np.array_equal(st.fetch(staged), X.astype(out_dt)[:n])
+        # ...and the conf turns it back off (parity must hold regardless)
+        set_config(staging_small_direct=False)
+        try:
+            assert np.array_equal(serial, _host(st.stage(X, out_dt)))
+        finally:
+            set_config(staging_small_direct=True)
+
+
+def test_small_direct_1d_mask_parity(num_workers):
+    """1-D companions (masks/labels/fold ids) take the fast path too."""
+    rng = np.random.default_rng(3)
+    y = rng.standard_normal(700)
+    m = get_mesh(num_workers)
+    st = RowStager(700, m)
+    serial = _host(st._stage_serial(y, np.dtype(np.float32)))
+    assert np.array_equal(serial, _host(st.stage(y, np.float32)))
+
+
 def test_depth_one_serial_fallback(force_pipelined):
     """staging_pipeline_depth=1 runs the engine without the producer
     thread — identical bytes, no overlap accounting."""
