@@ -35,6 +35,7 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("cagra_", "ann"),
     ("knn_", "knn"),
     ("dbscan_", "dbscan"),
+    ("epoch_cache_", "epoch_cache"),
     ("fused_", "fused_pca"),
     ("kmeans_", "kmeans"),
     ("logreg_", "logreg"),

@@ -150,6 +150,34 @@ def _retrace(real_jax: Any, fn: Any, kw: dict, args: tuple, kwargs: dict):
     return real_jax.make_jaxpr(target, **mj_kw)(*args, **dyn_kw)
 
 
+class _AuditedJit:
+    """Callable standing in for a `PjitFunction` created inside an
+    audit block: first call runs the audit, later calls pass straight
+    through.  Unknown attributes DELEGATE to the real jitted function —
+    a module first imported inside an audit block (the audited fit's
+    own lazy imports) keeps this wrapper for the life of the process,
+    so the PjitFunction surface (`_cache_size`, `clear_cache`,
+    `lower`, …) must keep working on it."""
+
+    def __init__(self, fn, jitted, on_first) -> None:
+        self._fn = fn
+        self._jitted = jitted
+        self._on_first = on_first
+        self._first = True
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        if self._first:
+            self._first = False
+            return self._on_first(args, kwargs)
+        return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, name: str) -> Any:
+        if name in ("_fn", "_jitted", "_on_first", "_first"):
+            raise AttributeError(name)  # never recurse mid-__init__
+        return getattr(self._jitted, name)
+
+
 def _make_auditing_jit(real_jax: Any, real_jit: Any,
                        prefixes: Optional[Tuple[str, ...]],
                        report: JitAuditReport) -> Any:
@@ -168,37 +196,32 @@ def _make_auditing_jit(real_jax: Any, real_jit: Any,
             getattr(fn, "__name__", repr(fn)),
             donate_argnums=tuple(donate),
         )
-        state = {"first": True}
 
-        @functools.wraps(fn)
-        def wrapper(*args: Any, **kwargs: Any) -> Any:
-            first, state["first"] = state["first"], False
-            if first:
-                report.records.append(rec)
-                try:
-                    closed = _retrace(real_jax, fn, kw, args, kwargs)
-                    rec.const_bytes = _const_bytes(closed.consts)
-                except Exception as e:  # surfaced via violations()
-                    rec.error = f"{type(e).__name__}: {e}"
-                donated = [
-                    leaf
-                    for i in donate if i < len(args)
-                    # a donated arg may be a PYTREE (the fused
-                    # accumulator tuples); host arrays (no is_deleted)
-                    # are consumed by the implicit device_put and are
-                    # not checkable
-                    for leaf in real_jax.tree_util.tree_leaves(args[i])
-                    if hasattr(leaf, "is_deleted")
-                ]
-                out = jitted(*args, **kwargs)
-                if donated:
-                    rec.donated_consumed = all(
-                        a.is_deleted() for a in donated
-                    )
-                return out
-            return jitted(*args, **kwargs)
+        def first_call(args: tuple, kwargs: dict) -> Any:
+            report.records.append(rec)
+            try:
+                closed = _retrace(real_jax, fn, kw, args, kwargs)
+                rec.const_bytes = _const_bytes(closed.consts)
+            except Exception as e:  # surfaced via violations()
+                rec.error = f"{type(e).__name__}: {e}"
+            donated = [
+                leaf
+                for i in donate if i < len(args)
+                # a donated arg may be a PYTREE (the fused
+                # accumulator tuples); host arrays (no is_deleted)
+                # are consumed by the implicit device_put and are
+                # not checkable
+                for leaf in real_jax.tree_util.tree_leaves(args[i])
+                if hasattr(leaf, "is_deleted")
+            ]
+            out = jitted(*args, **kwargs)
+            if donated:
+                rec.donated_consumed = all(
+                    a.is_deleted() for a in donated
+                )
+            return out
 
-        return wrapper
+        return _AuditedJit(fn, jitted, first_call)
 
     return auditing_jit
 

@@ -76,6 +76,42 @@ _DEFAULTS: Dict[str, Any] = {
     # device consumes the current one (streaming.iter_chunks_prefetch);
     # costs one extra chunk of host memory.
     "streaming_prefetch": True,
+    # How far the streaming prefetch thread may run ahead of the
+    # consumer (streaming.iter_chunks_prefetch): a bounded queue of
+    # depth-2 owned chunks plus the one in the reader's hand.  Each
+    # extra level costs one chunk of host memory; 1 disables the thread
+    # (serial decode).
+    "streaming_prefetch_depth": 3,
+    # Chunk cache (parallel/device_cache.py ChunkCache): "on" records
+    # the DECODED fixed-shape chunks of a parquet scan the first time it
+    # runs and replays them for every later identical scan — epoch 1
+    # pays parquet once, epochs 2..n stream from memory.  Chunks sit
+    # device-resident while free headroom under the shared device-budget
+    # ledger allows, host-resident under `chunk_cache_host_bytes`, and
+    # spill LRU-compressed (`chunk_cache_codec`) beyond that.  "off"
+    # restores re-read-every-epoch.
+    "chunk_cache": "on",
+    # Host-memory budget (bytes) for the chunk cache's host + spill
+    # tiers; LRU chunks spill (compressed, checksummed) and then whole
+    # LRU streams evict beyond it.
+    "chunk_cache_host_bytes": 1024 * 1024 * 1024,
+    # Spill codec for the chunk cache (parallel/chunk_codec.py):
+    # "none" (raw bytes, zero CPU), "zlib" (stdlib), or "lz4"/"zstd"
+    # where the optional wheels exist; custom codecs register via
+    # chunk_codec.register_codec.  Every spilled blob is crc32-
+    # checksummed regardless of codec.
+    "chunk_cache_codec": "none",
+    # DuHL-style importance sampling of cached chunks for the
+    # epoch-streaming solvers (streaming.py logreg/kmeans): "duhl" lets
+    # an epoch revisit only the chunks whose contribution to the
+    # solver's own statistics is still moving (per-chunk scores,
+    # stale-contribution compensation, age-forced refresh), once the
+    # chunk cache holds the full stream; "off" (default) keeps exact
+    # full passes — bit-identical to the pre-cache trajectories.
+    "streaming_chunk_sampling": "off",
+    # Fraction of cached chunks a sampled epoch revisits (the rest
+    # contribute their last-computed statistics).  Clamped to [0.1, 1].
+    "streaming_chunk_sample_fraction": 0.5,
     # Pipelined per-device staging engine (parallel/mesh.py): host rows
     # are sliced per DEVICE SHARD and assembled with
     # jax.make_array_from_single_device_arrays, so each byte travels to
@@ -173,17 +209,18 @@ _DEFAULTS: Dict[str, Any] = {
     # sparse/ELL staging, multi-process, DeviceDataset inputs already on
     # device) always keep the two-phase path.
     "fused_stage_solve": "auto",
-    # Parallel parquet range-readers for the FUSED producer (fused.py
-    # iter_parquet_chunks): each reader decodes ONLY its row-group share
-    # of a single parquet file, so a scan with idle time (real IO,
-    # multi-core hosts) parallelizes.  Legal only on the fused path —
-    # chunks arrive in arbitrary order, which the commutative statistics
-    # sums tolerate but positional staging cannot.  Default 1 (single
-    # in-order pruned reader): the 1-core CI box measured the warm Arrow
-    # scan CPU-bound (readers=2 == readers=1 on a pruned scan, and the
-    # naive scan-and-skip variant was 2-4x WORSE); raise it on real
-    # multi-core ingest hosts.
-    "fused_parquet_readers": 1,
+    # Parallel parquet range-readers (fused.py iter_parquet_chunks and
+    # the offset-carrying staging variant streaming.stage_parquet now
+    # also consumes): each reader decodes ONLY its row-group share of a
+    # single parquet file.  "auto" (default) probes the host —
+    # os.cpu_count() clamped by the file's row-group count and by the
+    # measured single-reader decode rate when one is on record
+    # (fused.resolve_parquet_readers; the decision lands in the fit
+    # report's solver_decision section) — so multi-core ingest hosts
+    # parallelize and the 1-core CI box keeps resolving to 1 (where the
+    # warm Arrow scan measured CPU-bound: readers=2 == readers=1).
+    # Explicit ints still pin the count.
+    "fused_parquet_readers": "auto",
     # PCA eigensolver (ops/pca.py): "full" = exact d x d covariance +
     # eigh (cuML PCAMG parity, O(n d^2)); "randomized" = Halko
     # randomized range-finder (O(n d l), l = k + pca_oversamples) —

@@ -28,7 +28,10 @@ from .context import (  # noqa: F401
     shutdown_distributed,
 )
 from .device_cache import (  # noqa: F401
+    ChunkCache,
     DeviceDatasetCache,
+    clear_chunk_cache,
     clear_device_cache,
+    get_chunk_cache,
     get_device_cache,
 )

@@ -1,0 +1,117 @@
+#
+# Spill codecs for the chunk cache (parallel/device_cache.py
+# `ChunkCache`) — the compressed host tier of the Snap ML-style memory
+# hierarchy: decoded chunks evicted from device/host residency are
+# serialized through one of these codecs before they land in the spill
+# tier, and every spilled buffer carries a crc32 of its RAW bytes so a
+# torn or bit-rotted blob is detected at re-serve time instead of
+# silently corrupting an epoch.
+#
+# The registry is pluggable: `register_codec` accepts any
+# (compress, decompress) pair operating on bytes.  `lz4` / `zstd` are
+# registered lazily and only resolve where the optional wheels exist
+# (the CI image bakes neither — `zlib` is the stdlib-always-available
+# compressed option, `none` the zero-cost raw option).  Deliberately
+# numpy/jax-free: resolving a codec must never pay an accelerator
+# import.
+#
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Callable, Dict, Tuple
+
+Compress = Callable[[bytes], bytes]
+Decompress = Callable[[bytes], bytes]
+
+_lock = threading.Lock()
+
+
+def _zlib_pair() -> Tuple[Compress, Decompress]:
+    # level 1: the spill path sits on the epoch hot loop — favor speed
+    # (decoded float chunks rarely reward higher levels anyway)
+    return (lambda b: zlib.compress(b, 1)), zlib.decompress
+
+
+def _none_pair() -> Tuple[Compress, Decompress]:
+    return (lambda b: b), (lambda b: b)
+
+
+def _lz4_pair() -> Tuple[Compress, Decompress]:
+    import lz4.frame  # gated: optional wheel
+
+    return lz4.frame.compress, lz4.frame.decompress
+
+
+def _zstd_pair() -> Tuple[Compress, Decompress]:
+    import zstandard  # gated: optional wheel
+
+    c = zstandard.ZstdCompressor(level=1)
+    d = zstandard.ZstdDecompressor()
+    return c.compress, d.decompress
+
+
+# name -> zero-arg factory returning (compress, decompress); factories
+# defer optional imports to resolve time
+_FACTORIES: Dict[str, Callable[[], Tuple[Compress, Decompress]]] = {
+    "none": _none_pair,
+    "zlib": _zlib_pair,
+    "lz4": _lz4_pair,
+    "zstd": _zstd_pair,
+}
+
+
+def register_codec(name: str, compress: Compress, decompress: Decompress) -> None:
+    """Plug in a custom spill codec under `name` (overrides builtins)."""
+    with _lock:
+        _FACTORIES[str(name)] = lambda: (compress, decompress)
+
+
+def available_codecs() -> Tuple[str, ...]:
+    with _lock:
+        names = tuple(sorted(_FACTORIES))
+    out = []
+    for n in names:
+        try:
+            resolve_codec(n)
+        except (ImportError, ValueError):
+            continue
+        out.append(n)
+    return tuple(out)
+
+
+def resolve_codec(name: str) -> Tuple[str, Compress, Decompress]:
+    """(name, compress, decompress) for a registered codec.  Raises
+    ValueError for an unknown name and ImportError when the codec's
+    optional dependency is absent from the image (the caller surfaces
+    the conf fix; nothing is pip-installed on its behalf)."""
+    name = str(name).lower()
+    with _lock:
+        factory = _FACTORIES.get(name)
+    if factory is None:
+        raise ValueError(
+            f"unknown chunk_cache_codec {name!r}; registered: "
+            f"{', '.join(sorted(_FACTORIES))}"
+        )
+    try:
+        compress, decompress = factory()
+    except ImportError as e:
+        raise ImportError(
+            f"chunk_cache_codec={name!r} needs an optional dependency "
+            f"this image lacks ({e}); use 'zlib' (stdlib) or 'none'"
+        ) from e
+    return name, compress, decompress
+
+
+def checksum(data: bytes) -> int:
+    """crc32 over the RAW (uncompressed) chunk bytes — verified on every
+    re-serve from the spill tier."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+__all__ = [
+    "available_codecs",
+    "checksum",
+    "register_codec",
+    "resolve_codec",
+]

@@ -561,7 +561,9 @@ class DeviceDatasetCache:
         self._sync_metrics()
         return True
 
-    def reserve_external(self, tag: str, need_bytes: int) -> bool:
+    def reserve_external(
+        self, tag: str, need_bytes: int, evict: bool = True
+    ) -> bool:
         """Book `need_bytes` of budget-accounted residency for a
         non-dataset consumer (keyed by `tag`; a repeat reservation for
         the same tag REPLACES the old claim), LRU-evicting dataset
@@ -572,7 +574,12 @@ class DeviceDatasetCache:
         pins and retries.  External claims are visible to every budget
         comparison (`claimed_bytes`, hence `cache_resident_bytes()` and
         core's `_over_device_budget`) but are never evicted from this
-        side — only `release_external` drops them."""
+        side — only `release_external` drops them.
+
+        `evict=False` claims only FREE headroom: the chunk cache's
+        device tier is opportunistic residency (re-creatable from its
+        own host/spill copies), so it must never push a dataset entry
+        or make a later staging decision degrade on its behalf."""
         budget = cache_budget_bytes()
         need_bytes = int(need_bytes)
         with self._mu:
@@ -580,7 +587,7 @@ class DeviceDatasetCache:
             extra = need_bytes - old
             if extra > budget:
                 return False
-            while self.claimed_bytes() + extra > budget:
+            while evict and self.claimed_bytes() + extra > budget:
                 if not self._evict_lru():
                     break
             if self.claimed_bytes() + extra > budget:
@@ -674,11 +681,15 @@ def invalidate_for_devices(ids) -> int:
     an entry sharded over a lost device is unreadable, so its registry
     claim is dropped and the next consumer re-stages onto the shrunken
     mesh through the pipelined engine (a cache MISS — the new mesh's
-    device set keys a different fingerprint anyway).  Returns the number
-    of entries invalidated."""
+    device set keys a different fingerprint anyway).  The chunk cache's
+    device tier invalidates on the same signal (host-spilled chunks
+    survive — `ChunkCache.invalidate_devices`).  Returns the number of
+    dataset entries invalidated."""
+    ids = {int(i) for i in ids}
+    if _chunk_cache is not None:
+        _chunk_cache.invalidate_devices(ids)
     if _global_cache is None:
         return 0
-    ids = {int(i) for i in ids}
     cache = _global_cache
     with cache._mu:
         doomed = [
@@ -816,18 +827,665 @@ def get_or_stage(
     return entry
 
 
+# ---------------------------------------------------------------------------
+# Chunk-granularity cache — the out-of-core EPOCH engine's fast tier.
+#
+# The dataset cache above holds whole staged datasets; the epoch-
+# streaming solvers (streaming.py mechanism B/C) never stage — they
+# re-read and re-decode the same parquet once per L-BFGS evaluation /
+# Lloyd pass, and the decode is the measured bottleneck of every
+# beyond-HBM fit (BENCH ingest_rows_per_sec caps the epoch rate).  The
+# ChunkCache records the DECODED fixed-shape chunks of a scan the first
+# time it runs (epoch 1) and replays them for every later identical
+# scan (epochs 2..n), so only epoch 1 pays parquet.  Snap ML's
+# hierarchical host/accelerator split (PAPERS.md) is the template:
+#
+#   device tier   the chunk's feature block lives on-device (jax array)
+#                 while free headroom under the SAME budget ledger the
+#                 dataset cache and serving pins use allows
+#                 (`reserve_external(evict=False)` — opportunistic
+#                 residency may never displace a dataset entry);
+#   host tier     decoded numpy arrays (the pinned-host stand-in on the
+#                 CPU mesh), bounded by `chunk_cache_host_bytes`;
+#   spill tier    LRU chunks compressed through a pluggable codec
+#                 (parallel/chunk_codec.py: none/zlib, lz4/zstd where
+#                 the wheels exist) and crc32-checksummed — a corrupt
+#                 blob is detected at re-serve and the stream falls
+#                 back to the parquet source instead of corrupting an
+#                 epoch.
+#
+# Streams are keyed by the caller (path content stamp + scan
+# parameters); chunks are stored as the exact tuples the source
+# iterator yielded (ndarray elements read-only, scalars verbatim), so
+# replay is byte-identical.  `select` serves only the chunk positions
+# an importance-sampling epoch asks for — skipped chunks never
+# decompress or transfer (the DuHL win, streaming.py).
+# ---------------------------------------------------------------------------
+
+CHUNK_METRICS = _dict_view(
+    "chunk_cache",
+    "Chunk cache counters (hits/misses/spills/restores/bytes by tier)",
+    initial={
+        "hits": 0,
+        "misses": 0,
+        "inserts": 0,
+        "spills": 0,
+        "restores": 0,
+        "evictions": 0,
+        "invalidations": 0,
+        "checksum_failures": 0,
+        "hit_bytes": 0,
+        "host_bytes": 0,
+        "spilled_bytes": 0,
+        "device_bytes": 0,
+        "streams_complete": 0,
+    },
+)
+
+_CHUNK_TAG = "chunk_cache"
+
+
+class ChunkIntegrityError(RuntimeError):
+    """A spilled chunk's crc32 did not match at re-serve time."""
+
+
+def chunk_cache_enabled() -> bool:
+    from ..config import get_config
+
+    return str(get_config("chunk_cache")).lower() == "on"
+
+
+def chunk_cache_host_budget() -> int:
+    from ..config import get_config
+
+    return int(get_config("chunk_cache_host_bytes"))
+
+
+def _chunk_note(kind: str, amount: int = 1) -> None:
+    with _lock:
+        CHUNK_METRICS.bump(kind, amount)
+
+
+class _SpilledArray:
+    """One ndarray serialized into the spill tier."""
+
+    __slots__ = ("codec", "blob", "dtype_str", "shape", "crc", "raw_nbytes")
+
+    def __init__(self, codec, blob, dtype_str, shape, crc, raw_nbytes):
+        self.codec = codec
+        self.blob = blob
+        self.dtype_str = dtype_str
+        self.shape = shape
+        self.crc = crc
+        self.raw_nbytes = int(raw_nbytes)
+
+
+class _ChunkArray:
+    """One ndarray element of a cached chunk: host (numpy) and/or
+    device (jax array — a MIRROR of the host copy, feature blocks
+    only), or spilled (codec blob + checksum).  The device tier caches
+    the host tier rather than replacing it: device consumers skip the
+    H2D put every epoch while host consumers (staging writers, host
+    moment scans, pure replays) keep zero-copy serves — and a device
+    loss costs only the mirror, never the data."""
+
+    __slots__ = ("host", "dev", "spill")
+
+    def __init__(self, host) -> None:
+        self.host = host
+        self.dev = None
+        self.spill = None
+
+    def host_nbytes(self) -> int:
+        return int(self.host.nbytes) if self.host is not None else 0
+
+    def spill_nbytes(self) -> int:
+        return len(self.spill.blob) if self.spill is not None else 0
+
+    def dev_nbytes(self) -> int:
+        return int(self.dev.nbytes) if self.dev is not None else 0
+
+
+class CachedChunk:
+    """One yielded tuple of a cached stream: `layout` interleaves
+    ("v", scalar-or-None) pass-through elements with ("a", _ChunkArray)
+    array elements, preserving tuple order exactly."""
+
+    __slots__ = ("layout", "last_used")
+
+    def __init__(self, layout) -> None:
+        self.layout = layout
+        self.last_used = 0
+
+    def arrays(self):
+        return [v for kind, v in self.layout if kind == "a"]
+
+
+class _ChunkStream:
+    __slots__ = ("key", "chunks", "complete", "dropped", "serving")
+
+    def __init__(self, key) -> None:
+        self.key = key
+        self.chunks: List[CachedChunk] = []
+        self.complete = False
+        self.dropped = False
+        self.serving = 0  # active serve iterations (eviction pin)
+
+
+class ChunkCache:
+    """Registry of cached chunk streams with tiered residency.  All
+    registry state is guarded by `_mu`; the dataset cache's lock is
+    only ever taken AFTER `_mu` (via the external-reservation ledger),
+    never the other way, so the two cannot deadlock.  Tier byte totals
+    are maintained INCREMENTALLY on every transition (a rescan of all
+    cached arrays per insert would be O(total_chunks^2) per epoch under
+    the lock at small-chunk configurations)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._streams: Dict[Any, _ChunkStream] = {}
+        self._clock = 0
+        self._host_b = 0  # host-resident array bytes
+        self._spill_b = 0  # compressed spill blob bytes
+        self._dev_total = 0  # bytes booked under _CHUNK_TAG
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def _host_total(self) -> int:
+        """Bytes counted against `chunk_cache_host_bytes` (host + spill)."""
+        return self._host_b + self._spill_b
+
+    def _touch_locked(self, chunk: CachedChunk) -> None:
+        self._clock += 1
+        chunk.last_used = self._clock
+
+    def _account_locked(self, host_delta: int = 0, spill_delta: int = 0) -> None:
+        self._host_b = max(0, self._host_b + int(host_delta))
+        self._spill_b = max(0, self._spill_b + int(spill_delta))
+        self._sync_bytes_locked()
+
+    def _sync_bytes_locked(self) -> None:
+        with _lock:
+            CHUNK_METRICS["host_bytes"] = self._host_b
+            CHUNK_METRICS["spilled_bytes"] = self._spill_b
+            CHUNK_METRICS["device_bytes"] = self._dev_total
+
+    def _book_dev_locked(self, delta: int) -> bool:
+        """Grow/shrink the chunk cache's claim in the device-budget
+        ledger (the same one serving pins and dataset residency use).
+        Growth claims FREE headroom only (`evict=False`)."""
+        new = self._dev_total + int(delta)
+        ledger = get_device_cache()
+        if delta > 0:
+            if not ledger.reserve_external(_CHUNK_TAG, new, evict=False):
+                return False
+        elif new <= 0:
+            ledger.release_external(_CHUNK_TAG)
+            new = 0
+        else:
+            ledger.reserve_external(_CHUNK_TAG, new)  # shrink always fits
+        self._dev_total = new
+        return True
+
+    # -- tier transitions ----------------------------------------------------
+
+    def _spill_chunk_locked(self, chunk: CachedChunk) -> None:
+        """Move every array of `chunk` into the spill tier (compress +
+        checksum).  The `chunk_cache_spill` fault site fires here: an
+        injected fault propagates into the consuming epoch iteration,
+        whose fit-level retry restarts the pass with fresh accumulators
+        (re-creatable state — chunks can never double-count)."""
+        from ..config import get_config
+        from ..resilience import maybe_inject
+        from .chunk_codec import checksum, resolve_codec
+
+        maybe_inject("chunk_cache_spill")
+        name, compress, _ = resolve_codec(get_config("chunk_cache_codec"))
+        freed_dev = 0
+        host_delta = 0
+        spill_delta = 0
+        for a in chunk.arrays():
+            if a.spill is not None:
+                continue
+            arr = a.host if a.host is not None else np.asarray(a.dev)
+            arr = np.ascontiguousarray(arr)
+            raw = arr.tobytes()
+            a.spill = _SpilledArray(
+                name, compress(raw), arr.dtype.str, arr.shape,
+                checksum(raw), len(raw),
+            )
+            spill_delta += len(a.spill.blob)
+            if a.dev is not None:
+                freed_dev += a.dev_nbytes()
+                a.dev = None
+            host_delta -= a.host_nbytes()
+            a.host = None
+        if freed_dev:
+            self._book_dev_locked(-freed_dev)
+        self._account_locked(host_delta, spill_delta)
+        _chunk_note("spills")
+        from ..tracing import event
+
+        event("chunk_cache_spill", detail=f"codec={name}")
+
+    def _restore_array_locked(self, a: _ChunkArray) -> np.ndarray:
+        """Spill blob -> read-only ndarray, crc-verified.  The restored
+        view is NOT re-warmed into the host tier: a working set larger
+        than the host budget would otherwise thrash (restore chunk i,
+        spill chunk j, every epoch)."""
+        from .chunk_codec import checksum, resolve_codec
+
+        sp = a.spill
+        _, _, decompress = resolve_codec(sp.codec)
+        try:
+            raw = decompress(sp.blob)
+        except Exception as e:
+            # a torn blob can fail the codec before the crc ever runs —
+            # same integrity verdict either way
+            _chunk_note("checksum_failures")
+            raise ChunkIntegrityError(
+                f"spilled chunk failed to decompress (codec={sp.codec}): "
+                f"{e}"
+            ) from e
+        if checksum(raw) != sp.crc:
+            _chunk_note("checksum_failures")
+            raise ChunkIntegrityError(
+                f"spilled chunk failed crc32 (codec={sp.codec}, "
+                f"{len(raw)} bytes)"
+            )
+        _chunk_note("restores")
+        return np.frombuffer(raw, dtype=np.dtype(sp.dtype_str)).reshape(
+            sp.shape
+        )
+
+    def _drop_stream_locked(self, st: _ChunkStream, reason: str) -> None:
+        if st.dropped:
+            return
+        st.dropped = True
+        freed_dev = host_delta = spill_delta = 0
+        for c in st.chunks:
+            for a in c.arrays():
+                freed_dev += a.dev_nbytes()
+                host_delta -= a.host_nbytes()
+                spill_delta -= a.spill_nbytes()
+        st.chunks = []
+        self._streams.pop(st.key, None)
+        if freed_dev:
+            self._book_dev_locked(-freed_dev)
+        self._account_locked(host_delta, spill_delta)
+        _chunk_note("evictions")
+        from ..tracing import event
+
+        event("chunk_cache_evict", detail=reason)
+
+    def _shrink_locked(self, protect: Optional[_ChunkStream]) -> None:
+        """Enforce the host budget: spill LRU chunks first (compression
+        may shrink them), then evict LRU streams outright.  `protect`
+        is the stream currently FILLING — evicted only as the last
+        resort (a single stream larger than the whole budget)."""
+        budget = chunk_cache_host_budget()
+        spills_help = True  # flips off when the codec frees nothing
+        while self._host_total > budget:
+            victim = None
+            if spills_help:
+                # host-resident chunks only: device-tier chunks cost no
+                # host bytes, and spilling one would GROW the host total
+                for st in self._streams.values():
+                    for c in st.chunks:
+                        if any(a.host is not None for a in c.arrays()):
+                            if (victim is None
+                                    or c.last_used < victim.last_used):
+                                victim = c
+            if victim is not None:
+                before = self._host_total
+                self._spill_chunk_locked(victim)
+                if self._host_total < before:
+                    continue
+                # codec="none" spills byte-for-byte: stop burning CPU
+                # on no-gain spills and move straight to eviction
+                spills_help = False
+            # nothing (usefully) spillable left: drop whole LRU streams.
+            # Streams with an ACTIVE serve iteration are pinned — an
+            # eviction mid-serve would force the position-based source
+            # fallback, which is only sound for in-order sources
+            streams = [
+                s for s in self._streams.values()
+                if s is not protect and s.serving == 0
+            ]
+            if not streams and protect is not None and protect.serving == 0:
+                streams = [protect]
+            if not streams:
+                return  # everything pinned: transiently over budget
+            lru = min(
+                streams,
+                key=lambda s: min(
+                    (c.last_used for c in s.chunks), default=0
+                ),
+            )
+            self._drop_stream_locked(lru, "host_budget")
+
+    # -- insert / serve ------------------------------------------------------
+
+    def _insert(self, st: _ChunkStream, item: tuple,
+                device_elem: Optional[int], serve_device: bool):
+        """Record one yielded tuple; returns the tuple to hand the
+        consumer (same host arrays, marked read-only — a mutating
+        consumer must fail loudly, not corrupt later epochs).  A
+        device-capable consumer receives the freshly created device
+        MIRROR for the promoted element: its own `device_put` of the
+        same bytes would double the fill epoch's H2D traffic."""
+        layout = []
+        served = []
+        host_bytes = 0
+        for part in item:
+            if isinstance(part, np.ndarray):
+                a = np.ascontiguousarray(part)
+                a.setflags(write=False)
+                layout.append(("a", _ChunkArray(a)))
+                served.append(a)
+                host_bytes += a.nbytes
+            else:
+                layout.append(("v", part))
+                served.append(part)
+        chunk = CachedChunk(tuple(layout))
+        with self._mu:
+            if st.dropped:
+                return tuple(served)
+            st.chunks.append(chunk)
+            self._touch_locked(chunk)
+            if device_elem is not None:
+                kind, ca = chunk.layout[device_elem]
+                if kind == "a" and self._book_dev_locked(ca.host_nbytes()):
+                    try:
+                        import jax
+
+                        ca.dev = jax.device_put(ca.host)
+                        if serve_device:
+                            served[device_elem] = ca.dev
+                    except Exception:
+                        # opportunistic residency must never fail the
+                        # consumer OR leak its booked claim: release
+                        # and keep serving from the host tier
+                        self._book_dev_locked(-ca.host_nbytes())
+            self._account_locked(host_delta=host_bytes)
+            self._shrink_locked(protect=st)
+        _chunk_note("inserts")
+        return tuple(served)
+
+    def _serve_chunk_locked(self, chunk: CachedChunk,
+                            serve_device: bool) -> tuple:
+        out = []
+        nbytes = 0
+        first_arr = True
+        for kind, v in chunk.layout:
+            if kind == "v":
+                out.append(v)
+                continue
+            if (
+                serve_device and first_arr and v.dev is None
+                and v.host is not None
+                and self._book_dev_locked(v.host_nbytes())
+            ):
+                # serve-time promotion: a stream first filled by a
+                # host-only consumer (label-moments scan, k-means
+                # seeding) mirrors its feature blocks on device the
+                # first time a device consumer replays it, while ledger
+                # headroom allows
+                try:
+                    import jax
+
+                    v.dev = jax.device_put(v.host)
+                except Exception:
+                    # failed mirror: release the booked claim and keep
+                    # serving host bytes — a device OOM here must
+                    # degrade, not abort the consuming epoch
+                    self._book_dev_locked(-v.host_nbytes())
+                self._sync_bytes_locked()
+            first_arr = False
+            if serve_device and v.dev is not None:
+                out.append(v.dev)
+                nbytes += v.dev_nbytes()
+            elif v.host is not None:
+                out.append(v.host)
+                nbytes += v.host_nbytes()
+            elif v.dev is not None:
+                out.append(np.asarray(v.dev))
+                nbytes += v.dev_nbytes()
+            else:
+                arr = self._restore_array_locked(v)
+                out.append(arr)
+                nbytes += arr.nbytes
+        self._touch_locked(chunk)
+        _chunk_note("hit_bytes", nbytes)
+        return tuple(out)
+
+    def stream_complete(self, key) -> Optional[int]:
+        """Chunk count of a fully cached stream, None otherwise — the
+        gate importance-sampling epochs check before selecting."""
+        with self._mu:
+            st = self._streams.get(key)
+            if st is not None and st.complete and not st.dropped:
+                return len(st.chunks)
+            return None
+
+    def stream(self, key, source_factory, device_elem: Optional[int] = None,
+               serve_device: bool = False, select=None,
+               ordered: bool = True):
+        """Serve the chunk stream for `key` from cache when complete,
+        else run `source_factory()` and record it in passing.  A stream
+        another iteration is still filling is bypassed (read the source
+        directly, cache untouched).  `select` (position set) filters
+        the served chunks; it only applies to fully cached streams —
+        callers gate on `stream_complete` first.  `ordered=False`
+        declares the SOURCE's chunk order nondeterministic (the fused
+        parallel reader pool): a mid-serve failure then cannot resume
+        from the source by position, so it raises instead of silently
+        mixing two orderings (actively-served streams are eviction-
+        pinned, making that path corruption-only)."""
+        with self._mu:
+            st = self._streams.get(key)
+            if st is not None and st.complete and not st.dropped:
+                mode = "serve"
+                st.serving += 1  # pins the stream against eviction
+            elif st is None:
+                st = _ChunkStream(key)
+                self._streams[key] = st
+                mode = "fill"
+            else:
+                mode = "bypass"
+        if mode == "bypass":
+            yield from _select_iter(source_factory(), select)
+            return
+        if mode == "serve":
+            _chunk_note("hits")
+            try:
+                yield from self._serve(
+                    st, source_factory, serve_device, select, ordered
+                )
+            finally:
+                with self._mu:
+                    st.serving = max(0, st.serving - 1)
+            return
+        _chunk_note("misses")
+        done = False
+        try:
+            for item in _select_iter(source_factory(), select):
+                try:
+                    out = self._insert(st, item, device_elem, serve_device)
+                except Exception:
+                    # insert failed (injected spill fault, codec error):
+                    # the cache must not keep a half-recorded stream —
+                    # the error itself propagates into the consuming
+                    # iteration (fit-level retry restarts the pass)
+                    with self._mu:
+                        self._drop_stream_locked(st, "insert_failed")
+                    raise
+                yield out
+            done = True
+        finally:
+            with self._mu:
+                if done and not st.dropped and select is None:
+                    st.complete = True
+                    _chunk_note("streams_complete")
+                else:
+                    self._drop_stream_locked(st, "abandoned")
+
+    def _serve(self, st: _ChunkStream, source_factory, serve_device: bool,
+               select, ordered: bool):
+        n = len(st.chunks)
+        pos = 0
+        while pos < n:
+            if select is not None and pos not in select:
+                pos += 1
+                continue
+            try:
+                with self._mu:
+                    if st.dropped or pos >= len(st.chunks):
+                        raise LookupError("chunk evicted mid-serve")
+                    item = self._serve_chunk_locked(
+                        st.chunks[pos], serve_device
+                    )
+            except (LookupError, ChunkIntegrityError, ImportError,
+                    ValueError) as e:
+                with self._mu:
+                    self._drop_stream_locked(st, "serve_fallback")
+                if not ordered:
+                    # the recorded order came from a nondeterministic
+                    # reader pool: position-resume against a fresh pool
+                    # run would double-count some chunks and drop
+                    # others.  Fail LOUDLY — the consuming pass's
+                    # accumulators are re-creatable and its fit-level
+                    # retry re-reads the (now uncached) source
+                    raise ChunkIntegrityError(
+                        "cached chunk unusable mid-serve of an "
+                        f"order-free stream ({e}); restart the pass"
+                    ) from e
+                # in-order source: drop the stream and finish from the
+                # parquet source at the same position — the consumer
+                # sees an uninterrupted, byte-identical stream
+                for i, fresh in enumerate(source_factory()):
+                    if i < pos:
+                        continue
+                    if select is None or i in select:
+                        yield fresh
+                return
+            yield item
+            pos += 1
+
+    # -- maintenance ---------------------------------------------------------
+
+    def invalidate_devices(self, ids) -> int:
+        """Drop the device tier for chunks resident on the given (lost)
+        device ids.  A chunk with a host/spill copy survives and keeps
+        serving; a device-only chunk is gone with its chip, so its
+        whole stream drops (the next scan is a miss that re-reads
+        parquet — exactly the dataset cache's recovery contract)."""
+        ids = {int(i) for i in ids}
+        n = 0
+        with self._mu:
+            for st in list(self._streams.values()):
+                doomed = False
+                for c in st.chunks:
+                    for a in c.arrays():
+                        if a.dev is None:
+                            continue
+                        try:
+                            on_lost = any(
+                                int(d.id) in ids for d in a.dev.devices()
+                            )
+                        except Exception:
+                            on_lost = True
+                        if not on_lost:
+                            continue
+                        self._book_dev_locked(-a.dev_nbytes())
+                        a.dev = None
+                        n += 1
+                        if a.host is None and a.spill is None:
+                            doomed = True
+                if doomed:
+                    self._drop_stream_locked(st, "device_lost")
+            self._sync_bytes_locked()
+        if n:
+            _chunk_note("invalidations", n)
+        return n
+
+    def clear(self) -> None:
+        with self._mu:
+            for st in list(self._streams.values()):
+                self._drop_stream_locked(st, "clear")
+
+
+def _select_iter(it, select):
+    if select is None:
+        yield from it
+        return
+    for i, item in enumerate(it):
+        if i in select:
+            yield item
+
+
+_chunk_cache: Optional[ChunkCache] = None
+
+
+def get_chunk_cache() -> ChunkCache:
+    global _chunk_cache
+    if _chunk_cache is None:
+        _chunk_cache = ChunkCache()
+    return _chunk_cache
+
+
+def clear_chunk_cache() -> None:
+    """Drop every cached chunk stream and release the device-ledger
+    claim (tests; explicit operator reset)."""
+    if _chunk_cache is not None:
+        _chunk_cache.clear()
+
+
+def cached_chunk_stream(key, source_factory, device_elem: Optional[int] = None,
+                        serve_device: bool = False, select=None,
+                        ordered: bool = True):
+    """The one consumer entry point: wrap a chunk iterator in the chunk
+    cache.  `key=None` (source not content-stampable) or
+    `chunk_cache=off` bypasses entirely.  `ordered=False` marks a
+    source whose chunk order is nondeterministic (see
+    `ChunkCache.stream`)."""
+    if key is None or not chunk_cache_enabled():
+        yield from _select_iter(source_factory(), select)
+        return
+    yield from get_chunk_cache().stream(
+        key, source_factory, device_elem=device_elem,
+        serve_device=serve_device, select=select, ordered=ordered,
+    )
+
+
+def chunk_stream_complete(key) -> Optional[int]:
+    if key is None or _chunk_cache is None or not chunk_cache_enabled():
+        return None
+    return _chunk_cache.stream_complete(key)
+
+
 __all__ = [
     "CACHE_METRICS",
+    "CHUNK_METRICS",
     "CacheEntry",
     "CachedEvalView",
+    "ChunkCache",
+    "ChunkIntegrityError",
     "DeviceDatasetCache",
     "FoldSet",
     "cache_budget_bytes",
     "cache_enabled",
     "cache_resident_bytes",
+    "cached_chunk_stream",
+    "chunk_cache_enabled",
+    "chunk_cache_host_budget",
+    "chunk_stream_complete",
+    "clear_chunk_cache",
     "clear_device_cache",
     "dataset_fingerprint",
     "device_data_budget_bytes",
+    "get_chunk_cache",
     "get_device_cache",
     "get_or_stage",
     "invalidate_for_devices",

@@ -408,6 +408,8 @@ class ShardedRowWriter:
     (`_writer_devices` decides eligibility)."""
 
     def __init__(self, shape, dtype, sharding=None) -> None:
+        import threading
+
         self.shape = tuple(int(x) for x in shape)
         self.dtype = np.dtype(dtype)
         ensure_x64(self.dtype)
@@ -429,6 +431,12 @@ class ShardedRowWriter:
         self.bytes_written = 0
         self.put_seconds = 0.0  # dispatch-side time (transfers are async)
         self.pieces = 0
+        # the parallel parquet range readers (streaming.stage_parquet)
+        # call write() from their own threads at disjoint row offsets;
+        # the lock protects the per-device buffer swap + metrics — the
+        # transfers themselves stay async and the donated single-device
+        # updates already serialize per device
+        self._mu = threading.Lock()
 
     @property
     def shard_rows(self) -> int:
@@ -452,7 +460,9 @@ class ShardedRowWriter:
             pos += take
 
     def write_shard(self, d: int, lo: int, rows: np.ndarray) -> None:
-        """Write host `rows` at offset `lo` WITHIN device `d`'s shard."""
+        """Write host `rows` at offset `lo` WITHIN device `d`'s shard.
+        Thread-safe: concurrent range readers writing disjoint offsets
+        serialize only the (fast) update dispatch."""
         import jax.numpy as jnp
 
         dev = self._devices[d]
@@ -463,10 +473,17 @@ class ShardedRowWriter:
         _, upd = _shard_update_fns(
             (self._s,) + self.shape[1:], self.dtype.str, dev
         )
-        self._bufs[d] = upd(self._bufs[d], pj, off)
-        self.put_seconds += time.perf_counter() - t0
-        self.bytes_written += piece.nbytes
-        self.pieces += 1
+        # prep+put timed OUTSIDE the lock, the update dispatch inside —
+        # put_seconds must never include another reader's lock hold, or
+        # N contending range readers would read as an Nx device-transfer
+        # bottleneck that is actually serialization
+        prep_s = time.perf_counter() - t0
+        with self._mu:
+            t1 = time.perf_counter()
+            self._bufs[d] = upd(self._bufs[d], pj, off)
+            self.put_seconds += prep_s + (time.perf_counter() - t1)
+            self.bytes_written += piece.nbytes
+            self.pieces += 1
 
     def finish(self) -> "jax.Array":
         if self.sharding is None:

@@ -53,6 +53,14 @@ KNOWN_SITES = frozenset({
     # every resident model on the shrunken mesh — no queued request is
     # lost either way
     "serving_dispatch",
+    # the chunk cache's spill-to-host compression step
+    # (parallel/device_cache.py ChunkCache._spill_chunk_locked): fires
+    # while an epoch iteration is inserting/evicting chunks mid-stream.
+    # The cache drops its half-recorded stream and the error propagates
+    # into the consuming fit, whose retry restarts the pass with FRESH
+    # accumulators — cached chunks are re-creatable state, so a retried
+    # epoch can never double-count (asserted by tests/test_chunk_cache.py)
+    "chunk_cache_spill",
 })
 
 # Injectable fault kinds (`_Fault` validates against this; the docs and

@@ -247,6 +247,57 @@ def _scan_columns(
     return columns
 
 
+def _chunk_stream_key(
+    path: str,
+    features_col,
+    features_cols,
+    label_col,
+    weight_col,
+    chunk_rows: int,
+    dtype,
+    row_range,
+    tag: str = "iter_chunks",
+):
+    """Chunk-cache stream key: the path's content stamp plus every scan
+    parameter that shapes the yielded chunks.  None (cache bypass) when
+    the path cannot be stat'd — a remote dataset rewritten in place must
+    never replay stale chunks."""
+    stamp = _path_stamp(path)
+    if stamp is None:
+        return None
+    return (
+        tag, path, stamp, features_col, tuple(features_cols or ()),
+        label_col, weight_col, int(chunk_rows), np.dtype(dtype).str,
+        row_range,
+    )
+
+
+def chunk_stream_key(
+    path, features_col, features_cols, label_col, weight_col,
+    chunk_rows, dtype, row_range=None,
+):
+    """Public form of the `iter_chunks` cache key (the epoch solvers use
+    it to ask `chunk_stream_complete` whether sampling may engage)."""
+    return _chunk_stream_key(
+        path, features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype, row_range,
+    )
+
+
+def _dev_chunk(c, dtype):
+    """Chunk feature block -> device array of `dtype`.  A cache-served
+    DEVICE-RESIDENT chunk passes straight through (no host round trip —
+    the device tier's whole point); host chunks take the usual
+    cast-and-put."""
+    import jax
+    import jax.numpy as jnp
+
+    want = np.dtype(dtype)
+    if isinstance(c, jax.Array):
+        return c if c.dtype == want else c.astype(want)
+    return jnp.asarray(np.asarray(c, want))
+
+
 def iter_chunks(
     path: str,
     features_col: Optional[str],
@@ -256,6 +307,9 @@ def iter_chunks(
     chunk_rows: int,
     dtype: np.dtype,
     row_range: Optional[Tuple[int, int]] = None,
+    device_ok: bool = False,
+    select_chunks=None,
+    cache_ok: bool = True,
 ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray], int]]:
     """Stream `(X, y, w, n_valid)` chunks of EXACTLY `chunk_rows` rows
     (zero-padded tail on the last chunk) — fixed shapes keep the device
@@ -264,15 +318,46 @@ def iter_chunks(
 
     Each yielded chunk owns its arrays (no buffer reuse): an exactly-full
     Arrow batch is yielded as a zero-copy reshape of the Arrow child
-    buffer; partial batches accumulate into a freshly allocated chunk."""
-    import pyarrow.dataset as ds
+    buffer; partial batches accumulate into a freshly allocated chunk.
 
-    columns = _scan_columns(features_col, features_cols, label_col, weight_col)
-    dataset = ds.dataset(path, format="parquet")
-    yield from chunks_from_batches(
-        dataset.to_batches(columns=columns, batch_size=chunk_rows),
-        features_col, features_cols, label_col, weight_col,
-        chunk_rows, dtype, row_range=row_range,
+    The stream runs through the chunk cache (`chunk_cache` conf,
+    parallel/device_cache.py): the first identical scan decodes parquet
+    and records the chunks (served arrays are READ-ONLY from then on);
+    later identical scans replay them byte-for-byte without touching
+    disk.  `device_ok=True` consumers (the epoch solvers, whose chunks
+    go straight into jitted device steps) may receive the feature block
+    as a device-resident jax array; everyone else always sees numpy.
+    `select_chunks` (a position set) replays only those chunks of a
+    fully cached stream — skipped chunks never decompress or transfer
+    (the DuHL sampling path).  `cache_ok=False` bypasses the cache
+    entirely — the one-shot staging scans (`stage_parquet`) would
+    otherwise retain chunks they never replay AND could LRU-evict the
+    epoch solvers' streams, the consumers the cache exists for."""
+
+    def _source():
+        import pyarrow.dataset as ds
+
+        columns = _scan_columns(
+            features_col, features_cols, label_col, weight_col
+        )
+        dataset = ds.dataset(path, format="parquet")
+        return chunks_from_batches(
+            dataset.to_batches(columns=columns, batch_size=chunk_rows),
+            features_col, features_cols, label_col, weight_col,
+            chunk_rows, dtype, row_range=row_range,
+        )
+
+    from .parallel.device_cache import cached_chunk_stream
+
+    key = None if not cache_ok else _chunk_stream_key(
+        path, features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype, row_range,
+    )
+    yield from cached_chunk_stream(
+        key, _source,
+        device_elem=0 if device_ok else None,
+        serve_device=device_ok,
+        select=select_chunks,
     )
 
 
@@ -344,21 +429,19 @@ def chunks_from_batches(
 
 def iter_chunks_prefetch(*args, **kwargs) -> Iterator:
     """`iter_chunks` with the parquet decode running on a background
-    thread, one chunk ahead: the device consumes chunk i while the host
-    reads chunk i+1 (the streaming analog of the reference's overlapped
-    reserved-memory copies, utils.py:403-522).  `iter_chunks` yields
-    owned chunks, so the queue holds up to two chunks of extra host
-    memory and no copy is needed.  Disable via the `streaming_prefetch`
-    conf."""
+    thread ahead of the consumer: the device consumes chunk i while the
+    host reads chunk i+1 (the streaming analog of the reference's
+    overlapped reserved-memory copies, utils.py:403-522).  `iter_chunks`
+    yields owned chunks, so the queue holds `streaming_prefetch_depth`-1
+    chunks of extra host memory and no copy is needed.  Disable via the
+    `streaming_prefetch` conf (or depth <= 1)."""
     from .utils import prefetch_iter
 
-    if not get_config("streaming_prefetch"):
+    depth = max(1, int(get_config("streaming_prefetch_depth")))
+    if not get_config("streaming_prefetch") or depth <= 1:
         yield from iter_chunks(*args, **kwargs)
         return
-    # depth=3: bounded queue of 2 owned chunks + the one in the reader's
-    # hand — the same extra-host-memory budget as before the shared
-    # helper (utils.prefetch_iter) absorbed this machinery
-    yield from prefetch_iter(iter_chunks(*args, **kwargs), depth=3)
+    yield from prefetch_iter(iter_chunks(*args, **kwargs), depth=depth)
 
 
 
@@ -386,6 +469,54 @@ def _weights_host(cw, n_c: int, chunk_rows: int, dtype) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # Mechanism A: stream-stage into a sharded HBM buffer
 # ---------------------------------------------------------------------------
+
+
+def _parquet_share_offsets(path: str, readers: int) -> Optional[list]:
+    """[(row_group_indices, global_start_row)] shares for the PARALLEL
+    staging readers: the fused engine's row-balanced contiguous
+    row-group partition (fused._partition_row_groups) annotated with
+    each share's global starting row, so out-of-order decoded chunks
+    still land at their exact global offsets in the ShardedRowWriters.
+    None = not splittable (directory dataset / too few groups /
+    readers<=1): the caller keeps the single in-order scan."""
+    from .fused import _partition_row_groups
+
+    shares = _partition_row_groups(path, readers)
+    if shares is None:
+        return None
+    import pyarrow.parquet as pq
+
+    md = pq.ParquetFile(path).metadata
+    sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+    starts = np.concatenate([[0], np.cumsum(sizes)])
+    return [(groups, int(starts[groups[0]])) for groups in shares]
+
+
+def _share_chunks(
+    path: str,
+    features_col,
+    features_cols,
+    label_col,
+    weight_col,
+    chunk_rows: int,
+    dtype: np.dtype,
+    groups,
+) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray], int]]:
+    """One staging reader's share: the iter_chunks decode + fixed-shape
+    chunking over ONLY its row groups (fused._reader_batches prunes the
+    scan).  Deliberately NOT chunk-cached: a staging scan runs once per
+    dataset-cache miss and would only burn host budget the epoch
+    solvers' streams need."""
+    from .fused import _reader_batches
+
+    columns = _scan_columns(
+        features_col, features_cols, label_col, weight_col
+    )
+    yield from chunks_from_batches(
+        _reader_batches(path, columns, chunk_rows, groups),
+        features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype,
+    )
 
 
 def stage_parquet(
@@ -432,7 +563,7 @@ def stage_parquet(
         at = 0
         for cX, cy, cw, n_c in iter_chunks(
             path, features_col, features_cols, label_col, weight_col,
-            chunk_rows, dtype, row_range=(lo, hi),
+            chunk_rows, dtype, row_range=(lo, hi), cache_ok=False,
         ):
             X[at : at + n_c] = cX[:n_c]
             if y is not None:
@@ -522,34 +653,95 @@ def stage_parquet(
 
     off = 0
     n_chunks = 0
-    for cX, cy, cw, n_c in iter_chunks_prefetch(
-        path, features_col, features_cols, label_col, weight_col,
-        chunk_rows, dtype,
-    ):
-        if use_writer:
-            # only the valid rows travel: chunk tail padding (and the
-            # buffer tail) stays in the zeros the shard buffers started
-            # with, so a short final chunk transfers no padding bytes
-            wX.write(off, np.asarray(cX[:n_c], dtype))
-            if wy is not None:
-                wy.write(off, np.asarray(np.asarray(cy)[:n_c], ldt))
-            # sliced to the valid rows so tail padding never travels; the
-            # chunk_rows arg keeps _ONES_CACHE keyed to the one full-chunk
-            # size (a per-tail-size key would grow the cache unboundedly
-            # across fits)
-            ww.write(off, _weights_host(cw, n_c, chunk_rows, dtype)[:n_c])
-        else:
-            w_host = _weights_host(cw, n_c, chunk_rows, dtype)
-            cY = (
-                jnp.asarray(np.asarray(cy, ldt)) if label_col else None
-            )
-            bufX, bufy, bufw = fill(
-                bufX, bufy, bufw,
-                jnp.asarray(cX), cY, jnp.asarray(w_host),
-                jnp.asarray(off, jnp.int32),
-            )
-        off += chunk_rows
-        n_chunks += 1
+    shares = None
+    if use_writer:
+        from .fused import resolve_parquet_readers
+
+        readers = resolve_parquet_readers(path)
+        if readers > 1:
+            shares = _parquet_share_offsets(path, readers)
+    if shares is not None:
+        # PARALLEL ingest (multi-core hosts): each range reader decodes
+        # ONLY its row-group share and feeds the per-device writers
+        # DIRECTLY from its own thread — decode, compress/spill
+        # (chunk-cache inserts) and device transfer all overlap.  The
+        # share's global start row keeps every chunk at its exact
+        # global offset, so the staged buffer is byte-identical to the
+        # single-reader scan (asserted by tests/test_chunk_cache.py).
+        import threading
+
+        from .tracing import adopt_trace_context
+
+        errors: list = []
+        counted = {"chunks": 0}
+        cmu = threading.Lock()
+        # reader threads decode AND dispatch device writes: adopt the
+        # fit's trace context so their compile events and any fault
+        # markers land in the fit's report, not an anonymous thread
+        adopt = adopt_trace_context()
+
+        def _stage_share(groups, start: int) -> None:
+            adopt()
+            try:
+                at = start
+                for cX, cy, cw, n_c in _share_chunks(
+                    path, features_col, features_cols, label_col,
+                    weight_col, chunk_rows, dtype, groups,
+                ):
+                    wX.write(at, np.asarray(cX[:n_c], dtype))
+                    if wy is not None:
+                        wy.write(at, np.asarray(np.asarray(cy)[:n_c], ldt))
+                    ww.write(
+                        at, _weights_host(cw, n_c, chunk_rows, dtype)[:n_c]
+                    )
+                    at += n_c
+                    with cmu:
+                        counted["chunks"] += 1
+            except BaseException as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=_stage_share, args=s, daemon=True)
+            for s in shares
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        n_chunks = counted["chunks"]
+    else:
+        # cache_ok=False: a one-shot staging scan must neither retain
+        # chunks it never replays nor evict the epoch solvers' streams
+        for cX, cy, cw, n_c in iter_chunks_prefetch(
+            path, features_col, features_cols, label_col, weight_col,
+            chunk_rows, dtype, cache_ok=False,
+        ):
+            if use_writer:
+                # only the valid rows travel: chunk tail padding (and the
+                # buffer tail) stays in the zeros the shard buffers started
+                # with, so a short final chunk transfers no padding bytes
+                wX.write(off, np.asarray(cX[:n_c], dtype))
+                if wy is not None:
+                    wy.write(off, np.asarray(np.asarray(cy)[:n_c], ldt))
+                # sliced to the valid rows so tail padding never travels;
+                # the chunk_rows arg keeps _ONES_CACHE keyed to the one
+                # full-chunk size (a per-tail-size key would grow the
+                # cache unboundedly across fits)
+                ww.write(off, _weights_host(cw, n_c, chunk_rows, dtype)[:n_c])
+            else:
+                w_host = _weights_host(cw, n_c, chunk_rows, dtype)
+                cY = (
+                    jnp.asarray(np.asarray(cy, ldt)) if label_col else None
+                )
+                bufX, bufy, bufw = fill(
+                    bufX, bufy, bufw,
+                    jnp.asarray(cX), cY, jnp.asarray(w_host),
+                    jnp.asarray(off, jnp.int32),
+                )
+            off += chunk_rows
+            n_chunks += 1
     if use_writer:
         bufX = wX.finish()
         bufy = wy.finish() if wy is not None else None
@@ -564,7 +756,11 @@ def stage_parquet(
     LAST_STAGE.update(
         {"seconds": round(el, 2), "mb": round(mb, 1),
          "mb_per_s": round(mb / max(el, 1e-9), 1),
-         "engine": "per-device" if use_writer else "global-update"}
+         "engine": (
+             "per-device-parallel" if shares is not None
+             else "per-device" if use_writer else "global-update"
+         ),
+         **({"readers": len(shares)} if shares is not None else {})}
     )
     if use_writer:
         # engine observability (mirrors mesh.STAGE_METRICS): actual bytes
@@ -705,11 +901,11 @@ def linreg_streaming_stats(
     acc, step = _linreg_acc(d, dtype)
     for cX, cy, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, label_col, weight_col,
-        chunk_rows, dtype, row_range=(lo, hi),
+        chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
     ):
         w_host = _weights_host(cw, n_c, chunk_rows, dtype)
         acc = step(
-            acc, jnp.asarray(cX), jnp.asarray(w_host),
+            acc, _dev_chunk(cX, dtype), jnp.asarray(w_host),
             jnp.asarray(np.asarray(cy, dtype)),
         )
     return _acc_to_host_f64(acc)
@@ -773,10 +969,10 @@ def pca_streaming_stats(
     acc, step = _pca_acc(d, dtype)
     for cX, _, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, None, weight_col,
-        chunk_rows, dtype, row_range=(lo, hi),
+        chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
     ):
         w_host = _weights_host(cw, n_c, chunk_rows, dtype)
-        acc = step(acc, jnp.asarray(cX), jnp.asarray(w_host))
+        acc = step(acc, _dev_chunk(cX, dtype), jnp.asarray(w_host))
     return _acc_to_host_f64(acc)
 
 
@@ -898,6 +1094,143 @@ def _label_moments_scan(
 from .resilience.checkpoint import checkpoint_file_for  # noqa: F401, E402
 
 
+# ---------------------------------------------------------------------------
+# DuHL-style chunk importance sampling (`streaming_chunk_sampling=duhl`).
+# "Large-Scale Stochastic Learning using GPUs" (DuHL, PAPERS.md) keeps
+# the coordinates with the largest duality-gap contribution in fast
+# memory and streams only those; the chunk-granularity analog here:
+# once the chunk cache holds the full stream, an epoch revisits only
+# the chunks whose contribution to the solver's own statistics is
+# still MOVING (per-chunk scores), and every unvisited chunk
+# contributes its last-computed statistics (stale-compensation — the
+# SAG-style trick that keeps the objective estimate unbiased-in-the-
+# limit as the iterates settle).  Skipped chunks never decompress or
+# transfer.  Guard rails: a chunk is force-revisited after MAX_AGE
+# epochs, and every FULL_EVERY-th evaluation runs a full refresh pass,
+# so no stale contribution can survive convergence checking.
+# ---------------------------------------------------------------------------
+
+
+def chunk_sampling_mode() -> str:
+    mode = str(get_config("streaming_chunk_sampling")).lower()
+    if mode not in ("off", "duhl"):
+        raise ValueError(
+            f"streaming_chunk_sampling must be off|duhl, got {mode!r}"
+        )
+    return mode
+
+
+class DuhlChunkSampler:
+    """Per-chunk contribution bookkeeping for sampled epochs.  The
+    solver feeds `visited(idx, score)` after recomputing a chunk and
+    asks `select()` for the next epoch's chunk set (None = run a full
+    pass: not primed yet, periodic refresh due, or the selection would
+    cover everything anyway).
+
+    The selection is FROZEN between full refreshes: within a refresh
+    cycle every evaluation revisits the SAME chunk set, so the
+    stale-compensated objective is a consistent (smoothly varying)
+    function of the iterate — an L-BFGS line search backtracking over a
+    selection that changed per evaluation would see the compensation
+    offsets jump discontinuously and stall.  The periodic full pass
+    refreshes every stale contribution and re-scores the next cycle's
+    selection; `MAX_AGE` additionally force-includes any chunk whose
+    contribution somehow outlived a cycle (a guard, not the steady
+    state)."""
+
+    MAX_AGE = 12  # no chunk's contribution may go staler than this
+    FULL_EVERY = 8  # full refresh every Nth evaluation (cycle length)
+    WARM_EVALS = 8  # full passes before sampling engages: the early
+    # L-BFGS phase takes large steps whose line searches need the exact
+    # objective; sampling pays off in the bulk-descent phase after it
+    TAIL_EPS = 0.02  # once the iterate moves less than this (relative)
+    # between full refreshes, sampling hands back to exact passes for
+    # good: the stale-compensation bias would otherwise floor the
+    # achievable tolerance, and there is nothing left to save — the
+    # endgame's convergence checks must run on the exact objective
+
+    def __init__(self, fraction: float, warm_evals: Optional[int] = None,
+                 full_every: Optional[int] = None) -> None:
+        self.fraction = min(max(float(fraction), 0.1), 1.0)
+        if warm_evals is not None:
+            self.WARM_EVALS = int(warm_evals)
+        if full_every is not None:
+            self.FULL_EVERY = max(2, int(full_every))
+        self.n_chunks: Optional[int] = None
+        self.score: Optional[np.ndarray] = None
+        self.age: Optional[np.ndarray] = None
+        self.sampled_epochs = 0
+        self.chunk_visits_saved = 0
+        self._evals = 0
+        self._sel: Optional[list] = None  # frozen within the cycle
+        self._ref: Optional[np.ndarray] = None  # iterate at last refresh
+        self._exact = False  # tail reached: full passes from here on
+
+    def ready(self) -> bool:
+        return self.n_chunks is not None
+
+    def start(self, n_chunks: int) -> None:
+        self.n_chunks = int(n_chunks)
+        self.score = np.full((n_chunks,), np.inf)
+        self.age = np.zeros((n_chunks,), np.int64)
+
+    def _pick(self) -> Optional[list]:
+        n = self.n_chunks
+        want = max(1, int(np.ceil(n * self.fraction)))
+        order = np.argsort(-self.score, kind="stable")
+        sel = set(int(i) for i in order[:want])
+        sel |= set(int(i) for i in np.flatnonzero(self.age + 1 >= self.MAX_AGE))
+        if len(sel) >= n:
+            return None
+        return sorted(sel)
+
+    def select(self) -> Optional[list]:
+        """Chunk positions for the next epoch; None = full pass."""
+        self._evals += 1
+        if (
+            self._exact
+            or not self.ready()
+            or self._evals <= self.WARM_EVALS
+            or (self._evals - 1) % self.FULL_EVERY == 0
+        ):
+            self._sel = None  # full refresh re-scores the next cycle
+            return None
+        if self._sel is None:
+            self._sel = self._pick()
+        if self._sel is None:
+            return None
+        self.sampled_epochs += 1
+        self.chunk_visits_saved += self.n_chunks - len(self._sel)
+        return self._sel
+
+    def note_refresh(self, iterate: np.ndarray) -> None:
+        """Called after every FULL pass with the solver's current
+        iterate (flattened): detects the convergence tail — relative
+        movement below TAIL_EPS since the previous full refresh — and
+        switches to exact mode permanently."""
+        it = np.asarray(iterate, np.float64).ravel()
+        if self._ref is not None and self._ref.shape == it.shape:
+            denom = max(float(np.linalg.norm(self._ref)), 1.0)
+            if float(np.linalg.norm(it - self._ref)) / denom < self.TAIL_EPS:
+                self._exact = True
+        self._ref = it.copy()
+
+    def visited(self, idx: int, score: float) -> None:
+        self.score[idx] = float(score)
+        self.age[idx] = 0
+
+    def epoch_done(self, visited_idx) -> None:
+        mask = np.ones((self.n_chunks,), bool)
+        mask[list(visited_idx)] = False
+        self.age[mask] += 1
+
+    def summary(self) -> dict:
+        return {
+            "sampled_epochs": int(self.sampled_epochs),
+            "chunk_visits_saved": int(self.chunk_visits_saved),
+        }
+
+
 def logreg_streaming_fit(
     path: str,
     features_col,
@@ -995,26 +1328,117 @@ def logreg_streaming_fit(
     coef_mask[:n_coef] = 1.0
     epochs = {"n": 0}
 
-    def oracle(theta_np: np.ndarray):
-        theta = jnp.asarray(theta_np.astype(np.float32))
-        acc_l = jnp.zeros((), jnp.float32)
-        acc_g = jnp.zeros((n_param,), jnp.float32)
-        for cX, cy, cw, n_c in iter_chunks_prefetch(
+    duhl = chunk_sampling_mode() == "duhl"
+    sampler = stale_l = stale_g = None
+    if duhl:
+        sampler = DuhlChunkSampler(
+            get_config("streaming_chunk_sample_fraction")
+        )
+        # per-chunk (loss, grad) — NOT donated/accumulated: the sampled
+        # epochs need each chunk's own contribution to compensate the
+        # unvisited ones and to score "is this chunk still moving"
+        lg = jax.jit(vg)
+    stream_key = chunk_stream_key(
+        path, features_col, features_cols, label_col, weight_col,
+        chunk_rows, dtype, (lo, hi),
+    )
+
+    def _chunk_iter(sel):
+        kw = dict(row_range=(lo, hi), device_ok=True)
+        if sel is None:
+            return enumerate(iter_chunks_prefetch(
+                path, features_col, features_cols, label_col, weight_col,
+                chunk_rows, dtype, **kw,
+            ))
+        return zip(sel, iter_chunks_prefetch(
             path, features_col, features_cols, label_col, weight_col,
-            chunk_rows, dtype, row_range=(lo, hi),
+            chunk_rows, dtype, select_chunks=frozenset(sel), **kw,
+        ))
+
+    def _duhl_eval(theta, theta_np):
+        """One (possibly sampled) epoch: fresh per-chunk contributions
+        for the selected chunks, last-computed (stale) contributions for
+        the rest.  Selection engages only once the chunk cache replays
+        the full stream — skipping chunks of a stream that still reads
+        parquet would skip-scan the file for no win."""
+        nonlocal stale_l, stale_g
+        from .parallel.device_cache import chunk_stream_complete
+
+        sel = None
+        if (
+            sampler.ready()
+            and chunk_stream_complete(stream_key) == sampler.n_chunks
         ):
+            sel = sampler.select()
+        idxs, dev_l, dev_g = [], [], []
+        host_l, host_g = [], []
+
+        def _flush():
+            # BOUNDED batched fetches (not one per epoch): per-chunk
+            # contributions held on device until epoch end would grow
+            # O(n_chunks x n_param) of device memory on a fit whose
+            # whole point is bounded-memory epochs; per-chunk syncs
+            # would serialize the prefetch pipeline away.  64 in-flight
+            # chunks keeps both properties
+            if dev_l:
+                hl, hg = jax.device_get((dev_l, dev_g))
+                host_l.extend(hl)
+                host_g.extend(hg)
+                dev_l.clear()
+                dev_g.clear()
+
+        for idx, (cX, cy, cw, n_c) in _chunk_iter(sel):
             w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
-            acc_l, acc_g = step(
-                acc_l, acc_g, theta,
-                jnp.asarray(np.asarray(cX, np.float32)),
-                jnp.asarray(w_host),
+            l, g = lg(
+                theta, _dev_chunk(cX, np.float32), jnp.asarray(w_host),
                 jnp.asarray(np.asarray(cy, np.float32)),
             )
-        host_l, host_g = jax.device_get((acc_l, acc_g))
-        agg = _sum_across_processes(
-            {"l": np.asarray(host_l, np.float64),
-             "g": np.asarray(host_g, np.float64)}
-        )
+            idxs.append(idx)
+            dev_l.append(l)
+            dev_g.append(g)
+            if len(dev_l) >= 64:
+                _flush()
+        _flush()
+        if not sampler.ready():
+            sampler.start(len(idxs))
+            stale_l = np.zeros((len(idxs),), np.float64)
+            stale_g = np.zeros((len(idxs), n_param), np.float64)
+        for i, idx in enumerate(idxs):
+            g_new = np.asarray(host_g[i], np.float64)
+            sampler.visited(idx, float(np.linalg.norm(g_new - stale_g[idx])))
+            stale_l[idx] = float(host_l[i])
+            stale_g[idx] = g_new
+        sampler.epoch_done(idxs)
+        if sel is None:
+            sampler.note_refresh(theta_np)
+        return float(stale_l.sum()), stale_g.sum(axis=0)
+
+    def oracle(theta_np: np.ndarray):
+        theta = jnp.asarray(theta_np.astype(np.float32))
+        if duhl:
+            tot_l, tot_g = _duhl_eval(theta, theta_np)
+            agg = _sum_across_processes(
+                {"l": np.asarray(tot_l, np.float64), "g": tot_g}
+            )
+        else:
+            acc_l = jnp.zeros((), jnp.float32)
+            acc_g = jnp.zeros((n_param,), jnp.float32)
+            for cX, cy, cw, n_c in iter_chunks_prefetch(
+                path, features_col, features_cols, label_col, weight_col,
+                chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
+            ):
+                w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
+                acc_l, acc_g = step(
+                    acc_l, acc_g, theta,
+                    _dev_chunk(cX, np.float32),
+                    jnp.asarray(w_host),
+                    jnp.asarray(np.asarray(cy, np.float32)),
+                )
+            host_l, host_g = jax.device_get((acc_l, acc_g))
+            agg = _sum_across_processes(
+                {"l": np.asarray(host_l, np.float64),
+                 "g": np.asarray(host_g, np.float64)}
+            )
         epochs["n"] += 1
         beta = theta_np * coef_mask
         f = float(agg["l"]) / wsum + 0.5 * l2 * float(beta @ beta)
@@ -1067,6 +1491,8 @@ def logreg_streaming_fit(
         "binomial": binomial,
         # TRUE dataset passes (accepted iterates + line-search backtracks)
         "epochs": epochs["n"],
+        # DuHL sampling accounting (0s when streaming_chunk_sampling=off)
+        **(sampler.summary() if sampler is not None else {}),
     }
 
 
@@ -1184,23 +1610,116 @@ def kmeans_streaming_fit(
         oh = jax.nn.one_hot(labels, k, dtype=X.dtype) * w[:, None]
         return (sums + oh.T @ X, cost + (md2 * w).sum()), counts + oh.sum(axis=0)
 
+    duhl = chunk_sampling_mode() == "duhl"
+    sampler = None
+    stale = {"sums": None, "counts": None, "cost": None}
+    if duhl:
+        # Lloyd has no line search and tolerates stale assign stats far
+        # better than L-BFGS tolerates a stale objective: engage after
+        # 3 exact passes, refresh every 4th
+        sampler = DuhlChunkSampler(
+            get_config("streaming_chunk_sample_fraction"),
+            warm_evals=3, full_every=4,
+        )
+
+        # per-chunk assign stats (NOT accumulated): the sampled Lloyd
+        # passes need each chunk's own (sums, counts, cost) so
+        # unvisited chunks can contribute their last-computed stats
+        def _chunk_stats_fn(C, X, w):
+            d2 = _pairwise_sqdist(X, C)
+            labels = jnp.argmin(d2, axis=1)
+            md2 = jnp.min(d2, axis=1)
+            oh = jax.nn.one_hot(labels, k, dtype=X.dtype) * w[:, None]
+            return oh.T @ X, oh.sum(axis=0), (md2 * w).sum()
+
+        chunk_stats = jax.jit(_chunk_stats_fn)
+    stream_key = chunk_stream_key(
+        path, features_col, features_cols, None, weight_col,
+        chunk_rows, dtype, (lo, hi),
+    )
+
     def one_pass(C_host: np.ndarray):
         C_dev = jnp.asarray(C_host.astype(dtype))
         acc = (jnp.zeros((k, d), jnp.float32), jnp.zeros((), jnp.float32))
         counts = jnp.zeros((k,), jnp.float32)
         for cX, _, cw, n_c in iter_chunks_prefetch(
             path, features_col, features_cols, None, weight_col,
-            chunk_rows, dtype, row_range=(lo, hi),
+            chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
         ):
             w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
             acc, counts = assign_step(
                 acc, counts, C_dev,
-                jnp.asarray(np.asarray(cX, np.float32)), jnp.asarray(w_host),
+                _dev_chunk(cX, np.float32), jnp.asarray(w_host),
             )
         host = jax.device_get({"sums": acc[0], "counts": counts, "cost": acc[1]})
         agg = _sum_across_processes(
             {kk: np.asarray(v, np.float64) for kk, v in host.items()}
         )
+        return agg["sums"], agg["counts"], float(agg["cost"])
+
+    def one_pass_duhl(C_host: np.ndarray):
+        """DuHL-sampled Lloyd pass: chunks with the largest cost
+        contribution (points far from their centers — the ones that
+        still move centers) recompute under the current centers; the
+        rest contribute their last-computed assign statistics."""
+        from .parallel.device_cache import chunk_stream_complete
+
+        C_dev = jnp.asarray(C_host.astype(dtype))
+        sel = None
+        if (
+            sampler.ready()
+            and chunk_stream_complete(stream_key) == sampler.n_chunks
+        ):
+            sel = sampler.select()
+        if sel is None:
+            it = enumerate(iter_chunks_prefetch(
+                path, features_col, features_cols, None, weight_col,
+                chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
+            ))
+        else:
+            it = zip(sel, iter_chunks_prefetch(
+                path, features_col, features_cols, None, weight_col,
+                chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
+                select_chunks=frozenset(sel),
+            ))
+        idxs, dev_stats, host_stats = [], [], []
+
+        def _flush():
+            # bounded batched fetches: per-chunk (k, d) assign stats on
+            # device until epoch end would be O(n_chunks x k x d) HBM
+            if dev_stats:
+                host_stats.extend(jax.device_get(dev_stats))
+                dev_stats.clear()
+
+        for idx, (cX, _, cw, n_c) in it:
+            w_host = _weights_host(cw, n_c, chunk_rows, np.float32)
+            dev_stats.append(chunk_stats(
+                C_dev, _dev_chunk(cX, np.float32), jnp.asarray(w_host)
+            ))
+            idxs.append(idx)
+            if len(dev_stats) >= 16:
+                _flush()
+        _flush()
+        if not sampler.ready():
+            n_ch = len(idxs)
+            sampler.start(n_ch)
+            stale["sums"] = np.zeros((n_ch, k, d), np.float64)
+            stale["counts"] = np.zeros((n_ch, k), np.float64)
+            stale["cost"] = np.zeros((n_ch,), np.float64)
+        for i, idx in enumerate(idxs):
+            s, c, co = host_stats[i]
+            stale["sums"][idx] = np.asarray(s, np.float64)
+            stale["counts"][idx] = np.asarray(c, np.float64)
+            stale["cost"][idx] = float(co)
+            sampler.visited(idx, float(co))
+        sampler.epoch_done(idxs)
+        if sel is None:
+            sampler.note_refresh(np.asarray(C_host, np.float64).ravel())
+        agg = _sum_across_processes({
+            "sums": stale["sums"].sum(axis=0),
+            "counts": stale["counts"].sum(axis=0),
+            "cost": np.asarray(stale["cost"].sum(), np.float64),
+        })
         return agg["sums"], agg["counts"], float(agg["cost"])
 
     from .resilience import maybe_inject
@@ -1235,7 +1754,9 @@ def kmeans_streaming_fit(
     cost = 0.0
     for n_iter in range(start_it + 1, max_iter + 1):
         maybe_inject("kmeans_lloyd")
-        sums, counts, cost = one_pass(C_host)
+        sums, counts, cost = (
+            one_pass_duhl(C_host) if duhl else one_pass(C_host)
+        )
         hb.beat(n_iter, loss=cost)
         new_C = np.where(
             counts[:, None] > 0,
@@ -1257,4 +1778,7 @@ def kmeans_streaming_fit(
     logger.info(
         f"Epoch-streaming kmeans: {n_iter} Lloyd passes over {n_total} rows"
     )
-    return {"centers": C_host, "cost": cost, "n_iter": n_iter, "d": d}
+    return {
+        "centers": C_host, "cost": cost, "n_iter": n_iter, "d": d,
+        **(sampler.summary() if sampler is not None else {}),
+    }

@@ -138,6 +138,10 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "staging_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "staging_counts": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "device_cache": {"kind": "view", "labels": ("key",), "cardinality": 32},
+    # chunk cache (parallel/device_cache.py ChunkCache): hit/miss/spill/
+    # restore/evict/invalidate counters + per-tier byte gauges for the
+    # out-of-core epoch engine's decoded-chunk tiers
+    "chunk_cache": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "recovery": {"kind": "view", "labels": ("key",), "cardinality": 16},
     "fused_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "pca_solver_last": {"kind": "view", "labels": ("key",), "cardinality": 16},

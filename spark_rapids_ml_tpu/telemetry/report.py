@@ -344,6 +344,26 @@ class FitTelemetry:
                 }
         except Exception:
             pass
+        # parallel parquet-reader decision (fused.resolve_parquet_readers):
+        # same last-run-state discipline — "why did this fit decode with
+        # N readers" is part of the solver_decision story
+        try:
+            from ..fused import LAST_READER_DECISION
+
+            if (
+                not self._overlapped
+                and LAST_READER_DECISION.get("stamp", 0) >= self._t0
+            ):
+                solver_decision.update({
+                    k: LAST_READER_DECISION[k]
+                    for k in (
+                        "parquet_readers", "parquet_readers_mode",
+                        "parquet_readers_reason",
+                    )
+                    if LAST_READER_DECISION.get(k) is not None
+                })
+        except Exception:
+            pass
 
         report: Dict[str, Any] = {
             "run_id": self.run_id,
@@ -360,6 +380,9 @@ class FitTelemetry:
             "cache": _view_delta(deltas, "device_cache"),
             "resilience": self._resilience_section(events, deltas),
         }
+        chunk_cache = _view_delta(deltas, "chunk_cache")
+        if any(chunk_cache.values()):
+            report["chunk_cache"] = chunk_cache
         if fused:
             report["fused"] = fused
         if solver_decision:

@@ -154,7 +154,16 @@ def prefetch_iter(it, depth: int):
                 continue
         return False
 
+    # the producer runs the caller's iterator (chunk-cache inserts emit
+    # spill/evict trace events; device_puts emit compile events): adopt
+    # the caller's trace buffer + run context so they attribute to the
+    # fit that is consuming, not to an anonymous worker thread
+    from .tracing import adopt_trace_context
+
+    adopt = adopt_trace_context()
+
     def producer() -> None:
+        adopt()
         try:
             for item in it:
                 if not _put(item):

@@ -31,8 +31,15 @@ from spark_rapids_ml_tpu.tuning import CrossValidator, ParamGridBuilder
 
 @pytest.fixture(autouse=True)
 def _clean_cache():
+    # the chunk cache books its device tier through the same external
+    # ledger these tests assert exact byte counts against — start from a
+    # clean claim table
+    from spark_rapids_ml_tpu.parallel.device_cache import clear_chunk_cache
+
+    clear_chunk_cache()
     clear_device_cache()
     yield
+    clear_chunk_cache()
     clear_device_cache()
     reset_config()
 

@@ -128,7 +128,10 @@ def test_parallel_readers_cover_every_row_once(tmp_path, rng):
         }
     )
     pq.write_table(t, path, row_group_size=1000)
-    set_config(fused_stage_solve="on", fused_parquet_readers=1)
+    # chunk cache off: a cached replay of the readers=1 stream would
+    # serve the readers=2 fit from memory and never run the reader pool
+    set_config(fused_stage_solve="on", fused_parquet_readers=1,
+               chunk_cache="off")
     m1 = PCA(k=2).setInputCol("features").fit(path)
     set_config(fused_parquet_readers=2)
     m2 = PCA(k=2).setInputCol("features").fit(path)
