@@ -117,6 +117,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
     tests/test_serving.py \
+    tests/test_flight_recorder.py tests/test_aggregate.py \
     tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
@@ -259,6 +260,59 @@ assert parsed[retry_key] >= 1.0, retry_key
 assert rep["resilience"]["retries"] >= 1
 print(f"telemetry smoke OK: {len(instants)} marker(s), "
       f"{len(parsed)} prometheus samples, report at {rep['run_id']}")
+EOF
+
+echo "== flight-recorder smoke: device loss leaves a black box =="
+# tier-1 marker-safe: a device_lost injection at Lloyd iteration 4 of a
+# fit with NO telemetry_dir (per-fit reports disabled) must leave a
+# post-mortem bundle in the recorder dir whose Chrome trace parses and
+# carries the interrupted fit's run_id, with the solver-state snapshot
+# showing the iteration the loss interrupted.  tests/test_flight_recorder
+# .py covers the ring/cooldown/hook matrix; this step keeps the black-box
+# gate runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import glob
+import json
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.clustering import KMeans
+from spark_rapids_ml_tpu.config import get_config, set_config
+from spark_rapids_ml_tpu.resilience import fault_inject
+from spark_rapids_ml_tpu.telemetry.exporters import parse_prometheus
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6)).astype(np.float32)
+df = pd.DataFrame({"features": list(X)})
+with tempfile.TemporaryDirectory() as td, \
+        tempfile.TemporaryDirectory() as ckpt:
+    assert not get_config("telemetry_dir"), "per-fit reports must be OFF"
+    set_config(flight_recorder_dir=td, checkpoint_dir=ckpt,
+               retry_backoff_s=0.01, retry_jitter=0.0)
+    with fault_inject("kmeans_lloyd", "device_lost", times=1, skip=3):
+        m = KMeans(k=3, seed=7, maxIter=8, tol=0.0).fit(df)
+    rep = m.fit_report()  # in-memory only; nothing was written per-fit
+    bundles = glob.glob(f"{td}/postmortem_device_lost_*")
+    assert len(bundles) == 1, bundles
+    b = bundles[0]
+    trace = json.load(open(os.path.join(b, "trace.json")))
+    run_ids = {e.get("args", {}).get("run_id")
+               for e in trace["traceEvents"]}
+    assert rep["run_id"] in run_ids, (rep["run_id"], run_ids)
+    manifest = json.load(open(os.path.join(b, "manifest.json")))
+    assert rep["run_id"] in manifest["run_ids"]
+    assert manifest["solver_state"]["solver_iteration"] == {
+        "solver=kmeans_lloyd": 3
+    }, manifest["solver_state"]
+    assert parse_prometheus(open(os.path.join(b, "metrics.prom")).read())
+    assert json.load(open(os.path.join(b, "config.json")))
+    print(f"flight-recorder smoke OK: bundle {os.path.basename(b)} holds "
+          f"{manifest['n_events']} event(s) of run {rep['run_id']} "
+          "(interrupted at Lloyd iteration 3)")
 EOF
 
 echo "== serving smoke: sustained small-QPS through the micro-batch server =="

@@ -364,6 +364,19 @@ class MetricNameRule(Rule):
                 }
                 if node.func.attr == "value":
                     used -= {"default"}
+                if node.func.attr == "observe" and "exemplar" in used:
+                    # `exemplar=` is a sample annotation, not a label —
+                    # legal ONLY on families the catalog declares
+                    # exemplar-bearing, so unbounded ids can never ride
+                    # into a family the dashboards treat as plain
+                    if not spec.get("exemplars"):
+                        yield Finding(
+                            sf.rel, node.lineno, self.name,
+                            f"`{mname}.observe(exemplar=...)` on a family "
+                            "METRIC_CATALOG does not declare "
+                            "`exemplars: True` for",
+                        )
+                    used -= {"exemplar"}
                 if used != declared:
                     yield Finding(
                         sf.rel, node.lineno, self.name,

@@ -337,6 +337,47 @@ _DEFAULTS: Dict[str, Any] = {
     # the per-model latency report.  Binds LOOPBACK like the
     # `telemetry_port` endpoint; 0 = off (in-process ServingClient only).
     "serving_port": 0,
+    # Slow-request capture (serving/server.py): a request whose total
+    # latency reaches this many milliseconds has its batch's FULL span
+    # tree captured (queue -> coalesce -> stage -> compute -> scatter)
+    # into a bounded in-memory buffer (`ServingServer.slow_traces()`)
+    # and marked with a `serving_slow[...]` instant event.  <= 0
+    # disables the capture; request ids still attach to every latency
+    # observation as exemplars either way.
+    "serving_slow_trace_ms": 0.0,
+    # Declared p99 latency target (milliseconds) every served model is
+    # held to: `slo_burn_rate{model,window}` gauges report the measured
+    # over-target request fraction divided by the 1% error budget a p99
+    # target implies (burn 1.0 = exactly on budget, >1 = burning).
+    # <= 0 disables the burn-rate gauges.  Per-model overrides via
+    # `serving_slo_targets`.
+    "serving_slo_p99_ms": 0.0,
+    # Per-model p99 target overrides: "model=ms,model2=ms" comma list
+    # (e.g. "logreg=5,pca=20").  Models not listed fall back to
+    # `serving_slo_p99_ms`.  Empty = no per-model overrides.
+    "serving_slo_targets": "",
+    # Failure flight recorder (telemetry/flight_recorder.py): "on" keeps
+    # an always-on bounded ring of recent trace events, rate-limited
+    # metric deltas and heartbeats (O(1) memory), and the typed failure
+    # paths — retry exhaustion, DispatchTimeout, device-loss elastic
+    # recovery, sustained ServingOverload — dump a post-mortem bundle
+    # (Chrome trace of the last `flight_recorder_window_s` seconds,
+    # Prometheus snapshot, effective config, solver state) so every
+    # failure leaves a black box behind.  "off" disables recording.
+    "flight_recorder": "on",
+    # Ring capacity of the flight recorder: how many recent trace
+    # events it retains (a deque — O(1) appends, memory bounded by this
+    # count regardless of process lifetime).
+    "flight_recorder_events": 4096,
+    # How many seconds of recent history a post-mortem bundle's Chrome
+    # trace covers (events older than this at dump time are dropped
+    # from the bundle; the ring itself is bounded by count, not time).
+    "flight_recorder_window_s": 60.0,
+    # Where post-mortem bundles are written.  Empty -> `telemetry_dir`;
+    # when both are empty the recorder still records (the in-memory
+    # ring stays queryable) but failure dumps are skipped with a log
+    # line.
+    "flight_recorder_dir": "",
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"
@@ -427,6 +468,15 @@ def set_config(**kwargs: Any) -> None:
         _config.update(kwargs)
         new = _traced_keys_locked()
     _invalidate_traced(prev, new)
+
+
+def config_snapshot() -> Dict[str, Any]:
+    """Effective (env-aware) value of EVERY known conf key — the
+    operator-facing "what was this process actually configured as" dump
+    the flight recorder writes into post-mortem bundles.  Values are the
+    plain Python scalars `_DEFAULTS` holds, so the dict JSON-serializes."""
+    with _lock:
+        return {k: _effective_locked(k) for k in sorted(_DEFAULTS)}
 
 
 def reset_config() -> None:

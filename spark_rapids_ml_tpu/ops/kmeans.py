@@ -441,6 +441,11 @@ def kmeans_fit_stepwise(
         if shift2 <= tol * tol:
             break
     _, _, cost = one_pass(C)
+    # end-mark on NORMAL completion only — AFTER the final cost pass: a
+    # fit that dies anywhere before the result exists must leave its
+    # last iteration/loss visible for the flight recorder's post-mortem
+    # (telemetry/heartbeat.py Heartbeat.close)
+    hb.close()
     if checkpoint_path:
         clear_checkpoint(checkpoint_path)
     return C, cost, n_iter

@@ -367,6 +367,10 @@ def lbfgs_minimize_host(
                 "rho": rho, "k": k, "it": it,
                 "hist": np.asarray(hist), "converged": converged,
             })
+    # end-mark on normal completion: the solver gauges must not report
+    # a finished fit as live (a mid-loop death keeps its last state
+    # visible for the flight recorder's post-mortem)
+    hb.close()
     if checkpoint_path:
         clear_checkpoint(checkpoint_path)
     return w, it, converged, hist

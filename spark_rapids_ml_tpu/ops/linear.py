@@ -174,6 +174,9 @@ def solve_linear_host(
                 )
             if delta <= tol * max(1.0, float(np.max(np.abs(beta)))):
                 break
+        # end-mark on normal completion (Heartbeat.close): a scrape
+        # after the fit shows no live fista series
+        hb.close()
         if checkpoint_path:
             clear_checkpoint(checkpoint_path)
         coef_s = beta

@@ -222,6 +222,17 @@ def recover_from_device_loss(logger_=None) -> bool:
         return False
     with _lock:
         RECOVERY_METRICS["losses_detected"] += len(lost)
+    # a confirmed device loss is a hardware-grade event: dump the flight
+    # recorder NOW, before the shrink mutates mesh/cache state — the
+    # bundle's trace carries the interrupted fit's spans and run id even
+    # when the fit never had telemetry_dir reports enabled
+    from ..telemetry.flight_recorder import note_failure
+
+    note_failure(
+        "device_lost",
+        detail=f"lost={[d.id for d in lost]} n_dev={len(devices)}",
+        log=lg,
+    )
     lost_id_set = {int(d.id) for d in lost}
     survivors = [d for d in devices if int(d.id) not in lost_id_set]
     if not elastic_enabled() or len(survivors) < elastic_min_devices():

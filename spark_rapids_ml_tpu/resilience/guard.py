@@ -99,6 +99,15 @@ def guarded(
             detail=f"deadline={deadline:.1f}s",
             log=log or logger,
         )
+        # the watchdog firing is exactly the moment evidence is about to
+        # be lost (the runtime may never return): leave the black box
+        from ..telemetry.flight_recorder import note_failure
+
+        note_failure(
+            "dispatch_timeout",
+            detail=f"label={label} deadline={deadline:.1f}s",
+            log=log or logger,
+        )
         raise DispatchTimeout(label, deadline)
     if failure:
         raise failure[0]

@@ -281,6 +281,23 @@ def retry_call(
                 or attempt >= policy.max_attempts
                 or (action == "oom" and oom_count >= policy.oom_attempts)
             ):
+                if action != "fatal" and action in policy.retryable:
+                    # a RECOVERABLE failure class exhausted its attempt
+                    # budget — the fit is about to die with its evidence:
+                    # dump the flight-recorder black box before the raise
+                    # (fatal errors propagate on the FIRST raise and are
+                    # the caller's bug to read from the traceback)
+                    from ..telemetry.flight_recorder import note_failure
+
+                    note_failure(
+                        "retry_exhausted",
+                        detail=(
+                            f"label={label} action={action} "
+                            f"attempt={attempt} "
+                            f"error={type(e).__name__}: {e}"
+                        ),
+                        log=lg,
+                    )
                 raise
             err_desc = f"{type(e).__name__}: {e}"
         # the retry runs OUTSIDE the except block: while handling, the

@@ -26,6 +26,7 @@ from typing import Any, Dict
 
 import numpy as np
 
+from ..tracing import mint_run_id
 from ..utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.serving")
@@ -111,10 +112,28 @@ def start_serving_http(server, port: int, host: str = "127.0.0.1"):
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": f"malformed request: {e}"})
                 return
+            # request-scoped tracing crosses the HTTP boundary: a caller-
+            # supplied X-Request-Id becomes the request's trace identity
+            # (exemplars, dispatch-span details, slow captures); absent,
+            # one is minted HERE at ingress — either way the response
+            # names it (429 rejections included: those are exactly the
+            # requests an operator wants to correlate), so a client log
+            # line joins the server's latency exemplars
+            req_id = (
+                (self.headers.get("X-Request-Id") or "").strip()
+                or mint_run_id("req")
+            )
             try:
-                outs = server.transform(name, X, timeout=REQUEST_TIMEOUT_S)
+                outs = server.submit(
+                    name, X, request_id=req_id
+                ).result(timeout=REQUEST_TIMEOUT_S)
             except ServingOverload as e:
-                self._reply(429, {"error": str(e), "reason": e.reason})
+                # the rejected requests are the ones an operator most
+                # wants to correlate: the reply names the id too
+                self._reply(429, {
+                    "error": str(e), "reason": e.reason,
+                    "request_id": req_id,
+                })
             except KeyError as e:
                 self._reply(404, {"error": str(e)})
             except ValueError as e:
@@ -122,14 +141,19 @@ def start_serving_http(server, port: int, host: str = "127.0.0.1"):
             except FuturesTimeoutError:
                 self._reply(504, {
                     "error": f"no result within {REQUEST_TIMEOUT_S:.0f}s "
-                    "(serving dispatcher stalled?)"
+                    "(serving dispatcher stalled?)",
+                    "request_id": req_id,
                 })
             except Exception as e:  # a failed dispatch, not a bad request
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(500, {
+                    "error": f"{type(e).__name__}: {e}",
+                    "request_id": req_id,
+                })
             else:
                 self._reply(200, {
                     "model": name,
                     "rows": int(X.shape[0]) if X.ndim == 2 else 1,
+                    "request_id": req_id,
                     "outputs": _jsonable(outs),
                 })
 

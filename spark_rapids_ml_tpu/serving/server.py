@@ -33,8 +33,15 @@ from typing import Any, Deque, Dict, List, Optional
 import numpy as np
 
 from ..config import get_config
-from ..telemetry.registry import counter, histogram
-from ..tracing import adopt_trace_context, event, trace
+from ..telemetry.registry import counter, gauge, histogram
+from ..tracing import (
+    adopt_trace_context,
+    event,
+    get_trace_events,
+    mint_run_id,
+    run_context,
+    trace,
+)
 from ..utils import get_logger
 from .registry import ModelRegistry, PinnedModel
 
@@ -68,6 +75,11 @@ REJECTIONS = counter(
     "serving_rejections_total",
     "Rejected serving requests by model and reason",
 )
+SLO_BURN = gauge(
+    "slo_burn_rate",
+    "Measured over-p99-target request fraction / the 1% error budget, "
+    "per model and window",
+)
 
 # exact per-model latency samples for the p50/p99 report (the registry
 # histogram's buckets are for Prometheus; percentiles in the per-model
@@ -77,6 +89,24 @@ _REPORT_SAMPLES = 4096
 # clean batches between each doubling of an OOM-shrunk coalescing cap
 # back toward the configured value
 _CAP_REGROW_BATCHES = 32
+
+# SLO burn-rate windows the sensor gauges report over (label value ->
+# seconds); the budget is the 1% a p99 target implies
+_SLO_WINDOWS = (("1m", 60.0), ("5m", 300.0))
+_SLO_BUDGET = 0.01
+# burn gauges refresh at most once per this many seconds per model (the
+# window scan walks a bounded deque; no reason to pay it per request)
+_SLO_REFRESH_S = 1.0
+
+# slow-request span-tree captures retained (operator post-hoc view; the
+# flight recorder keeps the longer process-wide history)
+_MAX_SLOW_TRACES = 32
+
+# sustained-overload detection: this many queue_full rejections inside
+# the window trips ONE flight-recorder post-mortem (then the recorder's
+# own per-reason cooldown applies)
+_OVERLOAD_DUMP_COUNT = 20
+_OVERLOAD_WINDOW_S = 5.0
 
 
 class ServingOverload(RuntimeError):
@@ -94,9 +124,13 @@ class ServingOverload(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("model", "X", "rows", "t_enqueue", "future", "attempts")
+    __slots__ = (
+        "model", "X", "rows", "t_enqueue", "future", "attempts", "req_id",
+    )
 
-    def __init__(self, model: str, X: np.ndarray) -> None:
+    def __init__(
+        self, model: str, X: np.ndarray, request_id: Optional[str] = None
+    ) -> None:
         self.model = model
         self.X = X
         self.rows = int(X.shape[0])
@@ -107,6 +141,11 @@ class _Request:
         # can neither exhaust another model's attempts nor ride interleaved
         # successes to retry forever
         self.attempts = 0
+        # the request's trace identity: minted at ingress (or adopted
+        # from the caller's X-Request-Id), carried through the batch
+        # dispatch spans and attached to the latency observations as an
+        # exemplar — the join key between a latency bucket and a trace
+        self.req_id = request_id or mint_run_id("req")
 
 
 class _InFlight:
@@ -115,10 +154,10 @@ class _InFlight:
     outputs (or already-host outputs for host-path models)."""
 
     __slots__ = ("name", "model", "reqs", "rows", "stager", "dev",
-                 "host_outs", "t_dispatch")
+                 "host_outs", "t_dispatch", "batch_id")
 
     def __init__(self, name, model, reqs, rows, stager, dev, host_outs,
-                 t_dispatch) -> None:
+                 t_dispatch, batch_id="") -> None:
         self.name = name
         # the dispatched model rides the flight: collect must fetch with
         # the SAME object the device outputs came from — a registry
@@ -133,6 +172,10 @@ class _InFlight:
         self.dev = dev
         self.host_outs = host_outs
         self.t_dispatch = t_dispatch
+        # the run id the batch's dispatch/collect spans carry: collect
+        # re-enters it so the whole queue->scatter tree of one batch
+        # correlates, and the slow-request capture filters by it
+        self.batch_id = batch_id
 
 
 class ServingServer:
@@ -169,6 +212,23 @@ class ServingServer:
         self._req_counts: Dict[str, int] = {}
         self._rej_counts: Dict[str, int] = {}
         self._lock = threading.Lock()  # report/latency state
+        # request-scoped tracing + SLO sensing state:
+        #   _lat_ts     per-model (monotonic_t, total_s) samples feeding
+        #               the windowed burn-rate scan (bounded like _lat)
+        #   _slo_last   per-model monotonic time of the last burn refresh
+        #   _slow       captured span trees of slow requests (bounded)
+        #   _overload_ts queue_full rejection timestamps for the
+        #               sustained-overload flight-recorder trigger
+        self._lat_ts: Dict[str, Deque[tuple]] = {}
+        self._slo_last: Dict[str, float] = {}
+        self._slow: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=_MAX_SLOW_TRACES
+        )
+        self._overload_ts: Deque[float] = collections.deque(
+            maxlen=_OVERLOAD_DUMP_COUNT
+        )
+        # serving_slo_targets parse memo: (conf string, parsed dict)
+        self._slo_targets_memo: tuple = ("", {})
 
     # -- registration (delegates; kept here so one object serves) ----------
 
@@ -268,11 +328,19 @@ class ServingServer:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, name: str, X: Any) -> Future:
+    def submit(
+        self, name: str, X: Any, request_id: Optional[str] = None
+    ) -> Future:
         """Enqueue one transform request; returns a Future resolving to
         `{output_col: np.ndarray}` with one row per input row.  Raises
         `ServingOverload` at the admission gate (never enqueued) and
-        KeyError/ValueError for unknown models / wrong feature width."""
+        KeyError/ValueError for unknown models / wrong feature width.
+
+        Every admitted request gets a REQUEST ID (minted here, or
+        `request_id` when the caller/HTTP ingress supplies one):
+        exposed as `.request_id` on the returned Future, carried through
+        the batch's dispatch spans, and attached to the latency
+        observations as an exemplar."""
         info = self.registry.info(name)  # KeyError for unknown models
         X = np.asarray(X)
         if X.ndim == 1:
@@ -292,34 +360,76 @@ class ServingServer:
             raise ValueError(
                 f"model {name!r} expects {want} features, got {X.shape[1]}"
             )
-        req = _Request(name, X)
+        req = _Request(name, X, request_id=request_id)
+        req.future.request_id = req.req_id
+        overload_detail = ""
         with self._cv:
             if not self._running:
                 REJECTIONS.inc(model=name, reason="stopped")
                 raise ServingOverload(name, "stopped", "server not running")
-            if self._queued >= self._max_queue():
+            admitted = self._queued < self._max_queue()
+            if not admitted:
                 REJECTIONS.inc(model=name, reason="queue_full")
                 with self._lock:
                     self._rej_counts[name] = (
                         self._rej_counts.get(name, 0) + 1
                     )
-                raise ServingOverload(
-                    name, "queue_full",
-                    f"{self._queued} requests queued "
-                    f"(serving_max_queue={self._max_queue()})",
+                overload_detail = self._note_overload_locked(name)
+                queued = self._queued
+            else:
+                self._queues.setdefault(
+                    name, collections.deque()
+                ).append(req)
+                self._queued += 1
+                self._cv.notify_all()
+        if not admitted:
+            if overload_detail:
+                # the dump runs OUTSIDE the cv (it writes files); the
+                # recorder's per-reason cooldown absorbs the rest of the
+                # storm racing here
+                from ..telemetry.flight_recorder import note_failure
+
+                note_failure(
+                    "serving_overload", detail=overload_detail, log=logger
                 )
-            self._queues.setdefault(name, collections.deque()).append(req)
-            self._queued += 1
-            self._cv.notify_all()
+            raise ServingOverload(
+                name, "queue_full",
+                f"{queued} requests queued "
+                f"(serving_max_queue={self._max_queue()})",
+            )
         REQUESTS.inc(model=name)
         with self._lock:
             self._req_counts[name] = self._req_counts.get(name, 0) + 1
         return req.future
 
+    def _note_overload_locked(self, name: str) -> str:
+        """Called (under the cv) on every queue_full rejection: a burst
+        of `_OVERLOAD_DUMP_COUNT` rejections inside `_OVERLOAD_WINDOW_S`
+        is SUSTAINED overload — the typed failure the flight recorder
+        should leave a black box for.  Returns the dump detail string
+        when the threshold trips (the caller dumps after releasing the
+        cv), else ''."""
+        now = time.monotonic()
+        self._overload_ts.append(now)
+        if (
+            len(self._overload_ts) == self._overload_ts.maxlen
+            and now - self._overload_ts[0] <= _OVERLOAD_WINDOW_S
+        ):
+            return (
+                f"model={name} queued={self._queued} "
+                f"max_queue={self._max_queue()} "
+                f"{len(self._overload_ts)} rejections in "
+                f"{now - self._overload_ts[0]:.2f}s"
+            )
+        return ""
+
     def transform(self, name: str, X: Any,
-                  timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+                  timeout: Optional[float] = None,
+                  request_id: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Blocking convenience over `submit`."""
-        return self.submit(name, X).result(timeout=timeout)
+        return self.submit(
+            name, X, request_id=request_id
+        ).result(timeout=timeout)
 
     # -- report --------------------------------------------------------------
 
@@ -355,11 +465,23 @@ class ServingServer:
                     p99_ms=round(_pct(0.99) * 1e3, 3),
                     mean_ms=round(sum(srt) / len(srt) * 1e3, 3),
                 )
+            target_s = self._slo_target_s(name)
+            if target_s > 0:
+                entry["slo_p99_target_ms"] = round(target_s * 1e3, 3)
+                for window, _span in _SLO_WINDOWS:
+                    burn = SLO_BURN.value(
+                        default=None, model=name, window=window
+                    )
+                    if burn is not None:
+                        entry[f"slo_burn_{window}"] = burn
             out[name] = entry
+        with self._lock:
+            n_slow = len(self._slow)
         out["_totals"] = {
             "batches": self._batches,
             "queued": self._queued,
             "pinned_bytes": self.registry.pinned_bytes(),
+            "slow_traces": n_slow,
         }
         return out
 
@@ -493,7 +615,14 @@ class ServingServer:
                         break  # collect finished work instead of idling
                     if draining and self._queued == 0:
                         break
-                    self._cv.wait(timeout=self._next_deadline_locked(now))
+                    if not self._cv.wait(
+                        timeout=self._next_deadline_locked(now)
+                    ):
+                        # timed-out idle tick: break to the outer loop so
+                        # _refresh_slo_all runs (burn gauges must decay
+                        # when traffic STOPS; with work ready the very
+                        # next inner pass picks it up)
+                        break
             if batch is None and pending is None:
                 with self._cv:
                     if not self._running and self._queued == 0:
@@ -502,6 +631,7 @@ class ServingServer:
                         # exit cannot interleave into a dead server
                         self._loop_done = True
                         return
+                self._refresh_slo_all()
                 continue
             # phase-separated failure attribution: a dispatch error
             # belongs to THIS batch only — the pending batch of a
@@ -532,11 +662,26 @@ class ServingServer:
 
     # -- dispatch / collect --------------------------------------------------
 
+    @staticmethod
+    def _req_id_detail(reqs: List[_Request]) -> str:
+        """Bounded request-id list for span details (the ids are the
+        exemplar join keys; a 4096-row batch must not serialize 4096 of
+        them into one detail string)."""
+        ids = [r.req_id for r in reqs[:8]]
+        more = len(reqs) - len(ids)
+        return ",".join(ids) + (f",+{more}" if more > 0 else "")
+
     def _dispatch(self, reqs: List[_Request]) -> _InFlight:
         """Stage one coalesced batch and launch its device program (jax
         dispatch is async — the transfer/compute are in flight when this
         returns).  Host-path models (no `_transform_device`) compute
-        synchronously here instead."""
+        synchronously here instead.
+
+        The whole batch runs under a minted `batch-<hex>` run id: the
+        dispatch span and its coalesce/stage/compute children (and the
+        collect/scatter spans next round) all carry it, so one request's
+        path through the server reconstructs as one tree — the
+        slow-request capture and the flight recorder both key off it."""
         from ..parallel.mesh import RowStager
         from ..resilience import maybe_inject
 
@@ -544,35 +689,55 @@ class ServingServer:
         pinned: PinnedModel = self.registry.resolve(name)
         rows = sum(r.rows for r in reqs)
         t0 = time.perf_counter()
-        with trace(f"serving_dispatch[{name}]", logger):
-            maybe_inject("serving_dispatch")
-            X = (
-                reqs[0].X
-                if len(reqs) == 1
-                else np.concatenate([r.X for r in reqs], axis=0)
-            )
-            BATCH_ROWS.observe(rows, model=name)
-            if not pinned.device:
-                X = np.ascontiguousarray(X, dtype=pinned.dtype)
-                outs = pinned.transform_fn(X)
-                return _InFlight(
-                    name, pinned.model, reqs, rows, None, None, outs, t0
+        with run_context(prefix="batch") as batch_id:
+            with trace(f"serving_dispatch[{name}]", logger):
+                event(
+                    f"serving_batch[{name}]",
+                    detail=(
+                        f"rows={rows} reqs={len(reqs)} "
+                        f"ids={self._req_id_detail(reqs)}"
+                    ),
                 )
-            # telemetry=False: the per-staging instrumentation (device
-            # census, dataset_stagings bump, byte prediction) is fit-
-            # scale bookkeeping a request-rate micro-batch must not pay
-            st = RowStager.for_replicated(
-                rows, pinned.mesh, telemetry=False
-            )
-            Xs = st.stage(np.ascontiguousarray(X), pinned.dtype)
-            dev = pinned.model._transform_device(Xs)
-        return _InFlight(name, pinned.model, reqs, rows, st, dev, None, t0)
+                maybe_inject("serving_dispatch")
+                with trace("serving_coalesce", logger):
+                    X = (
+                        reqs[0].X
+                        if len(reqs) == 1
+                        else np.concatenate([r.X for r in reqs], axis=0)
+                    )
+                BATCH_ROWS.observe(rows, model=name)
+                if not pinned.device:
+                    with trace("serving_compute", logger):
+                        X = np.ascontiguousarray(X, dtype=pinned.dtype)
+                        outs = pinned.transform_fn(X)
+                    return _InFlight(
+                        name, pinned.model, reqs, rows, None, None, outs,
+                        t0, batch_id,
+                    )
+                # telemetry=False: the per-staging instrumentation (device
+                # census, dataset_stagings bump, byte prediction) is fit-
+                # scale bookkeeping a request-rate micro-batch must not pay
+                with trace("serving_stage", logger):
+                    st = RowStager.for_replicated(
+                        rows, pinned.mesh, telemetry=False
+                    )
+                    Xs = st.stage(np.ascontiguousarray(X), pinned.dtype)
+                with trace("serving_compute", logger):
+                    dev = pinned.model._transform_device(Xs)
+        return _InFlight(
+            name, pinned.model, reqs, rows, st, dev, None, t0, batch_id
+        )
 
     def _collect(self, flight: _InFlight) -> None:
         """Fetch one in-flight batch (the sync point) and scatter each
         request's row slice to its future.  Futures resolve only after
         EVERY column fetched, so a mid-fetch failure retries the whole
-        batch without partial results escaping."""
+        batch without partial results escaping.  Runs under the batch's
+        run id, so the collect/scatter spans join the dispatch tree."""
+        with run_context(flight.batch_id or None):
+            self._collect_traced(flight)
+
+    def _collect_traced(self, flight: _InFlight) -> None:
         if flight.host_outs is not None:
             outs = flight.host_outs
         else:
@@ -581,32 +746,182 @@ class ServingServer:
                     flight.stager, flight.dev
                 )
         t_done = time.perf_counter()
+        slow_s = (
+            max(0.0, float(get_config("serving_slow_trace_ms"))) / 1e3
+        )
+        slow_hits: List[tuple] = []
         lo = 0
         with self._lock:
             lat = self._lat.setdefault(
                 flight.name, collections.deque(maxlen=_REPORT_SAMPLES)
             )
-        for r in flight.reqs:
-            sl = {c: v[lo : lo + r.rows] for c, v in outs.items()}
-            lo += r.rows
-            if r.future.done():
-                # cancelled by the caller while queued/in flight, or
-                # resolved by an earlier partially-scattered attempt a
-                # failure requeued — either way, publishing would raise
-                # InvalidStateError and poison the co-batched requests
-                continue
-            q_s = max(flight.t_dispatch - r.t_enqueue, 0.0)
-            d_s = max(t_done - flight.t_dispatch, 0.0)
-            tot = max(t_done - r.t_enqueue, 0.0)
-            LATENCY.observe(q_s, model=flight.name, phase="queue")
-            LATENCY.observe(d_s, model=flight.name, phase="dispatch")
-            LATENCY.observe(tot, model=flight.name, phase="total")
+            lat_ts = self._lat_ts.setdefault(
+                flight.name, collections.deque(maxlen=_REPORT_SAMPLES)
+            )
+        with trace("serving_scatter", logger):
+            now_mono = time.monotonic()
+            for r in flight.reqs:
+                sl = {c: v[lo : lo + r.rows] for c, v in outs.items()}
+                lo += r.rows
+                if r.future.done():
+                    # cancelled by the caller while queued/in flight, or
+                    # resolved by an earlier partially-scattered attempt a
+                    # failure requeued — either way, publishing would raise
+                    # InvalidStateError and poison the co-batched requests
+                    continue
+                q_s = max(flight.t_dispatch - r.t_enqueue, 0.0)
+                d_s = max(t_done - flight.t_dispatch, 0.0)
+                tot = max(t_done - r.t_enqueue, 0.0)
+                LATENCY.observe(
+                    q_s, exemplar=r.req_id, model=flight.name, phase="queue"
+                )
+                LATENCY.observe(
+                    d_s, exemplar=r.req_id,
+                    model=flight.name, phase="dispatch",
+                )
+                LATENCY.observe(
+                    tot, exemplar=r.req_id, model=flight.name, phase="total"
+                )
+                with self._lock:
+                    lat.append(tot)
+                    lat_ts.append((now_mono, tot))
+                if slow_s > 0 and tot >= slow_s:
+                    slow_hits.append((r.req_id, tot))
+                try:
+                    r.future.set_result(sl)
+                except Exception:
+                    pass  # cancelled in the race window; result dropped
+        if slow_hits:
+            self._capture_slow(flight, slow_hits)
+        # refresh EVERY served model, not just this flight's: a model
+        # whose traffic stopped must decay even while the dispatcher
+        # stays busy with other models' batches (the per-model rate
+        # limit inside _update_slo bounds the cost to ~1 scan/s/model)
+        self._refresh_slo_all()
+
+    def _capture_slow(
+        self, flight: _InFlight, hits: List[tuple]
+    ) -> None:
+        """A request breached the `serving_slow_trace_ms` threshold:
+        keep the batch's FULL span tree (queue wait is implicit in the
+        phase observations; dispatch -> coalesce/stage/compute ->
+        collect/scatter are the recorded spans, filtered by the batch's
+        run id from this dispatcher thread's bounded buffer) plus the
+        breaching request ids — the operator's "what did THAT request
+        hit" view, without pre-arming anything."""
+        from ..telemetry.report import span_tree
+
+        try:
+            events = [
+                e for e in get_trace_events()
+                if e.run_id == flight.batch_id
+            ]
+            entry = {
+                "model": flight.name,
+                "batch_id": flight.batch_id,
+                "batch_rows": flight.rows,
+                "requests": [
+                    {"request_id": rid, "total_ms": round(tot * 1e3, 3)}
+                    for rid, tot in hits
+                ],
+                "spans": span_tree(events),
+            }
             with self._lock:
-                lat.append(tot)
-            try:
-                r.future.set_result(sl)
-            except Exception:
-                pass  # cancelled in the race window above; result dropped
+                self._slow.append(entry)
+            event(
+                f"serving_slow[{flight.name}]",
+                detail=self._req_id_detail(
+                    [r for r in flight.reqs
+                     if r.req_id in {rid for rid, _ in hits}]
+                ),
+                log=logger,
+            )
+        except Exception as e:  # capture must never fail the scatter
+            logger.warning(f"slow-request capture failed ({e})")
+
+    def slow_traces(self) -> List[Dict[str, Any]]:
+        """Captured span trees of requests that breached
+        `serving_slow_trace_ms` (newest last, bounded)."""
+        with self._lock:
+            return list(self._slow)
+
+    # -- SLO sensing ---------------------------------------------------------
+
+    def _slo_target_s(self, name: str) -> float:
+        """The model's declared p99 target in seconds (0 = no SLO):
+        `serving_slo_targets` ("model=ms,...") overrides the
+        `serving_slo_p99_ms` default."""
+        spec = str(get_config("serving_slo_targets") or "")
+        with self._lock:
+            memo_spec, table = self._slo_targets_memo
+            if spec != memo_spec:
+                table = {}
+                for entry in spec.split(","):
+                    entry = entry.strip()
+                    if not entry:
+                        continue
+                    model, _, ms = entry.partition("=")
+                    try:
+                        table[model.strip()] = float(ms)
+                    except ValueError:
+                        logger.warning(
+                            f"serving_slo_targets entry {entry!r} is not "
+                            "'model=ms'; ignored"
+                        )
+                self._slo_targets_memo = (spec, table)
+        ms = table.get(name)
+        if ms is None:
+            ms = float(get_config("serving_slo_p99_ms") or 0.0)
+        return max(0.0, ms) / 1e3
+
+    def _update_slo(self, name: str) -> None:
+        """Refresh `slo_burn_rate{model,window}` from the recent
+        latency samples: (fraction of window requests over the p99
+        target) / the 1% budget.  1.0 = exactly on budget; 2.0 = the
+        error budget burns twice as fast as it accrues — the signal the
+        planned coalescing-cap controller will consume (ROADMAP item
+        2).  Rate-limited per model; no-op when no target is declared."""
+        target_s = self._slo_target_s(name)
+        if target_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._slo_last.get(name, 0.0) < _SLO_REFRESH_S:
+                return
+            self._slo_last[name] = now
+            samples = list(self._lat_ts.get(name, ()))
+        for window, span_s in _SLO_WINDOWS:
+            recent = [tot for t, tot in samples if now - t <= span_s]
+            if not recent:
+                # an empty window is ZERO burn, not "whatever the last
+                # burst left behind": without this a 100x spike would
+                # read as live forever once traffic stops (the same
+                # stale-gauge class Heartbeat.close fixes for solvers)
+                frac_over = 0.0
+            else:
+                frac_over = sum(
+                    1 for tot in recent if tot > target_s
+                ) / len(recent)
+            SLO_BURN.set(
+                round(frac_over / _SLO_BUDGET, 4),
+                model=name, window=window,
+            )
+
+    def _refresh_slo_all(self) -> None:
+        """Dispatcher idle tick: burn-rate gauges keep decaying toward
+        the truth even when no batch collects (a model whose traffic
+        STOPPED must not scrape as burning; `_update_slo`'s own
+        per-model rate limit bounds the cost).  Only models that have
+        SERVED are refreshed — decay maintains existing series, it must
+        not mint a 0.0 series for a model no request ever touched."""
+        try:
+            for name in self.registry.names():
+                with self._lock:
+                    served = bool(self._lat_ts.get(name))
+                if served:
+                    self._update_slo(name)
+        except Exception:  # gauge upkeep must never wedge the loop
+            pass
 
     # -- degradation ---------------------------------------------------------
 
@@ -676,6 +991,20 @@ class ServingServer:
                 f"({type(e).__name__}: {e}); failing {len(doomed)} "
                 "request(s)"
             )
+            if action != "fatal":
+                # a recoverable class exhausted its per-request budget:
+                # same black-box contract as retry_call's exhaustion path
+                from ..telemetry.flight_recorder import note_failure
+
+                note_failure(
+                    "retry_exhausted",
+                    detail=(
+                        f"label=serving_dispatch action={action} "
+                        f"doomed={len(doomed)} "
+                        f"error={type(e).__name__}: {e}"
+                    ),
+                    log=logger,
+                )
             for r in doomed:
                 if not r.future.done():
                     r.future.set_exception(e)
@@ -743,15 +1072,22 @@ class ServingClient:
     def __init__(self, server: ServingServer) -> None:
         self._server = server
 
-    def submit(self, model: str, X: Any) -> Future:
-        return self._server.submit(model, X)
+    def submit(self, model: str, X: Any,
+               request_id: Optional[str] = None) -> Future:
+        """Enqueue; the returned Future carries `.request_id` (minted
+        here unless the caller supplies one) — the id the latency
+        exemplars and dispatch spans carry."""
+        return self._server.submit(model, X, request_id=request_id)
 
     def transform(self, model: str, X: Any,
-                  timeout: Optional[float] = None) -> Any:
+                  timeout: Optional[float] = None,
+                  request_id: Optional[str] = None) -> Any:
         """Transform rows; a single-output model returns the bare array
         (matching `Model.transform`'s array-input contract), multi-output
         models return `{col: array}`."""
-        outs = self._server.transform(model, X, timeout=timeout)
+        outs = self._server.transform(
+            model, X, timeout=timeout, request_id=request_id
+        )
         if len(outs) == 1:
             return next(iter(outs.values()))
         return outs

@@ -1773,6 +1773,10 @@ def kmeans_streaming_fit(
             break
     # final cost under the final centers
     _, _, cost = one_pass(C_host)
+    # end-mark on normal completion (Heartbeat.close) AFTER the final
+    # cost pass: a death before the result exists keeps the solver
+    # gauges visible for the flight recorder's post-mortem
+    hb.close()
     if checkpoint_path:
         clear_checkpoint(checkpoint_path)
     logger.info(

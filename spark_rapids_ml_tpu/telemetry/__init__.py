@@ -44,6 +44,11 @@
 # Like resilience/, this package imports neither jax nor numpy at module
 # scope: reading a counter must not pay the accelerator import.
 #
+from .aggregate import (  # noqa: F401
+    dump_merged,
+    merge_prometheus,
+    scrape_endpoints,
+)
 from .compile import (  # noqa: F401
     compile_label,
     compile_span,
@@ -56,8 +61,15 @@ from .exporters import (  # noqa: F401
     dump_prometheus,
     maybe_start_http_server,
     parse_prometheus,
+    parse_prometheus_families,
+    render_families,
     start_http_server,
     stop_http_server,
+)
+from .flight_recorder import (  # noqa: F401
+    RECORDER,
+    FlightRecorder,
+    note_failure,
 )
 from .heartbeat import Heartbeat  # noqa: F401
 from .memory import (  # noqa: F401
@@ -86,14 +98,25 @@ from .registry import (  # noqa: F401
 )
 from .report import FitTelemetry, solver_summary, span_tree  # noqa: F401
 
+# the flight recorder is ALWAYS-ON by design: hook it onto the tracing
+# tap as soon as the telemetry package loads (every fit/serving path
+# imports it), so the black box is recording before the first span.  The
+# `flight_recorder` conf gates recording itself, re-read cheaply inside
+# record().
+from .flight_recorder import install as _install_flight_recorder  # noqa: E402
+
+_install_flight_recorder()
+
 __all__ = [
     "DictView",
     "FitMemoryWatermark",
     "FitTelemetry",
+    "FlightRecorder",
     "Heartbeat",
     "METRIC_CATALOG",
     "Metric",
     "MetricsRegistry",
+    "RECORDER",
     "REGISTRY",
     "SimulatedMemoryProvider",
     "check_cardinality",
@@ -104,19 +127,25 @@ __all__ = [
     "delta",
     "dict_view",
     "dump_chrome_trace",
+    "dump_merged",
     "dump_prometheus",
     "gauge",
     "get_provider",
     "histogram",
     "install_jax_listener",
     "maybe_start_http_server",
+    "merge_prometheus",
+    "note_failure",
     "note_recompile",
     "parse_prometheus",
+    "parse_prometheus_families",
     "record_budget_decision",
     "record_prediction",
+    "render_families",
     "reset_memory_telemetry",
     "reset_metrics",
     "sample_devices",
+    "scrape_endpoints",
     "snapshot",
     "solver_summary",
     "span_tree",

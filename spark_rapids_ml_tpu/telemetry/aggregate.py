@@ -1,0 +1,214 @@
+#
+# Cross-process metric aggregation — one fleet, one page.  Every process
+# of a multi-host pod (and every serving front end) keeps its OWN
+# registry; until now two processes could not merge them, so "how many
+# retries did the fleet take" meant ssh-ing into N hosts.  This module
+# merges per-process Prometheus pages (the existing text round-trip —
+# exporters.dump_prometheus / parse_prometheus_families — is the wire
+# format, so a page can come from an in-process dump, a file a rank
+# wrote, or a scrape of a per-host `telemetry_port` endpoint) by family:
+#
+#   counters     SUM across processes per labelset — `retries_total`
+#                over the fleet is exact, not approximate
+#   gauges       keep per-process series, tagged with a `process` label
+#                (summing point-in-time values like `solver_iteration`
+#                or resident-byte gauges would manufacture nonsense)
+#   histograms   merge BUCKET-WISE per labelset: per-`le` counts, sums
+#                and totals add (cumulative buckets stay cumulative), so
+#                fleet-level latency quantiles come out of the merged
+#                buckets with no per-process resampling
+#   untyped      treated like gauges (per-process, labeled)
+#
+# A process that is GONE is reported absent — `scrape_endpoints` returns
+# the failed targets separately instead of folding zeros into the merge
+# (a dead rank showing `retries_total 0` would read as "healthy and
+# idle", the exact lie an aggregator must not tell).
+#
+# Pure stdlib, no jax import: aggregation runs on whatever box watches
+# the fleet.
+#
+from __future__ import annotations
+
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from .exporters import parse_prometheus_families, render_families
+
+LabelPairs = Tuple[Tuple[str, str], ...]
+
+
+def _with_process(labels: LabelPairs, process: str) -> LabelPairs:
+    """Tag a series with the process it came from.  A series that
+    ALREADY carries a `process` label (this page is itself a merge —
+    the tiered host -> pod -> fleet case) gets namespaced
+    (`pod1/hostA`), never a duplicate label name: duplicate names make
+    the rendered page invalid and subset matches ambiguous."""
+    nested = None
+    rest = []
+    for k, v in labels:
+        if k == "process":
+            nested = v
+        else:
+            rest.append((k, v))
+    tag = f"{process}/{nested}" if nested else str(process)
+    return tuple(sorted(rest + [("process", tag)]))
+
+
+def merge_prometheus(pages: Dict[str, str]) -> Dict[str, Dict[str, Any]]:
+    """Merge `{process_name: prometheus_text}` pages into one family
+    table (the `parse_prometheus_families` structure): counters sum,
+    gauges/untyped keep per-process series under a `process` label,
+    histograms merge bucket-wise.  Families only some processes report
+    merge over the reporters; a page that fails to parse raises (a torn
+    scrape must not silently vanish from the fleet view).  Render the
+    result with `dump_merged`."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for process in sorted(pages):
+        fams = parse_prometheus_families(pages[process])
+        for name, entry in fams.items():
+            kind = entry.get("kind", "untyped")
+            tgt = merged.setdefault(
+                name,
+                {"kind": kind, "help": entry.get("help", ""), "samples": {}},
+            )
+            if tgt["kind"] != kind and tgt["kind"] == "untyped":
+                tgt["kind"] = kind  # a later page knew the type
+            if not tgt.get("help") and entry.get("help"):
+                tgt["help"] = entry["help"]
+            out = tgt["samples"]
+            if kind == "counter":
+                for lk, v in entry["samples"].items():
+                    out[lk] = out.get(lk, 0) + v
+            elif kind == "histogram":
+                for lk, h in entry["samples"].items():
+                    acc = out.setdefault(
+                        lk, {"buckets": {}, "sum": 0.0, "count": 0}
+                    )
+                    for le, c in h["buckets"].items():
+                        acc["buckets"][le] = acc["buckets"].get(le, 0) + c
+                    acc["sum"] += h["sum"]
+                    acc["count"] += h["count"]
+            else:  # gauge / untyped: per-process series
+                for lk, v in entry["samples"].items():
+                    out[_with_process(lk, process)] = v
+    return merged
+
+
+def dump_merged(merged: Dict[str, Dict[str, Any]]) -> str:
+    """A merged family table as Prometheus text — itself parseable by
+    `parse_prometheus_families`, so aggregation tiers stack (host pages
+    -> pod page -> fleet page)."""
+    return render_families(merged)
+
+
+class ScrapeResult:
+    """One aggregation round over per-host endpoints: the pages that
+    answered, the merged family table, and — separately — the targets
+    that did NOT answer.  `absent` maps the dead process name to the
+    error string; its series are MISSING from `merged`, never zero."""
+
+    def __init__(
+        self,
+        pages: Dict[str, str],
+        absent: Dict[str, str],
+    ) -> None:
+        self.pages = pages
+        self.absent = absent
+        self.merged = merge_prometheus(pages)
+
+    def dump(self) -> str:
+        return dump_merged(self.merged)
+
+    def __repr__(self) -> str:
+        return (
+            f"ScrapeResult(processes={sorted(self.pages)}, "
+            f"absent={sorted(self.absent)})"
+        )
+
+
+def scrape_endpoints(
+    targets: Dict[str, str], timeout_s: float = 5.0
+) -> ScrapeResult:
+    """Scrape `{process_name: url}` `telemetry_port` endpoints (each url
+    is the full `http://host:port/metrics`) and merge what answered.
+    Unreachable/erroring endpoints land in `.absent` with the error —
+    the fleet view names its blind spots instead of zero-filling them.
+    Targets fetch CONCURRENTLY (bounded pool), so a round over a fleet
+    with dead hosts costs ~one timeout, not one per dead host."""
+
+    def _fetch(url: str) -> str:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.read().decode("utf-8")
+
+    pages: Dict[str, str] = {}
+    absent: Dict[str, str] = {}
+    names = sorted(targets)
+    if names:
+        with ThreadPoolExecutor(
+            max_workers=min(32, len(names)), thread_name_prefix="scrape"
+        ) as pool:
+            futs = {n: pool.submit(_fetch, targets[n]) for n in names}
+        for name in names:
+            try:
+                pages[name] = futs[name].result()
+            except Exception as e:
+                absent[name] = f"{type(e).__name__}: {e}"
+    return ScrapeResult(pages, absent)
+
+
+def endpoints_for_hosts(
+    hosts: Iterable[str], port: int, scheme: str = "http"
+) -> Dict[str, str]:
+    """Convenience: the `{host: url}` target table for a fleet whose
+    processes all serve `/metrics` on one `telemetry_port`."""
+    return {
+        str(h): f"{scheme}://{h}:{int(port)}/metrics" for h in hosts
+    }
+
+
+def counter_total(
+    merged: Dict[str, Dict[str, Any]],
+    family: str,
+    **labels: str,
+) -> Optional[Any]:
+    """Sum of a merged counter family's samples matching `labels`
+    (subset match over the label pairs); None when the family is absent.
+    The one-liner tests and dashboards want for 'fleet-wide
+    retries_total{action=oom}'."""
+    fam = merged.get(family)
+    if fam is None:
+        return None
+    want = set((str(k), str(v)) for k, v in labels.items())
+    total: Any = 0
+    seen = False
+    for lk, v in fam.get("samples", {}).items():
+        if want <= set(lk):
+            total += v
+            seen = True
+    return total if seen else None
+
+
+def merge_pages_from_files(
+    paths: Dict[str, str],
+) -> Dict[str, Dict[str, Any]]:
+    """Merge pages ranks wrote to disk (`{process_name: path}`) — the
+    no-network form multi-process CI uses: each rank calls
+    `dump_prometheus()` into a shared directory, the controller merges
+    after the barrier."""
+    pages = {}
+    for name in sorted(paths):
+        with open(paths[name], "r") as f:
+            pages[name] = f.read()
+    return merge_prometheus(pages)
+
+
+__all__ = [
+    "ScrapeResult",
+    "counter_total",
+    "dump_merged",
+    "endpoints_for_hosts",
+    "merge_pages_from_files",
+    "merge_prometheus",
+    "scrape_endpoints",
+]

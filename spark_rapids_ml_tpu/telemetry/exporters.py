@@ -33,11 +33,29 @@ from typing import Any, Dict, List, Optional, Tuple
 # \\ / \" / \n escapes per the exposition format
 _RE_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 _RE_ESCAPE = re.compile(r"\\(.)")
+# OpenMetrics-style exemplar suffix our own dump appends when asked
+# (` # {request_id="..."} value timestamp`, end-anchored so an
+# adversarial LABEL VALUE merely containing the shape cannot truncate a
+# sample — inside a label it sits before the real sample value, never at
+# end-of-line)
+_RE_EXEMPLAR = re.compile(
+    r' # \{request_id="(?:[^"\\]|\\.)*"\} \S+ \S+$'
+)
 
 
 def _unescape_one(m: "re.Match") -> str:
     c = m.group(1)
     return "\n" if c == "n" else c
+
+
+def _parse_value(s: str):
+    """Sample value as the exact number the dump wrote: integers stay
+    int (counter sums across processes must be exact), everything else
+    float."""
+    try:
+        return int(s)
+    except ValueError:
+        return float(s)
 
 from .registry import REGISTRY, MetricsRegistry
 
@@ -178,13 +196,23 @@ def _fmt_labels(pairs: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
     return "{" + ",".join(items) + "}" if items else ""
 
 
-def dump_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+def dump_prometheus(
+    registry: Optional[MetricsRegistry] = None, exemplars: bool = False
+) -> str:
     """Every registry metric in the Prometheus exposition text format
     (`# HELP` / `# TYPE` headers, `_bucket`/`_sum`/`_count` histogram
     series).  The legacy dict views (STAGE_COUNTS, CACHE_METRICS,
     RECOVERY_METRICS, ...) export as gauge families labeled by `key`, so
     `spark_rapids_ml_tpu_recovery{key="meshes_rebuilt"}` always equals
-    `RECOVERY_METRICS["meshes_rebuilt"]`."""
+    `RECOVERY_METRICS["meshes_rebuilt"]`.
+
+    `exemplars=True` appends each histogram labelset's recorded request
+    ids to their `_bucket` lines in the OpenMetrics exemplar shape
+    (` # {request_id="..."} value timestamp`) — opt-in because classic
+    0.0.4 scrapers reject the syntax; `parse_prometheus` strips it
+    either way.  The flight recorder's post-mortem bundles dump with
+    exemplars on, so a latency bucket in the black box names the
+    requests that landed in it."""
     reg = registry or REGISTRY
     lines: List[str] = []
     for m in reg.metrics():
@@ -195,12 +223,26 @@ def dump_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
         samples = m.samples()
         if m.kind == "histogram":
             for lk, h in samples.items():
-                for le, c in zip(m.buckets, h["buckets"]):
+                ex_by_bucket: Dict[int, Dict[str, Any]] = {}
+                if exemplars:
+                    for e in h.get("exemplars", ()):
+                        for i, le in enumerate(m.buckets):
+                            if e["value"] <= le:
+                                ex_by_bucket[i] = e  # newest wins
+                                break
+                        else:
+                            ex_by_bucket[len(m.buckets)] = e
+                for i, (le, c) in enumerate(zip(m.buckets, h["buckets"])):
                     extra = 'le="%s"' % le
-                    lines.append(f"{name}_bucket{_fmt_labels(lk, extra)} {c}")
+                    suffix = _fmt_exemplar(ex_by_bucket.get(i))
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(lk, extra)} {c}{suffix}"
+                    )
                 inf = 'le="+Inf"'
+                suffix = _fmt_exemplar(ex_by_bucket.get(len(m.buckets)))
                 lines.append(
-                    f"{name}_bucket{_fmt_labels(lk, inf)} {h['count']}"
+                    f"{name}_bucket{_fmt_labels(lk, inf)} "
+                    f"{h['count']}{suffix}"
                 )
                 lines.append(f"{name}_sum{_fmt_labels(lk)} "
                              f"{_fmt_value(h['sum'])}")
@@ -209,6 +251,53 @@ def dump_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
             for lk, v in samples.items():
                 lines.append(f"{name}{_fmt_labels(lk)} {_fmt_value(v)}")
     return "\n".join(lines) + "\n"
+
+
+def _fmt_exemplar(e: Optional[Dict[str, Any]]) -> str:
+    if not e:
+        return ""
+    return (
+        f' # {{request_id="{_escape_label(e["id"])}"}} '
+        f"{_fmt_value(e['value'])} {round(e['t'], 3)}"
+    )
+
+
+def _parse_sample_line(
+    line: str,
+) -> Tuple[str, Tuple[Tuple[str, str], ...], str]:
+    """One sample line -> (name, sorted label pairs, raw value string).
+    Strips an OpenMetrics exemplar suffix when present, and tolerates
+    the exposition format's OPTIONAL trailing timestamp (foreign pages
+    — federation output, other exporters — emit `name{l} value ts`; the
+    timestamp is dropped, never mistaken for the value).  Raises
+    ValueError on malformed lines so a broken dump fails loudly."""
+    line = _RE_EXEMPLAR.sub("", line)
+    head, _, value = line.rpartition(" ")
+    if not head:
+        raise ValueError(f"malformed prometheus sample: {line!r}")
+    if " " in head and (
+        ("}" in head and not head.endswith("}")) or "{" not in head
+    ):
+        # the token we took as the value is a trailing timestamp: the
+        # real value is the token before it (a head that still has a
+        # space after its label block — or a label-less head with a
+        # space — cannot be a bare metric name)
+        head, _, value = head.rpartition(" ")
+    labels: Tuple[Tuple[str, str], ...] = ()
+    name = head
+    if head.endswith("}"):
+        name, _, rest = head.partition("{")
+        body = rest[:-1]
+        # escape-aware: values may contain \\, \" and \n (and
+        # commas, which a naive split would sever)
+        pairs = [
+            (k, _RE_ESCAPE.sub(_unescape_one, v))
+            for k, v in _RE_LABEL.findall(body)
+        ]
+        if body and not pairs:
+            raise ValueError(f"malformed label in: {line!r}")
+        labels = tuple(sorted(pairs))
+    return name, labels, value
 
 
 def parse_prometheus(
@@ -223,25 +312,116 @@ def parse_prometheus(
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        head, _, value = line.rpartition(" ")
-        if not head:
-            raise ValueError(f"malformed prometheus sample: {line!r}")
-        labels: Tuple[Tuple[str, str], ...] = ()
-        name = head
-        if head.endswith("}"):
-            name, _, rest = head.partition("{")
-            body = rest[:-1]
-            # escape-aware: values may contain \\, \" and \n (and
-            # commas, which a naive split would sever)
-            pairs = [
-                (k, _RE_ESCAPE.sub(_unescape_one, v))
-                for k, v in _RE_LABEL.findall(body)
-            ]
-            if body and not pairs:
-                raise ValueError(f"malformed label in: {line!r}")
-            labels = tuple(sorted(pairs))
+        name, labels, value = _parse_sample_line(line)
         out[(name, labels)] = float(value)
     return out
+
+
+def parse_prometheus_families(text: str) -> Dict[str, Dict[str, Any]]:
+    """Structured family-level parse — the exact round-trip the
+    cross-process aggregator (telemetry/aggregate.py) stands on:
+
+        {family: {"kind": counter|gauge|histogram|untyped,
+                  "help": str,
+                  "samples": {label_pairs: value}}}
+
+    Histogram families reassemble their `_bucket`/`_sum`/`_count` series
+    back into one value per labelset —
+    `{"buckets": {le_str: count}, "sum": float, "count": int}` — keyed
+    WITHOUT the `le` label, so bucket-wise merging is a dict walk.
+    Escaped label values (backslash, quote, newline — and commas/spaces/
+    braces, which need no escape but break naive splitters) round-trip
+    byte-exactly; integer sample values stay `int` so counter sums
+    across processes are exact.  `render_families` is the inverse."""
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    raw: Dict[str, Dict[Tuple[Tuple[str, str], ...], Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ", 3)
+            if len(parts) == 4:
+                kinds[parts[2]] = parts[3]
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(" ", 3)
+            if len(parts) >= 3:
+                helps[parts[2]] = parts[3] if len(parts) == 4 else ""
+            continue
+        if line.startswith("#"):
+            continue
+        name, labels, value = _parse_sample_line(line)
+        raw.setdefault(name, {})[labels] = _parse_value(value)
+    out: Dict[str, Dict[str, Any]] = {}
+    for fam, kind in kinds.items():
+        entry: Dict[str, Any] = {"kind": kind, "help": helps.get(fam, "")}
+        if kind == "histogram":
+            samples: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+            for lk, v in raw.pop(fam + "_bucket", {}).items():
+                le = dict(lk).get("le", "")
+                base = tuple(p for p in lk if p[0] != "le")
+                h = samples.setdefault(
+                    base, {"buckets": {}, "sum": 0.0, "count": 0}
+                )
+                h["buckets"][le] = v
+            for lk, v in raw.pop(fam + "_sum", {}).items():
+                samples.setdefault(
+                    lk, {"buckets": {}, "sum": 0.0, "count": 0}
+                )["sum"] = float(v)
+            for lk, v in raw.pop(fam + "_count", {}).items():
+                samples.setdefault(
+                    lk, {"buckets": {}, "sum": 0.0, "count": 0}
+                )["count"] = int(v)
+            entry["samples"] = samples
+        else:
+            entry["samples"] = raw.pop(fam, {})
+        out[fam] = entry
+    # samples with no TYPE header (foreign pages): keep them, untyped
+    for fam, samples in raw.items():
+        out[fam] = {"kind": "untyped", "help": "", "samples": samples}
+    return out
+
+
+def render_families(families: Dict[str, Dict[str, Any]]) -> str:
+    """`parse_prometheus_families`'s inverse: families back to the text
+    exposition format (deterministic ordering: families as given,
+    labelsets sorted), so merged pages are themselves scrapeable and
+    re-parseable."""
+    lines: List[str] = []
+    for fam, entry in families.items():
+        if entry.get("help"):
+            lines.append(f"# HELP {fam} {entry['help']}")
+        kind = entry.get("kind", "untyped")
+        if kind != "untyped":
+            lines.append(f"# TYPE {fam} {kind}")
+        samples = entry.get("samples", {})
+        if kind == "histogram":
+            for lk in sorted(samples):
+                h = samples[lk]
+                les = sorted(
+                    h["buckets"],
+                    key=lambda s: float("inf") if s == "+Inf" else float(s),
+                )
+                for le in les:
+                    extra = f'le="{le}"'
+                    lines.append(
+                        f"{fam}_bucket{_fmt_labels(lk, extra)} "
+                        f"{_fmt_value(h['buckets'][le])}"
+                    )
+                lines.append(
+                    f"{fam}_sum{_fmt_labels(lk)} {_fmt_value(h['sum'])}"
+                )
+                lines.append(
+                    f"{fam}_count{_fmt_labels(lk)} {_fmt_value(h['count'])}"
+                )
+        else:
+            for lk in sorted(samples):
+                lines.append(
+                    f"{fam}{_fmt_labels(lk)} {_fmt_value(samples[lk])}"
+                )
+    return "\n".join(lines) + "\n"
 
 
 # ---------------------------------------------------------------------------
@@ -280,8 +460,12 @@ def start_http_server(
                     return
                 body = dump_prometheus(reg).encode()
                 self.send_response(200)
+                # the full exposition-format content type: scrapers key
+                # the parser off version AND charset (a bare text/plain
+                # makes strict clients fall back to guessing)
                 self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4"
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
                 )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -345,6 +529,8 @@ __all__ = [
     "dump_prometheus",
     "maybe_start_http_server",
     "parse_prometheus",
+    "parse_prometheus_families",
+    "render_families",
     "start_http_server",
     "stop_http_server",
 ]

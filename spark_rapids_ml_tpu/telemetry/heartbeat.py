@@ -24,6 +24,17 @@ _loss_gauge = gauge(
     "solver_loss", "Current loss/objective of the running solver loop"
 )
 
+# the most recent Heartbeat per label OWNS the gauge series: close()
+# only removes the series while its caller is still the owner, so a
+# fit completing while ANOTHER fit of the same solver type is mid-loop
+# (parallel CV, tuning) cannot erase the live fit's state from a
+# flight-recorder post-mortem — and an interrupted loop's abandoned
+# heartbeat (device-loss resume creates a fresh one) never blocks the
+# resumed loop's close from end-marking.  Bounded by the solver-label
+# vocabulary (METRIC_CATALOG cardinality 16).
+_owners_lock = threading.Lock()
+_owners: dict = {}
+
 
 class Heartbeat:
     """Per-solver-loop progress reporter.  Construct once before the
@@ -59,6 +70,9 @@ class Heartbeat:
         self._last = self._t0
         self._first_it: Optional[int] = None  # resumed loops start at k>0
         self._lock = threading.Lock()
+        self._closed = False
+        with _owners_lock:
+            _owners[self.label] = self
 
     def beat(self, it: int, loss: Any = None, detail: str = "") -> None:
         """Record one completed iteration.  Cheap when quiet: two gauge
@@ -107,6 +121,38 @@ class Heartbeat:
             f"heartbeat[{self.label}]",
             detail=f"it={it}{bound}{loss_s}".strip(),
         )
+
+    def close(self) -> None:
+        """End-mark the solver: REMOVE this label's
+        `solver_iteration`/`solver_loss` samples so a scrape after the
+        fit completes shows no live series for it.  Without this the
+        gauges keep reporting the LAST iteration/loss forever and a
+        finished fit is indistinguishable from a running one.  Solver
+        loops call it on normal completion only — a fit that dies
+        mid-loop deliberately leaves its last state visible for the
+        flight recorder's post-mortem bundle.  Idempotent.
+
+        Only the CURRENT owner of the label's series removes it: a
+        concurrent fit of the same solver type that beat more recently
+        keeps its state (its next beat re-sets the gauges anyway)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        with _owners_lock:
+            if _owners.get(self.label) is not self:
+                return  # a newer loop owns the series; leave it live
+            del _owners[self.label]
+        _iter_gauge.remove(solver=self.label)
+        _loss_gauge.remove(solver=self.label)
+
+    def __enter__(self) -> "Heartbeat":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # as a context manager the gauges clear on ANY exit; the bare
+        # construct-and-close form keeps the die-mid-loop state visible
+        self.close()
 
 
 __all__ = ["Heartbeat"]

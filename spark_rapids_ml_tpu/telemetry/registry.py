@@ -24,6 +24,7 @@
 from __future__ import annotations
 
 import threading
+import time
 from collections.abc import MutableMapping
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -111,10 +112,27 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     # coalescing sizes, admission-control rejections, and model-pin
     # lifecycle.  Labels stay enumerable: model names are
     # operator-chosen registry keys, phases/reasons/events are fixed
-    # vocabularies.
+    # vocabularies.  `exemplars: True` declares the family carries
+    # bounded per-labelset exemplars (request ids) — the ONLY families
+    # allowed to pass `exemplar=` to observe() (metric-name rule); the
+    # unbounded ids live beside the samples, never as labels.
     "serving_request_latency_seconds": {
         "kind": "histogram", "labels": ("model", "phase"),
-        "cardinality": 96,
+        "cardinality": 96, "exemplars": True,
+    },
+    # SLO sensing (serving/server.py): measured over-p99-target request
+    # fraction / the 1% budget a p99 target implies, per declared
+    # window — the sensor half of the planned coalescing-cap feedback
+    # controller (ROADMAP item 2).
+    "slo_burn_rate": {
+        "kind": "gauge", "labels": ("model", "window"), "cardinality": 96,
+    },
+    # failure flight recorder (telemetry/flight_recorder.py): one bump
+    # per post-mortem bundle written, labeled by the typed failure path
+    # that triggered the dump (retry_exhausted / dispatch_timeout /
+    # device_lost / serving_overload / manual)
+    "postmortems_total": {
+        "kind": "counter", "labels": ("reason",), "cardinality": 16,
     },
     "serving_batch_rows": {
         "kind": "histogram", "labels": ("model",), "cardinality": 32,
@@ -210,7 +228,13 @@ class Metric:
 
     # -- histogram -----------------------------------------------------------
 
-    def observe(self, value: float, **labels: Any) -> None:
+    # exemplars retained per labelset: enough to answer "which request
+    # was that" for the recent observations without growing with traffic
+    _MAX_EXEMPLARS = 4
+
+    def observe(
+        self, value: float, exemplar: Optional[str] = None, **labels: Any
+    ) -> None:
         if self.kind != "histogram":
             raise TypeError(f"{self.kind} metrics take inc()/set()")
         v = float(value)
@@ -228,16 +252,55 @@ class Metric:
                     h["buckets"][i] += 1
             h["sum"] += v
             h["count"] += 1
+            if exemplar is not None:
+                # exemplars (request/run ids) are UNBOUNDED values and
+                # must never become labels (cardinality); a short ring
+                # beside the sample keeps the trace join-key without
+                # growing with traffic
+                ex = h.setdefault("exemplars", [])
+                ex.append({
+                    "id": str(exemplar), "value": v, "t": time.time(),
+                })
+                del ex[: -self._MAX_EXEMPLARS]
+
+    def exemplars(self, **labels: Any) -> List[Dict[str, Any]]:
+        """Recent exemplars recorded for one labelset (histograms whose
+        catalog entry declares `exemplars: True`); newest last."""
+        with self._lock:
+            h = self._samples.get(_label_key(labels))
+            if not isinstance(h, dict):
+                return []
+            return [dict(e) for e in h.get("exemplars", ())]
 
     # -- shared --------------------------------------------------------------
 
     def samples(self) -> Dict[LabelKey, Any]:
         with self._lock:
             return {
-                k: (dict(v, buckets=list(v["buckets"]))
-                    if isinstance(v, dict) else v)
+                k: (
+                    dict(
+                        v,
+                        buckets=list(v["buckets"]),
+                        **(
+                            {"exemplars": [dict(e) for e in v["exemplars"]]}
+                            if "exemplars" in v
+                            else {}
+                        ),
+                    )
+                    if isinstance(v, dict)
+                    else v
+                )
                 for k, v in self._samples.items()
             }
+
+    def remove(self, **labels: Any) -> bool:
+        """Drop one labelset's sample entirely (True when it existed).
+        The end-mark for gauges that would otherwise report a finished
+        run as live forever — a scrape after `Heartbeat.close()` shows
+        NO `solver_iteration{solver=...}` series instead of the last
+        iteration of a fit that ended minutes ago."""
+        with self._lock:
+            return self._samples.pop(_label_key(labels), None) is not None
 
     def clear(self) -> None:
         with self._lock:

@@ -81,11 +81,41 @@ def _records() -> List[TraceEvent]:
     return rec
 
 
+# process-wide observers of EVERY recorded event, regardless of which
+# thread's buffer it lands in — the flight recorder's feed
+# (telemetry/flight_recorder.py).  Registration is rare (guarded by
+# _buffers_lock); the hot-path iteration reads the list lock-free
+# (list object replaced atomically on registration, append-only reads).
+_taps: List[Callable[[TraceEvent], None]] = []
+
+
+def add_trace_tap(fn: Callable[[TraceEvent], None]) -> None:
+    """Register `fn` to observe every TraceEvent recorded by any thread
+    of this process (spans on exit, instants immediately).  Idempotent.
+    A tap must be cheap and never raise — it runs inline on the
+    recording thread."""
+    global _taps
+    with _buffers_lock:
+        if fn not in _taps:
+            _taps = _taps + [fn]
+
+
+def remove_trace_tap(fn: Callable[[TraceEvent], None]) -> None:
+    global _taps
+    with _buffers_lock:
+        _taps = [t for t in _taps if t is not fn]
+
+
 def _append(event: TraceEvent) -> None:
     rec = _records()
     if len(rec) >= MAX_EVENTS:
         del rec[: MAX_EVENTS // 2]  # drop the oldest half
     rec.append(event)
+    for tap in _taps:
+        try:
+            tap(event)
+        except Exception:  # a broken observer must never fail the span
+            pass
 
 
 def get_trace_events() -> List[TraceEvent]:

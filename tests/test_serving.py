@@ -123,12 +123,17 @@ def test_coalescing_parity_n_concurrent_rows_exact(pca_model, rng):
     try:
         rows = [_q(rng, 1) for _ in range(16)]
         server.pause()  # deterministic coalescing: all 16 queue first
-        futs = []
+        # futs[i] belongs to rows[i] BY INDEX: appends land in thread-
+        # completion order, which the GIL does not promise matches the
+        # submission order (the parity check below is per-request)
+        futs = [None] * len(rows)
+
+        def _submit(i, r):
+            futs[i] = server.submit("pca", r)
+
         threads = [
-            threading.Thread(
-                target=lambda r=r: futs.append(server.submit("pca", r))
-            )
-            for r in rows
+            threading.Thread(target=_submit, args=(i, r))
+            for i, r in enumerate(rows)
         ]
         for t in threads:
             t.start()
@@ -523,6 +528,229 @@ def test_http_endpoint_roundtrip(pca_model, rng):
     finally:
         http.shutdown()
         http.server_close()
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing, exemplars, slow capture, SLO burn rate
+# ---------------------------------------------------------------------------
+
+
+def test_request_ids_minted_and_adoptable(pca_model, rng):
+    server = _serve(rid=pca_model)
+    try:
+        fut = server.submit("rid", _q(rng))
+        assert fut.request_id.startswith("req-")
+        fut.result(timeout=60)
+        fut2 = server.submit("rid", _q(rng), request_id="caller-7")
+        assert fut2.request_id == "caller-7"
+        fut2.result(timeout=60)
+        client = ServingClient(server)
+        fut3 = client.submit("rid", _q(rng), request_id="client-9")
+        assert fut3.request_id == "client-9"
+        fut3.result(timeout=60)
+    finally:
+        server.stop()
+
+
+def test_latency_exemplars_carry_request_ids(pca_model, rng):
+    from spark_rapids_ml_tpu.serving.server import LATENCY
+
+    server = _serve(exm=pca_model)
+    try:
+        fut = server.submit("exm", _q(rng), request_id="exemplar-probe")
+        fut.result(timeout=60)
+        for phase in ("queue", "dispatch", "total"):
+            ex = LATENCY.exemplars(model="exm", phase=phase)
+            assert any(e["id"] == "exemplar-probe" for e in ex), (phase, ex)
+        # exemplars surface in the opt-in dump and the classic dump
+        # still round-trips through the parser with them present
+        page = dump_prometheus(exemplars=True)
+        assert 'request_id="exemplar-probe"' in page
+        assert parse_prometheus(page) == parse_prometheus(dump_prometheus())
+    finally:
+        server.stop()
+
+
+def test_slow_request_capture_has_full_span_tree(pca_model, rng):
+    set_config(serving_slow_trace_ms=0.0001)  # everything is "slow"
+    server = _serve(slow=pca_model)
+    try:
+        fut = server.submit("slow", _q(rng), request_id="slow-probe")
+        fut.result(timeout=60)
+        deadline = time.time() + 10
+        while not server.slow_traces() and time.time() < deadline:
+            time.sleep(0.01)
+        traces = server.slow_traces()
+        assert traces, "no slow capture despite a 0.0001ms threshold"
+        entry = traces[-1]
+        assert entry["model"] == "slow"
+        assert any(
+            r["request_id"] == "slow-probe" for r in entry["requests"]
+        )
+        names = set()
+
+        def walk(nodes):
+            for n in nodes:
+                names.add(n["name"])
+                walk(n.get("children", []))
+
+        walk(entry["spans"])
+        # the full request path: dispatch with its coalesce/stage/
+        # compute children plus the collect/scatter of the same batch
+        for want in (
+            "serving_dispatch[slow]", "serving_coalesce", "serving_stage",
+            "serving_compute", "serving_collect[slow]", "serving_scatter",
+        ):
+            assert want in names, (want, sorted(names))
+        assert server.report()["_totals"]["slow_traces"] >= 1
+    finally:
+        server.stop()
+
+
+def test_slow_capture_off_by_default(pca_model, rng):
+    server = _serve(fast=pca_model)
+    try:
+        server.transform("fast", _q(rng), timeout=60)
+        assert server.slow_traces() == []
+    finally:
+        server.stop()
+
+
+def test_slo_burn_rate_gauges(pca_model, rng):
+    from spark_rapids_ml_tpu.serving.server import SLO_BURN
+
+    # an impossible target: every request breaches -> burn = 100x budget
+    set_config(serving_slo_p99_ms=0.0001)
+    server = _serve(burn=pca_model)
+    try:
+        for _ in range(3):
+            server.transform("burn", _q(rng), timeout=60)
+        deadline = time.time() + 10
+        while (
+            SLO_BURN.value(default=None, model="burn", window="1m") is None
+            and time.time() < deadline
+        ):
+            server.transform("burn", _q(rng), timeout=60)
+            time.sleep(0.3)
+        burn = SLO_BURN.value(default=None, model="burn", window="1m")
+        assert burn is not None and burn > 1.0, burn
+        rep = server.report()["burn"]
+        assert rep["slo_burn_1m"] == burn
+        assert rep["slo_p99_target_ms"] == 0.0  # rounds from 1e-4 ms
+        # a generous per-model override flips the same model to healthy
+        set_config(serving_slo_targets="burn=60000")
+        time.sleep(1.1)  # past the per-model refresh rate limit
+        server.transform("burn", _q(rng), timeout=60)
+        deadline = time.time() + 10
+        while (
+            SLO_BURN.value(default=1e9, model="burn", window="1m") > 0
+            and time.time() < deadline
+        ):
+            time.sleep(1.1)
+            server.transform("burn", _q(rng), timeout=60)
+        assert SLO_BURN.value(model="burn", window="1m") == 0.0
+    finally:
+        server.stop()
+
+
+def test_slo_gauges_absent_without_target(pca_model, rng):
+    from spark_rapids_ml_tpu.serving.server import SLO_BURN
+
+    SLO_BURN.remove(model="quiet", window="1m")
+    SLO_BURN.remove(model="quiet", window="5m")
+    server = _serve(quiet=pca_model)
+    try:
+        server.transform("quiet", _q(rng), timeout=60)
+        assert SLO_BURN.value(default=None, model="quiet", window="1m") is None
+        assert "slo_burn_1m" not in server.report()["quiet"]
+    finally:
+        server.stop()
+
+
+def test_idle_dispatcher_refreshes_slo_gauges(pca_model, rng):
+    # regression: the dispatcher's idle wait must break out to run
+    # _refresh_slo_all — burn gauges decay when traffic STOPS, with no
+    # later request driving the collect-path refresh (before the fix the
+    # inner cv-wait loop never broke while running+idle, so a burn spike
+    # scraped as live forever once traffic ended)
+    set_config(serving_slo_p99_ms=60000)
+    server = _serve(idle=pca_model)
+    try:
+        server.transform("idle", _q(rng), timeout=60)
+        calls: list = []
+        orig = server._update_slo
+        server._update_slo = (
+            lambda name: (calls.append(name), orig(name))[1]
+        )
+        deadline = time.time() + 5
+        while "idle" not in calls and time.time() < deadline:
+            time.sleep(0.1)
+        assert "idle" in calls  # refreshed with zero in-flight traffic
+    finally:
+        server.stop()
+
+
+def test_http_request_id_header_roundtrip(pca_model, rng):
+    import json
+    import urllib.request
+
+    from spark_rapids_ml_tpu.serving.http import start_serving_http
+
+    server = _serve(hdr=pca_model)
+    http = start_serving_http(server, port=0)
+    base = f"http://127.0.0.1:{http.server_port}"
+    try:
+        body = json.dumps({"instances": _q(rng).tolist()}).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/models/hdr:transform", data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "edge-42"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = json.load(resp)
+        assert payload["request_id"] == "edge-42"
+        # no header -> the server mints one and still names it
+        req = urllib.request.Request(
+            f"{base}/v1/models/hdr:transform", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            payload = json.load(resp)
+        assert payload["request_id"].startswith("req-")
+    finally:
+        http.shutdown()
+        http.server_close()
+        server.stop()
+
+
+def test_sustained_overload_leaves_postmortem(pca_model, rng, tmp_path):
+    from spark_rapids_ml_tpu.serving import server as srv_mod
+    from spark_rapids_ml_tpu.telemetry.flight_recorder import RECORDER
+
+    set_config(
+        flight_recorder_dir=str(tmp_path), serving_max_queue=1,
+    )
+    RECORDER.clear()  # fresh cooldown state for this test
+    server = _serve(ovl=pca_model)
+    server.pause()  # requests queue, nothing drains -> queue_full storm
+    try:
+        rejections = 0
+        fut = server.submit("ovl", _q(rng))  # occupies the queue slot
+        for _ in range(srv_mod._OVERLOAD_DUMP_COUNT + 5):
+            with pytest.raises(ServingOverload):
+                server.submit("ovl", _q(rng))
+            rejections += 1
+        bundles = list(tmp_path.glob("postmortem_serving_overload_*"))
+        assert len(bundles) == 1, (rejections, bundles)
+        import json as _json
+
+        manifest = _json.loads((bundles[0] / "manifest.json").read_text())
+        assert manifest["reason"] == "serving_overload"
+        assert "model=ovl" in manifest["detail"]
+    finally:
+        server.resume()
+        fut.result(timeout=60)
         server.stop()
 
 

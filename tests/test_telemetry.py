@@ -310,6 +310,77 @@ def test_http_endpoint_serves_metrics():
         stop_http_server()
 
 
+def test_http_endpoint_concurrent_scrapes_during_active_writes():
+    """4 threads scraping `/metrics` WHILE a writer hammers the
+    registry: every response must carry the exact exposition content
+    type (`text/plain; version=0.0.4; charset=utf-8`), parse cleanly
+    (no torn output — Content-Length is computed from the rendered
+    body, so a scrape mid-write still reads one consistent page), and
+    nothing may deadlock against the registry lock."""
+    import threading
+
+    from spark_rapids_ml_tpu.telemetry import (
+        start_http_server,
+        stop_http_server,
+    )
+
+    stop_http_server()
+    reg = MetricsRegistry()
+    c = reg.counter("scrape_probe")
+    h = reg.histogram("scrape_lat", buckets=(0.1, 1.0))
+    stop_writer = threading.Event()
+
+    def _writer():
+        i = 0
+        while not stop_writer.is_set():
+            c.inc(site=f"s{i % 5}")
+            h.observe(0.05 * (i % 30))
+            i += 1
+
+    srv = start_http_server(0, registry=reg)
+    wt = threading.Thread(target=_writer, daemon=True)
+    wt.start()
+    failures = []
+
+    def _scraper():
+        try:
+            url = f"http://127.0.0.1:{srv.server_port}/metrics"
+            for _ in range(25):
+                with urllib.request.urlopen(url, timeout=30) as resp:
+                    assert resp.headers["Content-Type"] == (
+                        "text/plain; version=0.0.4; charset=utf-8"
+                    )
+                    body = resp.read().decode()
+                parsed = parse_prometheus(body)  # raises on torn lines
+                # histogram internal consistency on every scrape: the
+                # +Inf bucket IS the count (a torn page would drift)
+                cnt = parsed.get(
+                    ("spark_rapids_ml_tpu_scrape_lat_count", ())
+                )
+                inf = parsed.get(
+                    ("spark_rapids_ml_tpu_scrape_lat_bucket",
+                     (("le", "+Inf"),))
+                )
+                assert cnt == inf, (cnt, inf)
+        except Exception as e:  # pragma: no cover - the assertion payload
+            failures.append(e)
+
+    try:
+        scrapers = [
+            threading.Thread(target=_scraper) for _ in range(4)
+        ]
+        for t in scrapers:
+            t.start()
+        for t in scrapers:
+            t.join(timeout=120)
+            assert not t.is_alive(), "scraper deadlocked"
+    finally:
+        stop_writer.set()
+        wt.join(timeout=30)
+        stop_http_server()
+    assert not failures, failures
+
+
 # ---------------------------------------------------------------------------
 # heartbeat
 # ---------------------------------------------------------------------------
@@ -337,6 +408,7 @@ def test_heartbeat_logs_and_gauges():
 
     assert REGISTRY.get("solver_iteration").value(solver="probe_solver") == 2
     assert REGISTRY.get("solver_loss").value(solver="probe_solver") == 4.0
+    hb.close()  # drop the series: later tests read global solver state
 
 
 def test_heartbeat_silent_when_disabled():
@@ -358,6 +430,7 @@ def test_heartbeat_silent_when_disabled():
     from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
 
     assert REGISTRY.get("solver_iteration").value(solver="quiet_solver") == 4
+    hb.close()  # drop the series: later tests read global solver state
 
 
 # ---------------------------------------------------------------------------
