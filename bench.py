@@ -47,7 +47,7 @@ WORKLOADS = [
     for w in os.environ.get(
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
-        "serving,streaming,epoch_cache,refconfig,rf",
+        "serving,streaming,summarize,epoch_cache,refconfig,rf",
     ).split(",")
 ]
 
@@ -507,6 +507,40 @@ def bench_streaming(extra: dict):
         import shutil
 
         shutil.rmtree(td, ignore_errors=True)
+
+
+def bench_summarize(extra: dict):
+    """Statistic-program engine (stats/): many statistics in ONE fused
+    chunked pass vs one pass per program.  The fused speedup is the
+    subsystem's headline — requesting 8 metrics must cost ~one scan."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.stats import summarize
+    from spark_rapids_ml_tpu.stats.engine import STAT_METRICS
+
+    n, d = min(N_ROWS, 500_000), 32
+    rng = _rng(11)
+    X = rng.standard_normal((n, d)).astype(np.float32)
+    metrics = ["count", "mean", "variance", "min", "max", "normL2",
+               "quantiles", "distinctCount"]
+    summarize(X[:4096], metrics=metrics)  # warm compiles out of the timing
+    t0 = time.perf_counter()
+    summarize(X, metrics=metrics)
+    fused = time.perf_counter() - t0
+    extra[f"summarize_{n//1000}kx{d}_pass_sec"] = round(fused, 3)
+    extra["summarize_rows_per_sec"] = round(n / fused, 1)
+    extra["summarize_programs"] = int(STAT_METRICS.get("programs", 0))
+    extra["summarize_chunks"] = int(STAT_METRICS.get("chunks", 0))
+    extra["summarize_overlap_fraction"] = float(
+        STAT_METRICS.get("overlap_fraction", 0.0)
+    )
+    # sequential baseline: the same statistics one program-pass at a time
+    t0 = time.perf_counter()
+    for m in metrics:
+        summarize(X, metrics=[m])
+    seq = time.perf_counter() - t0
+    extra["summarize_seq_passes_sec"] = round(seq, 3)
+    extra["summarize_fused_speedup_x"] = round(seq / max(fused, 1e-9), 2)
 
 
 def bench_epoch_cache(extra: dict):
@@ -1770,7 +1804,7 @@ def _cpu_shrink() -> None:
     if "BENCH_WORKLOADS" not in os.environ:
         WORKLOADS[:] = [
             "pca", "fused_pca", "staging", "serving", "streaming",
-            "epoch_cache",
+            "summarize", "epoch_cache",
         ]
 
 
@@ -1914,6 +1948,7 @@ def main() -> None:
         "cv_cached": bench_cv_cached,
         "serving": bench_serving,
         "streaming": bench_streaming,
+        "summarize": bench_summarize,
         "epoch_cache": bench_epoch_cache,
         "refconfig": bench_refconfig,
         "rf": bench_rf,

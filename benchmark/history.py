@@ -45,6 +45,11 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("serving_", "serving"),
     ("staging_", "staging"),
     ("streaming_", "streaming"),
+    # statistic-program engine (bench.py `summarize` section): the fused
+    # multi-statistic pass timings + fused-vs-sequential speedup; the
+    # `_sec`/`_per_sec`/`_speedup_x`/`_overlap_fraction` suffixes pick
+    # up the standard compare.py direction rules
+    ("summarize_", "summarize"),
     ("ingest_", "streaming"),
     ("umap_", "umap"),
 )

@@ -53,6 +53,7 @@ mods = [
     "spark_rapids_ml_tpu.tuning", "spark_rapids_ml_tpu.pipeline",
     "spark_rapids_ml_tpu.sklearn_api", "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.streaming", "spark_rapids_ml_tpu.metrics",
+    "spark_rapids_ml_tpu.stats",
     "spark_rapids_ml_tpu.resilience", "spark_rapids_ml_tpu.telemetry",
     "benchmark.benchmark_runner", "benchmark.gen_data",
     "benchmark.gen_data_distributed",
@@ -106,7 +107,8 @@ run_batch tests/test_common_estimator.py tests/test_metrics.py \
     tests/test_tuning_pipeline.py tests/test_device_cache.py \
     tests/test_chunk_cache.py \
     tests/test_pca.py tests/test_kmeans.py \
-    tests/test_linear_regression.py tests/test_fused_stats.py "$@"
+    tests/test_linear_regression.py tests/test_fused_stats.py \
+    tests/test_stat_programs.py "$@"
 run_batch tests/test_logistic_regression.py tests/test_sparse_logreg.py \
     tests/test_f32_and_weights.py tests/test_random_forest.py "$@"
 run_batch tests/test_knn.py tests/test_ann.py tests/test_dbscan.py \
@@ -486,6 +488,53 @@ with tempfile.TemporaryDirectory() as td:
     print(f"epoch-cache smoke OK: epoch1 {e1:.2f}s -> epoch2 {e2:.2f}s "
           f"({e2 / e1:.2f}x), {CHUNK_METRICS['hit_bytes'] / 1e6:.0f} MB "
           "served from cache, statistics bit-identical")
+EOF
+
+echo "== stats smoke: fused multi-statistic pass, OOM restart, scrapeable =="
+# tier-1 marker-safe: one fused pass computing 7 statistics with an
+# injected mid-pass OOM must (a) retry with fresh accumulators and land
+# bit-identical to the clean pass (restart-not-double-count), (b) run as
+# ONE chunked pass (no full dataset staging), and (c) leave the
+# stat_program_* families scrapeable with no live solver series after
+# completion.  tests/test_stat_programs.py covers the full parity
+# matrix; this step keeps the subsystem gate runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import numpy as np
+
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.parallel.mesh import STAGE_COUNTS
+from spark_rapids_ml_tpu.resilience import fault_inject
+from spark_rapids_ml_tpu.stats import summarize
+from spark_rapids_ml_tpu.stats.engine import STAT_METRICS
+from spark_rapids_ml_tpu.telemetry import REGISTRY
+from spark_rapids_ml_tpu.telemetry.exporters import dump_prometheus
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((60_000, 16)).astype(np.float32)
+metrics = ["count", "mean", "variance", "min", "max", "quantiles",
+           "distinctCount"]
+set_config(retry_backoff_s=0.01, retry_jitter=0.0)
+stagings0 = STAGE_COUNTS["dataset_stagings"]
+clean = summarize(X, metrics=metrics)
+assert STAGE_COUNTS["dataset_stagings"] == stagings0, "staged the batch"
+assert STAT_METRICS["passes"] == 1 and STAT_METRICS["chunks"] >= 2
+with fault_inject("stat_program_step", "oom", times=1, skip=2):
+    faulted = summarize(X, metrics=metrics)
+assert faulted["count"] == clean["count"]
+np.testing.assert_array_equal(faulted["min"], clean["min"])
+np.testing.assert_array_equal(faulted["distinctCount"],
+                              clean["distinctCount"])
+np.testing.assert_array_equal(faulted["quantiles"][0.5],
+                              clean["quantiles"][0.5])
+text = dump_prometheus()
+assert "stat_program_runs_total" in text, "family not scrapeable"
+sentinel = object()
+assert REGISTRY.get("solver_iteration").value(
+    default=sentinel, solver="stat_programs") is sentinel, "live gauge leak"
+print(f"stats smoke OK: {STAT_METRICS['programs']} programs, "
+      f"{STAT_METRICS['chunks']} chunks, one pass, OOM restart "
+      "bit-identical, families scrapeable, gauges end-marked")
 EOF
 
 echo "== benchmark smoke =="

@@ -23,7 +23,7 @@ import sys as _sys
 __version__ = "0.1.0"
 
 from . import config  # noqa: F401
-from . import evaluation, metrics, pipeline, tuning  # noqa: F401
+from . import evaluation, metrics, pipeline, stats, tuning  # noqa: F401
 from .data import DeviceDataset  # noqa: F401
 from .parallel import init_distributed  # noqa: F401
 

@@ -52,6 +52,7 @@ DOC_FILES = (
     "docs/observability.md",
     "docs/performance.md",
     "docs/analysis.md",
+    "docs/statistics.md",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable(-file)?\s*(?:=\s*([\w\-*,\s]+))?")
@@ -362,11 +363,18 @@ class Project:
 def all_rules() -> List[Rule]:
     """Every shipped rule, builtin lint first (import here, not at module
     scope, so framework.py <-> rules_*.py never cycle)."""
-    from . import rules_builtin, rules_concurrency, rules_docs, rules_registry
+    from . import (
+        rules_builtin,
+        rules_concurrency,
+        rules_docs,
+        rules_registry,
+        rules_stats,
+    )
 
     return [
         *rules_builtin.RULES,
         *rules_registry.RULES,
+        *rules_stats.RULES,
         *rules_concurrency.RULES,
         *rules_docs.RULES,
     ]

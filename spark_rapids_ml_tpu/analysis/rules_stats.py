@@ -1,0 +1,194 @@
+#
+# stat-program rule — the statistic-program registry cross-check
+# (anchor: `stats/programs.py` STAT_PROGRAMS registrations):
+#
+#   - every `register_program(StatProgram(...))` declares a LITERAL
+#     `name` and a `shapes` declaration (the runtime half — declared
+#     shapes matching the built accumulator — is verified by
+#     `register_program` itself at import time); names are unique
+#   - every `run_program("p")` / `run_programs(["p", ...])` /
+#     `iter_chunk_accs("p")` / `get_program("p")` literal in the
+#     package names a registered program (a typo'd name fails CI, not
+#     the first user at runtime)
+#   - the Summarizer metric table (stats/summarizer.py `_METRICS`) maps
+#     only onto registered programs
+#   - docs/statistics.md lists every registered program by name
+#
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional
+
+from .framework import Finding, Project, Rule
+
+_PROGRAMS_REL = "spark_rapids_ml_tpu/stats/programs.py"
+_SUMMARIZER_REL = "spark_rapids_ml_tpu/stats/summarizer.py"
+_DOC_REL = "docs/statistics.md"
+
+_CALL_FUNCS = {"run_program", "run_programs", "iter_chunk_accs",
+               "get_program"}
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _literal_names(node: ast.expr) -> Optional[List[str]]:
+    """String literal(s) a program argument carries: "p" or ["p", "q"].
+    None = not statically determinable (a variable, a comprehension)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+class StatProgramRule(Rule):
+    name = "stat-program"
+    description = (
+        "statistic-program registrations declare literal names + "
+        "shapes; run_program call sites and docs/statistics.md resolve "
+        "against the registry"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        reg_sf = project.file(_PROGRAMS_REL)
+        registered: Dict[str, int] = {}
+        if reg_sf is not None and reg_sf.tree is not None:
+            yield from self._check_registrations(reg_sf, registered)
+        for sf in project.package_files():
+            if sf.tree is None or sf.rel == _PROGRAMS_REL:
+                continue
+            yield from self._check_calls(sf, registered)
+        if registered:
+            yield from self._check_summarizer_table(project, registered)
+            yield from self._check_docs(project, registered)
+
+    def _check_registrations(
+        self, sf, registered: Dict[str, int]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) == "register_program"):
+                continue
+            ctor = node.args[0] if node.args else None
+            if not (isinstance(ctor, ast.Call)
+                    and _call_name(ctor) == "StatProgram"):
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    "register_program must take a literal "
+                    "`StatProgram(...)` so the registry is statically "
+                    "checkable",
+                )
+                continue
+            kwargs = {kw.arg for kw in ctor.keywords if kw.arg}
+            name_kw = next(
+                (kw.value for kw in ctor.keywords if kw.arg == "name"),
+                None,
+            )
+            pname: Optional[str] = None
+            if isinstance(name_kw, ast.Constant) and isinstance(
+                name_kw.value, str
+            ):
+                pname = name_kw.value
+            if pname is None:
+                yield Finding(
+                    sf.rel, ctor.lineno, self.name,
+                    "StatProgram registration without a literal `name=` "
+                    "defeats the registry cross-check",
+                )
+                continue
+            if "shapes" not in kwargs:
+                yield Finding(
+                    sf.rel, ctor.lineno, self.name,
+                    f"program `{pname}` registers without a `shapes=` "
+                    "declaration (the contract every accumulator is "
+                    "verified against)",
+                )
+            if pname in registered:
+                yield Finding(
+                    sf.rel, ctor.lineno, self.name,
+                    f"program `{pname}` registered twice (first at line "
+                    f"{registered[pname]})",
+                )
+                continue
+            registered[pname] = ctor.lineno
+
+    def _check_calls(
+        self, sf, registered: Dict[str, int]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _call_name(node)
+            if fn not in _CALL_FUNCS or not node.args:
+                continue
+            names = _literal_names(node.args[0])
+            if names is None:
+                continue  # computed program sets resolve at runtime
+            for pname in names:
+                if pname not in registered:
+                    yield Finding(
+                        sf.rel, node.lineno, self.name,
+                        f"`{fn}({pname!r}, ...)` names no registered "
+                        "statistic program (not in STAT_PROGRAMS)",
+                    )
+
+    def _check_summarizer_table(
+        self, project: Project, registered: Dict[str, int]
+    ) -> Iterable[Finding]:
+        sf = project.file(_SUMMARIZER_REL)
+        if sf is None or sf.tree is None:
+            return
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "_METRICS"
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            for v in node.value.values:
+                if not (isinstance(v, ast.Tuple) and v.elts):
+                    continue
+                first = v.elts[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ) and first.value not in registered:
+                    yield Finding(
+                        sf.rel, v.lineno, self.name,
+                        f"Summarizer metric maps to `{first.value}`, "
+                        "which is not a registered statistic program",
+                    )
+
+    def _check_docs(
+        self, project: Project, registered: Dict[str, int]
+    ) -> Iterable[Finding]:
+        doc = project.file(_DOC_REL)
+        if doc is None:
+            yield Finding(
+                _DOC_REL, 1, self.name,
+                "docs/statistics.md is missing — every registered "
+                "statistic program must be documented there",
+            )
+            return
+        for pname in sorted(registered):
+            if f"`{pname}`" not in doc.text:
+                yield Finding(
+                    _DOC_REL, 1, self.name,
+                    f"registered statistic program `{pname}` is not "
+                    "listed in docs/statistics.md",
+                )
+
+
+RULES = [StatProgramRule()]

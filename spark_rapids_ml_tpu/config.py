@@ -235,6 +235,23 @@ _DEFAULTS: Dict[str, Any] = {
     # adds one O(n d l) pass and sharpens the spectrum (2 is enough for
     # slowly-decaying spectra; 0 is fastest).
     "pca_power_iters": 2,
+    # Statistic-program engine (stats/) sketch sizing.  Per-level item
+    # capacity of the mergeable KLL-style quantile sketch
+    # (stats/sketches.py): rank error shrinks ~1/k, memory grows
+    # O(cols * levels * k).
+    "summarizer_sketch_k": 256,
+    # Misra-Gries frequent-items table capacity per column: every
+    # reported count carries at most n/cap slack, and any value with
+    # true frequency above n/cap is guaranteed present.
+    "summarizer_frequent_k": 64,
+    # HyperLogLog precision bits for the `distinct_count` program:
+    # 2^bits int32 registers per column (~1.04/sqrt(2^bits) relative
+    # error; 12 bits = 4096 registers = ~1.6% error).
+    "summarizer_hll_bits": 12,
+    # Contingency-table bins per axis for the `chi2` independence test:
+    # integer-coded feature and label values are clipped into
+    # [0, bins).
+    "summarizer_chi2_bins": 16,
     # UMAP SGD epoch kernel: "auto" picks the scatter-free structured
     # kernel on TPU backends (unsorted scatter-adds serialize on TPU; the
     # structured form replaces them with dense sums + one sorted

@@ -99,30 +99,19 @@ def _jitted_steps(
     materialization for full chunks of weightless fits (ops/stats.py).
     `precision`/`compensated` key the conf values baked in at trace
     time; the initial zeros accumulator is built FRESH per fit (it is
-    donated into the first step and must never be reused)."""
+    donated into the first step and must never be reused).
+
+    The specs resolve through the statistic-program registry
+    (stats/programs.py STAT_PROGRAMS) — `kind` IS the registered
+    program name, so the fused estimators and any other registry
+    consumer share one owner for the update math (the PR-8 specs,
+    migrated)."""
     import jax
 
-    from .ops.stats import (
-        linreg_acc,
-        linreg_step_unw,
-        pca_moment_acc,
-        pca_moment_step_unw,
-        pca_projected_acc,
-        pca_projected_step_unw,
-    )
+    from .stats.programs import get_program
 
     dtype = np.dtype(dtype_str)
-    if kind == "linreg":
-        _, step = linreg_acc(d, dtype)
-        unw = linreg_step_unw
-    elif kind == "pca_moments":
-        _, step = pca_moment_acc(d, dtype)
-        unw = pca_moment_step_unw
-    elif kind == "pca_projected":
-        _, step = pca_projected_acc(d, l, dtype)
-        unw = pca_projected_step_unw
-    else:
-        raise ValueError(f"unknown fused accumulator kind {kind!r}")
+    step, unw = get_program(kind).make_step(d, dtype, {"l": l})
     return (
         jax.jit(step, donate_argnums=0),
         jax.jit(unw, donate_argnums=0),
@@ -131,17 +120,12 @@ def _jitted_steps(
 
 def _acc_spec(kind: str, d: int, l: int, dtype):
     """(fresh initial accumulator, cached (weighted, unweighted) jitted
-    steps) for `kind`."""
+    steps) for the registered statistic program `kind`."""
     from .ops.precision import stats_compensated
-    from .ops.stats import linreg_acc, pca_moment_acc, pca_projected_acc
+    from .stats.programs import get_program
 
     dtype = np.dtype(dtype)
-    if kind == "linreg":
-        acc, _ = linreg_acc(d, dtype)
-    elif kind == "pca_moments":
-        acc, _ = pca_moment_acc(d, dtype)
-    else:
-        acc, _ = pca_projected_acc(d, l, dtype)
+    acc = get_program(kind).init(d, dtype, {"l": l})
     steps = _jitted_steps(
         kind, d, l, dtype.str,
         str(get_config("stats_precision")).lower(), stats_compensated(),

@@ -55,7 +55,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from .distance import sqdist_gathered
+from .distances import sqdist_gathered
 
 
 def _pos_bits(C: int) -> int:

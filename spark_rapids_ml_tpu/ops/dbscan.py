@@ -58,7 +58,7 @@ _BLOCK = 8192
 
 def _sqdist(A: jax.Array, B: jax.Array) -> jax.Array:
     """Pairwise squared distances (shared rank-critical form)."""
-    from .distance import sqdist
+    from .distances import sqdist
 
     return sqdist(A, B)
 

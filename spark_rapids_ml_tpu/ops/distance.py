@@ -1,41 +1,23 @@
 #
-# Shared squared-euclidean distance forms (matmul identity), all routed
-# through `distance_precision()` (ops/precision.py) so the rank-critical
-# kernels (kNN/ANN/DBSCAN) change precision in one place.
+# DEPRECATED import path — the confusing `ops/distance.py` vs
+# `ops/distances.py` pair is consolidated into `ops/distances.py` (one
+# module owns every distance form: the precision-routed
+# squared-euclidean kernels AND the elementwise metric zoo).  This shim
+# keeps old `from spark_rapids_ml_tpu.ops.distance import sqdist`
+# imports working for one deprecation cycle; new code imports from
+# `spark_rapids_ml_tpu.ops.distances`.
 #
 from __future__ import annotations
 
-from typing import Optional
+import warnings
 
-import jax
-import jax.numpy as jnp
+from .distances import sqdist, sqdist_gathered
 
-from .precision import distance_precision
+warnings.warn(
+    "spark_rapids_ml_tpu.ops.distance is deprecated; import sqdist/"
+    "sqdist_gathered from spark_rapids_ml_tpu.ops.distances instead",
+    DeprecationWarning,
+    stacklevel=2,
+)
 
-
-def sqdist(
-    Q: jax.Array,  # (q, d)
-    X: jax.Array,  # (m, d)
-    q2: Optional[jax.Array] = None,  # (q, 1) optional precomputed norms
-    x2: Optional[jax.Array] = None,  # (m,)
-) -> jax.Array:
-    """(q, m) squared euclidean distances, clamped at 0."""
-    if q2 is None:
-        q2 = (Q * Q).sum(axis=1, keepdims=True)
-    if x2 is None:
-        x2 = (X * X).sum(axis=1)
-    d2 = q2 - 2.0 * jnp.matmul(Q, X.T, precision=distance_precision()) + x2
-    return jnp.maximum(d2, 0.0)
-
-
-def sqdist_gathered(
-    B: jax.Array,  # (r, d) one vector per row
-    Xc: jax.Array,  # (r, C, d) gathered candidates per row
-    b2: jax.Array,  # (r,) row-vector norms
-    c2: jax.Array,  # (r, C) candidate norms
-) -> jax.Array:
-    """(r, C) squared euclidean distances row-vs-its-candidates, clamped
-    at 0 — the gathered-candidate form used by IVF probing and the CAGRA
-    build/search."""
-    dot = jnp.einsum("rd,rcd->rc", B, Xc, precision=distance_precision())
-    return jnp.maximum(b2[:, None] - 2.0 * dot + c2, 0.0)
+__all__ = ["sqdist", "sqdist_gathered"]

@@ -19,7 +19,45 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
 from ..utils import pcast_compat, shard_map_compat
+from .precision import distance_precision
+
+# ---------------------------------------------------------------------------
+# Shared squared-euclidean forms (matmul identity), routed through
+# `distance_precision()` so the rank-critical kernels (kNN/ANN/DBSCAN)
+# change precision in one place.  Consolidated here from the old
+# one-kernel-pair `ops/distance.py` (now a deprecation shim): ONE module
+# owns every distance form.
+# ---------------------------------------------------------------------------
+
+
+def sqdist(
+    Q: jax.Array,  # (q, d)
+    X: jax.Array,  # (m, d)
+    q2: Optional[jax.Array] = None,  # (q, 1) optional precomputed norms
+    x2: Optional[jax.Array] = None,  # (m,)
+) -> jax.Array:
+    """(q, m) squared euclidean distances, clamped at 0."""
+    if q2 is None:
+        q2 = (Q * Q).sum(axis=1, keepdims=True)
+    if x2 is None:
+        x2 = (X * X).sum(axis=1)
+    d2 = q2 - 2.0 * jnp.matmul(Q, X.T, precision=distance_precision()) + x2
+    return jnp.maximum(d2, 0.0)
+
+
+def sqdist_gathered(
+    B: jax.Array,  # (r, d) one vector per row
+    Xc: jax.Array,  # (r, C, d) gathered candidates per row
+    b2: jax.Array,  # (r,) row-vector norms
+    c2: jax.Array,  # (r, C) candidate norms
+) -> jax.Array:
+    """(r, C) squared euclidean distances row-vs-its-candidates, clamped
+    at 0 — the gathered-candidate form used by IVF probing and the CAGRA
+    build/search."""
+    dot = jnp.einsum("rd,rcd->rc", B, Xc, precision=distance_precision())
+    return jnp.maximum(b2[:, None] - 2.0 * dot + c2, 0.0)
 
 MATMUL_METRICS = {
     "euclidean", "l2", "sqeuclidean", "cosine", "correlation", "hellinger",

@@ -30,7 +30,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from .distance import sqdist, sqdist_gathered
+from .distances import sqdist, sqdist_gathered
 from .precision import distance_precision
 import numpy as np
 

@@ -157,6 +157,16 @@ def kmeans_parallel_init(X: jax.Array, w: jax.Array, k: int, seed,
     return trials[jnp.argmin(costs)]
 
 
+def seed_sample_stride(n_total: int, init_rows: int) -> int:
+    """Global row stride for the seeding subsample: every `stride`-th
+    row of the dataset enters the k-means|| init, keeping the sampled
+    pool at <= `init_rows` rows.  ONE owner for the formula shared by
+    the epoch-streaming fit (streaming.py `kmeans_streaming_fit`, via
+    the registered `kmeans_sample` statistic program) so the sampled
+    pool cannot silently diverge between paths."""
+    return max(1, -(-int(n_total) // max(int(init_rows), 1)))
+
+
 def init_flops_accounting(
     init: str, k: int, d: int, init_steps: int, oversample: float
 ) -> tuple:

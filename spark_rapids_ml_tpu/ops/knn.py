@@ -33,7 +33,7 @@ from ..utils import pcast_compat, shard_map_compat
 
 def _block_sqdist(Q: jax.Array, X: jax.Array) -> jax.Array:
     """(q, m) squared euclidean distances via the matmul identity."""
-    from .distance import sqdist
+    from .distances import sqdist
 
     return sqdist(Q, X)
 

@@ -214,16 +214,26 @@ def linreg_step_unw(acc, X, y):
 
 
 def acc_to_host_f64(acc) -> dict:
-    """Device accumulator -> float64 host dict.  Kahan carries fold into
-    their primaries in f64 (`value - carry` recovers the residual of the
-    final step) and never appear in the result."""
-    host = {k: np.asarray(v, np.float64) for k, v in jax.device_get(acc).items()}
+    """Device accumulator -> host dict.  Float fields come back float64
+    with their Kahan carries folded in (`value - carry` recovers the
+    residual of the final step; carries never appear in the result).
+    INTEGER and boolean fields are dtype-preserving (widened to int64,
+    never cast through f64): a statistic program's sketch counters —
+    HyperLogLog registers, item counts — are exact integers and a float
+    round-trip would corrupt values past 2^53 and break bit-parity
+    merges."""
+    host = jax.device_get(acc)
     out = {}
     for k, v in host.items():
         if k.endswith(CARRY_SUFFIX):
             continue
+        v = np.asarray(v)
+        if v.dtype.kind in "iub":
+            out[k] = v.astype(np.int64)
+            continue
+        v = v.astype(np.float64)
         c = host.get(k + CARRY_SUFFIX)
-        out[k] = v if c is None else v - c
+        out[k] = v if c is None else v - np.asarray(c, np.float64)
     return out
 
 

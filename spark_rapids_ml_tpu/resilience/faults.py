@@ -61,6 +61,12 @@ KNOWN_SITES = frozenset({
     # accumulators — cached chunks are re-creatable state, so a retried
     # epoch can never double-count (asserted by tests/test_chunk_cache.py)
     "chunk_cache_spill",
+    # the statistic-program engine's per-chunk fold (stats/engine.py):
+    # same contract as `fused_accumulate` — accumulators are
+    # re-creatable state, a mid-pass fault fails the WHOLE pass and the
+    # retry restarts it with fresh accumulators, so a retried chunk can
+    # never double-count (asserted by tests/test_stat_programs.py)
+    "stat_program_step",
 })
 
 # Injectable fault kinds (`_Fault` validates against this; the docs and

@@ -1,0 +1,931 @@
+#
+# Statistic programs — the declarative contract ROADMAP item 5 promotes
+# PR 8's accumulator specs into.  A program is four functions over
+# fixed-shape `(X, w[, y])` chunks:
+#
+#   init(d, dtype, opts)       fresh accumulator dict (DECLARED shapes)
+#   step(acc, X, w[, y])       fold one chunk (device: jax, donated;
+#                              host: numpy, in-place-and-return)
+#   merge(a, b)                combine two partial accumulators (device
+#                              programs derive it from each field's
+#                              declared merge mode: sum | min | max)
+#   finalize(host_acc, ctx)    accumulator -> user-facing statistics
+#
+# Programs register in `STAT_PROGRAMS` with declared accumulator
+# shapes/dtypes; the declaration is VERIFIED against a probe init on
+# first use (`get_program` — import-light registration), and the
+# graft-lint `stat-program` rule anchors `run_program(...)` call sites
+# and the docs/statistics.md program table against this registry.  The engine (stats/engine.py)
+# fuses any set of registered programs into ONE pass over the data on
+# every existing chunk path (fused stage-and-solve overlap, epoch
+# chunk-cache replay, plain in-memory batches).
+#
+# The PR-8 estimator specs (`ops/stats.py` pca/linreg accumulators) are
+# REGISTERED here rather than re-implemented: fused.py and streaming.py
+# resolve their specs through this registry, so the migrated paths stay
+# numerically identical to the pre-registry outputs (asserted by
+# tests/test_stat_programs.py).
+#
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..config import get_config
+from ..ops.stats import CARRY_SUFFIX, _kahan_add
+
+
+class Field(NamedTuple):
+    """One declared accumulator field: shape (in terms of the feature
+    dimension d), dtype (None = follows the requested accumulation
+    dtype), and how two partial accumulators combine on this field."""
+
+    shape: Tuple[int, ...]
+    dtype: Optional[str] = None
+    merge: str = "sum"  # sum | min | max | slot (host slot-disjoint)
+
+
+@dataclass(frozen=True)
+class StatProgram:
+    """A registered statistic program.  `kind` is "device" (jax step,
+    donated accumulator, runs inside the engine's one jitted combined
+    step) or "host" (numpy step on the decoded chunk — the mergeable
+    sketches whose data-dependent updates have no fixed-shape jax
+    form).  `make_step(d, dtype, opts)` returns the step callable(s):
+    device programs return `(weighted_step, unweighted_step_or_None)`
+    so the fused engine keeps its full-chunk fast path; host programs
+    return one `step(acc, X, w, y, ctx)`."""
+
+    name: str
+    kind: str
+    shapes: Callable[[int, Dict[str, Any]], Dict[str, Field]]
+    init: Callable[[int, Any, Dict[str, Any]], Dict[str, Any]]
+    make_step: Callable[[int, Any, Dict[str, Any]], Any]
+    finalize: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]
+    merge: Optional[Callable[..., Dict[str, Any]]] = None
+    needs_y: bool = False
+    mergeable: bool = True
+    # precision modes the device step honors (ops/precision.py
+    # stats_precision levels; host sketches are precision-independent)
+    precision_modes: Tuple[str, ...] = ("exact",)
+    doc: str = ""
+    opts_defaults: Dict[str, Any] = dc_field(default_factory=dict)
+    # resolves CONF-derived option values (sketch sizes, bin counts)
+    # into explicit dict entries, so the engine's compiled-step cache
+    # keys on the effective geometry — a `set_config` change between
+    # runs must re-trace, never reuse a step built for the old shapes
+    resolve: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None
+    # extra per-pass step arguments (e.g. the randomized range-finder's
+    # omega): programs declaring them run only through their dedicated
+    # callers (fused.py), never the generic engine dispatch
+    extra_args: Tuple[str, ...] = ()
+
+
+STAT_PROGRAMS: Dict[str, StatProgram] = {}
+
+_PROBE_D = 3
+
+# programs whose declaration has been verified against a probe init
+# (first-use, via `get_program`): device inits build jax arrays, and a
+# probe at REGISTRATION time would initialize the XLA backend on bare
+# `import spark_rapids_ml_tpu` — which must stay legal before
+# `init_distributed()` (parallel/context.py rejects distributed init
+# once a backend exists)
+_VALIDATED: set = set()
+
+
+def register_program(p: StatProgram) -> StatProgram:
+    """Register a program.  The declared shapes/dtypes are VERIFIED
+    against a probe `init` the first time the program is fetched
+    (`get_program`) — the runtime half of the graft-lint `stat-program`
+    rule — so a program cannot drift from its declaration, while
+    registration itself stays import-light (no accelerator arrays are
+    built at package import)."""
+    if p.name in STAT_PROGRAMS:
+        raise ValueError(f"statistic program {p.name!r} already registered")
+    if p.kind not in ("device", "host"):
+        raise ValueError(f"program {p.name!r}: kind must be device|host")
+    STAT_PROGRAMS[p.name] = p
+    return p
+
+
+def _validate(p: StatProgram) -> None:
+    """Probe-init at d=3 and compare against the declaration."""
+    opts = resolve_opts(p, None)
+    declared = p.shapes(_PROBE_D, opts)
+    acc = p.init(_PROBE_D, np.float32, opts)
+    got = {k: v for k, v in acc.items() if not k.endswith(CARRY_SUFFIX)}
+    if set(got) != set(declared):
+        raise ValueError(
+            f"program {p.name!r}: init fields {sorted(got)} != declared "
+            f"{sorted(declared)}"
+        )
+    for fname, spec in declared.items():
+        v = got[fname]
+        want_shape = tuple(spec.shape)
+        if tuple(v.shape) != want_shape:
+            raise ValueError(
+                f"program {p.name!r}: field {fname!r} shape "
+                f"{tuple(v.shape)} != declared {want_shape}"
+            )
+        want_dtype = np.dtype(spec.dtype or np.float32)
+        if np.dtype(v.dtype) != want_dtype:
+            raise ValueError(
+                f"program {p.name!r}: field {fname!r} dtype {v.dtype} != "
+                f"declared {want_dtype}"
+            )
+
+
+def get_program(name: str) -> StatProgram:
+    p = STAT_PROGRAMS.get(name)
+    if p is None:
+        raise KeyError(
+            f"unknown statistic program {name!r}; registered: "
+            + ", ".join(sorted(STAT_PROGRAMS))
+        )
+    if name not in _VALIDATED:
+        _validate(p)
+        _VALIDATED.add(name)
+    return p
+
+
+def merge_accs(
+    p: StatProgram, a: Dict[str, Any], b: Dict[str, Any],
+    opts: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Combine two HOST-side partial accumulators of one program.  Host
+    programs bring their own merge; device programs merge field-wise by
+    the declared mode (their accumulators are plain commutative
+    reductions)."""
+    if not p.mergeable:
+        raise ValueError(f"program {p.name!r} is not mergeable")
+    if p.merge is not None:
+        return p.merge(a, b, resolve_opts(p, opts))
+    declared = p.shapes(_infer_d(p, a), resolve_opts(p, opts))
+    out: Dict[str, Any] = {}
+    for k, v in a.items():
+        if k.endswith(CARRY_SUFFIX):
+            continue
+        mode = declared[k].merge
+        if mode == "sum":
+            out[k] = np.asarray(v) + np.asarray(b[k])
+        elif mode == "min":
+            out[k] = np.minimum(v, b[k])
+        elif mode == "max":
+            out[k] = np.maximum(v, b[k])
+        else:
+            raise ValueError(
+                f"program {p.name!r}: field {k!r} merge mode {mode!r}"
+            )
+    return out
+
+
+def resolve_opts(
+    p: StatProgram, opts: Optional[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Effective per-program options: defaults, caller overrides, then
+    the program's conf resolution (explicit sketch/bin sizes)."""
+    merged = dict(p.opts_defaults)
+    merged.update(opts or {})
+    if p.resolve is not None:
+        merged = p.resolve(merged)
+    return merged
+
+
+def _infer_d(p: StatProgram, acc: Dict[str, Any]) -> int:
+    """The feature dimension a host accumulator was built at, read back
+    off a field whose declared shape leads with d."""
+    for fname, spec in p.shapes(_PROBE_D, resolve_opts(p, None)).items():
+        if spec.shape and spec.shape[0] == _PROBE_D:
+            return int(np.shape(acc[fname])[0])
+    return _PROBE_D
+
+
+def _zeros(
+    shapes: Dict[str, Field], d_actual: Dict[str, Field], dtype,
+    compensated_fields: Tuple[str, ...] = (),
+):
+    """Device zeros accumulator honoring per-field dtypes, with Kahan
+    carry twins on the compensated sum fields when the
+    `stats_precision` conf asks for them (ops/stats.py discipline)."""
+    import jax.numpy as jnp
+
+    from ..ops.precision import stats_compensated
+
+    del shapes  # declared probe shapes; d_actual carries the real ones
+    comp = stats_compensated()
+    acc = {}
+    for k, spec in d_actual.items():
+        dt = np.dtype(spec.dtype or dtype)
+        if spec.merge == "min":
+            acc[k] = jnp.full(spec.shape, jnp.inf, dt)
+        elif spec.merge == "max":
+            acc[k] = jnp.full(spec.shape, -jnp.inf, dt)
+        else:
+            acc[k] = jnp.zeros(spec.shape, dt)
+        if comp and k in compensated_fields:
+            acc[k + CARRY_SUFFIX] = jnp.zeros(spec.shape, dt)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# column moments / min / max  (count, mean, variance, std, norms, nnz)
+# ---------------------------------------------------------------------------
+
+_MOMENT_COMP = ("sw", "s1", "s2", "l1")
+
+
+def _moments_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    return {
+        "sw": Field(()),
+        "n": Field((), "int32"),
+        "s1": Field((d,)),
+        "s2": Field((d,)),
+        "l1": Field((d,)),
+        "nnz": Field((d,), "int32"),
+        "min": Field((d,), merge="min"),
+        "max": Field((d,), merge="max"),
+    }
+
+
+def _moments_init(d: int, dtype, opts: Dict[str, Any]):
+    return _zeros(
+        _moments_shapes(_PROBE_D, opts), _moments_shapes(d, opts),
+        dtype, _MOMENT_COMP,
+    )
+
+
+def _moments_make_step(d: int, dtype, opts: Dict[str, Any]):
+    def step(acc, X, w):
+        import jax.numpy as jnp
+
+        valid = w > 0
+        Xw = X * w[:, None]
+        out = dict(acc)
+        out.update(_kahan_add(acc, "s1", Xw.sum(axis=0)))
+        out.update(_kahan_add(acc, "s2", (Xw * X).sum(axis=0)))
+        out.update(
+            _kahan_add(acc, "l1", (jnp.abs(X) * w[:, None]).sum(axis=0))
+        )
+        out.update(_kahan_add(acc, "sw", w.sum()))
+        # exact integer counts (int32: f32 would round past 2^24 rows)
+        out["n"] = acc["n"] + valid.sum(dtype=jnp.int32)
+        out["nnz"] = acc["nnz"] + (
+            (X != 0) & valid[:, None]
+        ).sum(axis=0, dtype=jnp.int32)
+        lo = jnp.where(valid[:, None], X, jnp.inf)
+        hi = jnp.where(valid[:, None], X, -jnp.inf)
+        out["min"] = jnp.minimum(acc["min"], lo.min(axis=0))
+        out["max"] = jnp.maximum(acc["max"], hi.max(axis=0))
+        return out
+
+    return step, None
+
+
+def _moments_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    sw = float(acc["sw"])
+    mean = np.asarray(acc["s1"]) / max(sw, 1e-300)
+    # Spark MultivariateOnlineSummarizer variance: ddof-1-scaled weighted
+    # central moment (ops/stats.py weighted_moments semantics)
+    var = (np.asarray(acc["s2"]) - sw * mean * mean) / max(sw - 1.0, 1.0)
+    var = np.maximum(var, 0.0)
+    return {
+        "count": int(acc["n"]),
+        "weight_sum": sw,
+        "mean": mean,
+        "sum": np.asarray(acc["s1"]),
+        "variance": var,
+        "std": np.sqrt(var),
+        "min": np.asarray(acc["min"]),
+        "max": np.asarray(acc["max"]),
+        "norm_l1": np.asarray(acc["l1"]),
+        "norm_l2": np.sqrt(np.maximum(np.asarray(acc["s2"]), 0.0)),
+        "num_nonzeros": np.asarray(acc["nnz"]),
+    }
+
+
+register_program(StatProgram(
+    name="moments",
+    kind="device",
+    shapes=_moments_shapes,
+    init=_moments_init,
+    make_step=_moments_make_step,
+    finalize=_moments_finalize,
+    precision_modes=("exact", "high_compensated"),
+    doc="per-column count/mean/variance/std/min/max/norms/nonzeros",
+))
+
+
+def _standardization_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    """Standardization stats with the solver contract applied: zero
+    variance columns scale by 1.0 (ops/stats.py weighted_moments)."""
+    out = _moments_finalize(acc, ctx)
+    std = np.where(out["std"] == 0.0, 1.0, out["std"])
+    return {"mean": out["mean"], "std": std, "weight_sum": out["weight_sum"]}
+
+
+register_program(StatProgram(
+    name="standardization",
+    kind="device",
+    shapes=_moments_shapes,
+    init=_moments_init,
+    make_step=_moments_make_step,
+    finalize=_standardization_finalize,
+    precision_modes=("exact", "high_compensated"),
+    doc="solver standardization mean/std (zero-variance columns -> 1.0)",
+))
+
+
+# ---------------------------------------------------------------------------
+# covariance / correlation  (shares the PCA second-moment accumulator)
+# ---------------------------------------------------------------------------
+
+
+def _second_moment_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    return {"S": Field((d, d)), "s1": Field((d,)), "sw": Field(())}
+
+
+def _second_moment_init(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import pca_moment_acc
+
+    acc, _ = pca_moment_acc(d, np.dtype(dtype))
+    return acc
+
+
+def _second_moment_make_step(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import pca_moment_acc, pca_moment_step_unw
+
+    _, step = pca_moment_acc(d, np.dtype(dtype))
+    return step, pca_moment_step_unw
+
+
+def _covariance_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    sw = float(acc["sw"])
+    mean = np.asarray(acc["s1"]) / max(sw, 1e-300)
+    cov = (
+        np.asarray(acc["S"]) - sw * np.outer(mean, mean)
+    ) / max(sw - 1.0, 1.0)
+    cov = (cov + cov.T) / 2.0  # symmetrize away accumulation round-off
+    sd = np.sqrt(np.maximum(np.diag(cov), 0.0))
+    denom = np.outer(sd, sd)
+    corr = np.divide(
+        cov, denom, out=np.full_like(cov, np.nan), where=denom > 0
+    )
+    np.fill_diagonal(corr, 1.0)
+    return {"mean": mean, "covariance": cov, "correlation": corr,
+            "weight_sum": sw}
+
+
+register_program(StatProgram(
+    name="covariance",
+    kind="device",
+    shapes=_second_moment_shapes,
+    init=_second_moment_init,
+    make_step=_second_moment_make_step,
+    finalize=_covariance_finalize,
+    precision_modes=("exact", "high_compensated"),
+    doc="covariance + correlation matrices from one Gram pass",
+))
+
+
+# ---------------------------------------------------------------------------
+# migrated estimator specs (PR 8): fused.py / streaming.py resolve their
+# accumulators THROUGH these registrations
+# ---------------------------------------------------------------------------
+
+
+def _pca_moments_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    return dict(acc)  # PCA._attrs_from_moments consumes S/s1/sw raw
+
+
+register_program(StatProgram(
+    name="pca_moments",
+    kind="device",
+    shapes=_second_moment_shapes,
+    init=_second_moment_init,
+    make_step=_second_moment_make_step,
+    finalize=_pca_moments_finalize,
+    precision_modes=("exact", "high_compensated"),
+    doc="PCA exact second moments (migrated ops/stats.py pca_moment_acc)",
+))
+
+
+def _pca_projected_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    l = int(opts.get("l", 8))
+    return {
+        "SOm": Field((d, l)), "s1": Field((d,)), "ssq": Field((d,)),
+        "sw": Field(()),
+    }
+
+
+def _pca_projected_init(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import pca_projected_acc
+
+    acc, _ = pca_projected_acc(d, int(opts.get("l", 8)), np.dtype(dtype))
+    return acc
+
+
+def _pca_projected_make_step(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import pca_projected_acc, pca_projected_step_unw
+
+    _, step = pca_projected_acc(d, int(opts.get("l", 8)), np.dtype(dtype))
+    return step, pca_projected_step_unw
+
+
+register_program(StatProgram(
+    name="pca_projected",
+    kind="device",
+    shapes=_pca_projected_shapes,
+    init=_pca_projected_init,
+    make_step=_pca_projected_make_step,
+    finalize=lambda acc, ctx: dict(acc),
+    precision_modes=("exact", "high_compensated"),
+    doc="randomized-PCA projected moments (takes the range-finder's "
+        "omega as an extra step argument)",
+    opts_defaults={"l": 8},
+    extra_args=("omega",),
+))
+
+
+def _linreg_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    return {
+        "gram": Field((d, d)), "sxy": Field((d,)), "s1": Field((d,)),
+        "sw": Field(()), "sy": Field(()), "syy": Field(()),
+    }
+
+
+def _linreg_init(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import linreg_acc
+
+    acc, _ = linreg_acc(d, np.dtype(dtype))
+    return acc
+
+
+def _linreg_make_step(d: int, dtype, opts: Dict[str, Any]):
+    from ..ops.stats import linreg_acc, linreg_step_unw
+
+    _, step = linreg_acc(d, np.dtype(dtype))
+    return step, linreg_step_unw
+
+
+register_program(StatProgram(
+    name="linreg",
+    kind="device",
+    shapes=_linreg_shapes,
+    init=_linreg_init,
+    make_step=_linreg_make_step,
+    finalize=lambda acc, ctx: dict(acc),
+    needs_y=True,
+    precision_modes=("exact", "high_compensated"),
+    doc="weighted Gram/moment/cross statistics (migrated ops/stats.py "
+        "linreg_acc)",
+))
+
+
+# ---------------------------------------------------------------------------
+# hypothesis tests: grouped moments (t-test) and contingency (chi-squared)
+# ---------------------------------------------------------------------------
+
+
+def _grouped_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    return {
+        "gn": Field((2,)), "gs1": Field((2, d)), "gs2": Field((2, d)),
+    }
+
+
+def _grouped_init(d: int, dtype, opts: Dict[str, Any]):
+    return _zeros(
+        _grouped_shapes(_PROBE_D, opts), _grouped_shapes(d, opts),
+        dtype, ("gs1", "gs2"),
+    )
+
+
+def _grouped_make_step(d: int, dtype, opts: Dict[str, Any]):
+    def step(acc, X, w, y):
+        import jax.numpy as jnp
+
+        g1 = (y > 0.5).astype(X.dtype)
+        gw = jnp.stack([w * (1.0 - g1), w * g1])  # (2, rows)
+        out = dict(acc)
+        out["gn"] = acc["gn"] + gw.sum(axis=1)
+        out.update(_kahan_add(acc, "gs1", gw @ X))
+        out.update(_kahan_add(acc, "gs2", gw @ (X * X)))
+        return out
+
+    return step, None
+
+
+def _ttest_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    """Per-column Welch two-sample t-test between label groups 0/1."""
+    n = np.asarray(acc["gn"], np.float64)  # (2,)
+    s1 = np.asarray(acc["gs1"], np.float64)
+    s2 = np.asarray(acc["gs2"], np.float64)
+    mean = s1 / np.maximum(n[:, None], 1e-300)
+    var = (s2 - n[:, None] * mean * mean) / np.maximum(
+        n[:, None] - 1.0, 1.0
+    )
+    var = np.maximum(var, 0.0)
+    se2 = var[0] / max(n[0], 1.0) + var[1] / max(n[1], 1.0)
+    t = (mean[0] - mean[1]) / np.sqrt(np.maximum(se2, 1e-300))
+    df_num = se2 * se2
+    df_den = (
+        (var[0] / max(n[0], 1.0)) ** 2 / max(n[0] - 1.0, 1.0)
+        + (var[1] / max(n[1], 1.0)) ** 2 / max(n[1] - 1.0, 1.0)
+    )
+    df = df_num / np.maximum(df_den, 1e-300)
+    return {
+        "t": t, "df": df, "p_value": _t_sf(np.abs(t), df) * 2.0,
+        "group_counts": n, "group_means": mean, "group_variances": var,
+    }
+
+
+def _t_sf(t: np.ndarray, df: np.ndarray) -> np.ndarray:
+    try:
+        from scipy.stats import t as t_dist
+
+        return t_dist.sf(t, np.maximum(df, 1e-9))
+    except ImportError:  # pragma: no cover - scipy ships in the image
+        from math import erf, sqrt
+
+        return np.asarray(
+            [0.5 * (1.0 - erf(float(x) / sqrt(2.0))) for x in np.ravel(t)]
+        ).reshape(np.shape(t))
+
+
+register_program(StatProgram(
+    name="ttest",
+    kind="device",
+    shapes=_grouped_shapes,
+    init=_grouped_init,
+    make_step=_grouped_make_step,
+    finalize=_ttest_finalize,
+    needs_y=True,
+    precision_modes=("exact", "high_compensated"),
+    doc="per-column Welch two-sample t-test between label groups 0/1",
+))
+
+
+def _contingency_bins(opts: Dict[str, Any]) -> int:
+    return int(opts.get("bins") or get_config("summarizer_chi2_bins"))
+
+
+def _contingency_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    b = _contingency_bins(opts)
+    return {"counts": Field((d, b, b))}
+
+
+def _contingency_init(d: int, dtype, opts: Dict[str, Any]):
+    return _zeros(
+        _contingency_shapes(_PROBE_D, opts), _contingency_shapes(d, opts),
+        dtype,
+    )
+
+
+def _contingency_make_step(d: int, dtype, opts: Dict[str, Any]):
+    b = _contingency_bins(opts)
+
+    def step(acc, X, w, y):
+        import jax.numpy as jnp
+
+        counts = acc["counts"]
+        xi = jnp.clip(jnp.round(X).astype(jnp.int32), 0, b - 1)
+        yi = jnp.clip(jnp.round(y).astype(jnp.int32), 0, b - 1)
+        flat = counts.reshape(-1)
+        cols = jnp.arange(X.shape[1], dtype=jnp.int32)[None, :]
+        idx = (cols * (b * b) + xi * b + yi[:, None]).reshape(-1)
+        upd = jnp.broadcast_to(
+            w[:, None].astype(counts.dtype), xi.shape
+        ).reshape(-1)
+        out = dict(acc)
+        out["counts"] = flat.at[idx].add(upd).reshape(counts.shape)
+        return out
+
+    return step, None
+
+
+def _chi2_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    """Per-column chi-squared test of independence between the (integer
+    -coded, clipped to `summarizer_chi2_bins`) feature and the label."""
+    counts = np.asarray(acc["counts"], np.float64)
+    d = counts.shape[0]
+    stat = np.zeros((d,))
+    dof = np.zeros((d,), np.int64)
+    p = np.ones((d,))
+    for j in range(d):
+        O = counts[j]
+        O = O[O.sum(axis=1) > 0][:, O.sum(axis=0) > 0]
+        if O.shape[0] < 2 or O.shape[1] < 2:
+            continue
+        n = O.sum()
+        E = np.outer(O.sum(axis=1), O.sum(axis=0)) / n
+        stat[j] = float(((O - E) ** 2 / E).sum())
+        dof[j] = (O.shape[0] - 1) * (O.shape[1] - 1)
+        p[j] = _chi2_sf(stat[j], int(dof[j]))
+    return {"statistic": stat, "dof": dof, "p_value": p}
+
+
+def _chi2_sf(x: float, dof: int) -> float:
+    try:
+        from scipy.stats import chi2 as chi2_dist
+
+        return float(chi2_dist.sf(x, dof))
+    except ImportError:  # pragma: no cover - scipy ships in the image
+        from math import exp
+
+        return float(exp(-x / 2.0))
+
+
+register_program(StatProgram(
+    name="chi2",
+    kind="device",
+    shapes=_contingency_shapes,
+    init=_contingency_init,
+    make_step=_contingency_make_step,
+    finalize=_chi2_finalize,
+    needs_y=True,
+    doc="per-column chi-squared independence test vs the label (binned "
+        "contingency counts)",
+    resolve=lambda opts: dict(opts, bins=_contingency_bins(opts)),
+))
+
+
+# ---------------------------------------------------------------------------
+# HyperLogLog distinct counts (device; int32 registers exercise the
+# dtype-preserving accumulator fold)
+# ---------------------------------------------------------------------------
+
+
+def _hll_bits(opts: Dict[str, Any]) -> int:
+    return int(opts.get("bits") or get_config("summarizer_hll_bits"))
+
+
+def _hll_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    return {"regs": Field((d, 2 ** _hll_bits(opts)), "int32", merge="max")}
+
+
+def _hll_init(d: int, dtype, opts: Dict[str, Any]):
+    import jax.numpy as jnp
+
+    return {"regs": jnp.zeros((d, 2 ** _hll_bits(opts)), jnp.int32)}
+
+
+def _hll_make_step(d: int, dtype, opts: Dict[str, Any]):
+    p_bits = _hll_bits(opts)
+    m = 2 ** p_bits
+
+    def step(acc, X, w):
+        import jax
+        import jax.numpy as jnp
+
+        # canonicalize -0.0 -> +0.0 so equal values hash equal, then
+        # murmur3-finalize the f32 bit pattern
+        h = jax.lax.bitcast_convert_type(
+            (X + 0.0).astype(jnp.float32), jnp.uint32
+        )
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        bucket = (h >> (32 - p_bits)).astype(jnp.int32)
+        rest = jax.lax.bitcast_convert_type(h << p_bits, jnp.int32)
+        rho = jnp.minimum(jax.lax.clz(rest) + 1, 32 - p_bits + 1)
+        rho = jnp.where((w > 0)[:, None], rho, 0).astype(jnp.int32)
+        cols = jnp.arange(X.shape[1], dtype=jnp.int32)[None, :]
+        idx = (cols * m + bucket).reshape(-1)
+        regs = acc["regs"].reshape(-1).at[idx].max(rho.reshape(-1))
+        return {"regs": regs.reshape(acc["regs"].shape)}
+
+    return step, None
+
+
+def _hll_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    from .sketches import hll_estimate
+
+    return {"distinct": hll_estimate(np.asarray(acc["regs"]))}
+
+
+register_program(StatProgram(
+    name="distinct_count",
+    kind="device",
+    shapes=_hll_shapes,
+    init=_hll_init,
+    make_step=_hll_make_step,
+    finalize=_hll_finalize,
+    doc="per-column HyperLogLog approximate distinct counts",
+    resolve=lambda opts: dict(opts, bits=_hll_bits(opts)),
+))
+
+
+# ---------------------------------------------------------------------------
+# host sketch programs: KLL-style quantiles, Misra-Gries frequent items
+# ---------------------------------------------------------------------------
+
+
+def _qk(opts: Dict[str, Any]) -> int:
+    return int(opts.get("k") or get_config("summarizer_sketch_k"))
+
+
+def _quantile_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    from .sketches import QUANTILE_LEVELS
+
+    k = _qk(opts)
+    return {
+        "items": Field((d, QUANTILE_LEVELS, k), "float64", merge="slot"),
+        "sizes": Field((QUANTILE_LEVELS,), "int64", merge="slot"),
+        "n": Field((), "int64"),
+    }
+
+
+def _quantile_init(d: int, dtype, opts: Dict[str, Any]):
+    from .sketches import quantile_init
+
+    return quantile_init(d, _qk(opts))
+
+
+def _quantile_make_step(d: int, dtype, opts: Dict[str, Any]):
+    from .sketches import quantile_update
+
+    k = _qk(opts)
+
+    def step(acc, X, w, y, ctx):
+        return quantile_update(acc, X, np.asarray(w) > 0, k)
+
+    return step
+
+
+def _quantile_merge(a, b, opts: Dict[str, Any]):
+    from .sketches import quantile_merge
+
+    return quantile_merge(a, b, _qk(opts))
+
+
+def _quantile_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    from .sketches import quantile_query
+
+    qs = ctx.get("quantiles") or (0.25, 0.5, 0.75)
+    vals = quantile_query(acc, qs)
+    return {
+        "n": int(acc["n"]),
+        "quantiles": {float(q): vals[:, i] for i, q in enumerate(qs)},
+        "state": acc,
+    }
+
+
+register_program(StatProgram(
+    name="quantile_sketch",
+    kind="host",
+    shapes=_quantile_shapes,
+    init=_quantile_init,
+    make_step=_quantile_make_step,
+    finalize=_quantile_finalize,
+    merge=_quantile_merge,
+    doc="mergeable KLL-style per-column quantile sketch",
+    resolve=lambda opts: dict(opts, k=_qk(opts)),
+))
+
+
+def _fk(opts: Dict[str, Any]) -> int:
+    return int(opts.get("cap") or get_config("summarizer_frequent_k"))
+
+
+def _frequent_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    cap = _fk(opts)
+    return {
+        "keys": Field((d, cap), "float64", merge="slot"),
+        "counts": Field((d, cap), "int64", merge="slot"),
+        "err": Field((d,), "int64"),
+        "n": Field((), "int64"),
+    }
+
+
+def _frequent_init(d: int, dtype, opts: Dict[str, Any]):
+    from .sketches import frequent_init
+
+    return frequent_init(d, _fk(opts))
+
+
+def _frequent_make_step(d: int, dtype, opts: Dict[str, Any]):
+    from .sketches import frequent_update
+
+    cap = _fk(opts)
+
+    def step(acc, X, w, y, ctx):
+        return frequent_update(acc, X, np.asarray(w) > 0, cap)
+
+    return step
+
+
+def _frequent_merge(a, b, opts: Dict[str, Any]):
+    from .sketches import frequent_merge
+
+    return frequent_merge(a, b, _fk(opts))
+
+
+def _frequent_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    from .sketches import frequent_items_result
+
+    return {
+        "n": int(acc["n"]),
+        "items": frequent_items_result(acc),
+        "error_bound": np.asarray(acc["err"]),
+        "state": acc,
+    }
+
+
+register_program(StatProgram(
+    name="frequent_items",
+    kind="host",
+    shapes=_frequent_shapes,
+    init=_frequent_init,
+    make_step=_frequent_make_step,
+    finalize=_frequent_finalize,
+    merge=_frequent_merge,
+    doc="Misra-Gries per-column frequent items (count lower bounds with "
+        "a declared error slack)",
+    resolve=lambda opts: dict(opts, cap=_fk(opts)),
+))
+
+
+# ---------------------------------------------------------------------------
+# seeded k-means|| init sampling (migrated from the inline
+# streaming.kmeans_streaming_fit collection loop): a strided global
+# subsample assembled slot-disjointly from chunks, so any chunk order /
+# chunk split reconstructs the IDENTICAL sample (byte parity asserted)
+# ---------------------------------------------------------------------------
+
+
+def _ks_opts(opts: Dict[str, Any]) -> Tuple[int, int]:
+    return int(opts.get("stride", 1)), int(opts.get("cap", 8))
+
+
+def _kmeans_sample_shapes(d: int, opts: Dict[str, Any]) -> Dict[str, Field]:
+    _, cap = _ks_opts(opts)
+    return {
+        "rows": Field((cap, d), "float64", merge="slot"),
+        "w": Field((cap,), "float64", merge="slot"),
+        "mask": Field((cap,), "int64", merge="slot"),
+    }
+
+
+def _kmeans_sample_init(d: int, dtype, opts: Dict[str, Any]):
+    _, cap = _ks_opts(opts)
+    return {
+        "rows": np.zeros((cap, d), np.float64),
+        "w": np.zeros((cap,), np.float64),
+        "mask": np.zeros((cap,), np.int64),
+    }
+
+
+def _kmeans_sample_make_step(d: int, dtype, opts: Dict[str, Any]):
+    stride, cap = _ks_opts(opts)
+
+    def step(acc, X, w, y, ctx):
+        offset = int(ctx["offset"])
+        n_c = int(ctx["n_valid"])
+        gidx = np.arange(offset, offset + n_c)
+        pick = (gidx % stride) == 0
+        if pick.any():
+            slots = gidx[pick] // stride
+            slots = slots[slots < cap]
+            pick = np.flatnonzero(pick)[: slots.size]
+            acc["rows"][slots] = np.asarray(X[:n_c][pick], np.float64)
+            acc["w"][slots] = np.asarray(w[:n_c][pick], np.float64)
+            acc["mask"][slots] = 1
+        return acc
+
+    return step
+
+
+def _kmeans_sample_merge(a, b, opts: Dict[str, Any]):
+    take = np.asarray(b["mask"]) > 0
+    out = {k: np.array(v) for k, v in a.items()}
+    out["rows"][take] = np.asarray(b["rows"])[take]
+    out["w"][take] = np.asarray(b["w"])[take]
+    out["mask"][take] = 1
+    return out
+
+
+def _kmeans_sample_finalize(acc: Dict[str, Any], ctx: Dict[str, Any]):
+    filled = np.asarray(acc["mask"]) > 0
+    return {
+        "X": np.asarray(acc["rows"])[filled],
+        "w": np.asarray(acc["w"])[filled],
+        "count": int(filled.sum()),
+    }
+
+
+register_program(StatProgram(
+    name="kmeans_sample",
+    kind="host",
+    shapes=_kmeans_sample_shapes,
+    init=_kmeans_sample_init,
+    make_step=_kmeans_sample_make_step,
+    finalize=_kmeans_sample_finalize,
+    merge=_kmeans_sample_merge,
+    doc="strided global row subsample feeding the seeded k-means|| init "
+        "(slot-disjoint: any chunking assembles the identical sample)",
+    opts_defaults={"stride": 1, "cap": 8},
+))

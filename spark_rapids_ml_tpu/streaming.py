@@ -823,28 +823,33 @@ def _sum_across_processes(host_stats: dict) -> dict:
 def _linreg_acc(d: int, dtype):
     """(initial accumulator, donated jitted step) for the weighted
     Gram/moment/cross statistics (ops/linear.py `linreg_sufficient_stats`)
-    — shared by the parquet-streaming and blocked-CSR fits.  The update
-    math (incl. the optional Kahan compensation under
-    `stats_precision="high_compensated"`) lives in the shared spec
-    (ops/stats.py `linreg_acc`), the same one the fused stage-and-solve
-    engine accumulates through."""
+    — shared by the parquet-streaming and blocked-CSR fits.  The spec
+    resolves through the statistic-program registry (stats/programs.py
+    `linreg` — the migrated ops/stats.py spec, incl. the optional Kahan
+    compensation under `stats_precision="high_compensated"`), the same
+    one the fused stage-and-solve engine accumulates through."""
     import jax
 
-    from .ops.stats import linreg_acc
+    from .stats.programs import get_program
 
-    acc, step = linreg_acc(d, dtype)
-    return acc, jax.jit(step, donate_argnums=0)
+    p = get_program("linreg")
+    dtype = np.dtype(dtype)
+    step, _unw = p.make_step(d, dtype, {})
+    return p.init(d, dtype, {}), jax.jit(step, donate_argnums=0)
 
 
 def _pca_acc(d: int, dtype):
     """(initial accumulator, donated jitted step) for the PCA second
-    moments (S = sum w x x^T, s1, sw) — shared spec, see `_linreg_acc`."""
+    moments (S = sum w x x^T, s1, sw) — the registered `pca_moments`
+    program, see `_linreg_acc`."""
     import jax
 
-    from .ops.stats import pca_moment_acc
+    from .stats.programs import get_program
 
-    acc, step = pca_moment_acc(d, dtype)
-    return acc, jax.jit(step, donate_argnums=0)
+    p = get_program("pca_moments")
+    dtype = np.dtype(dtype)
+    step, _unw = p.make_step(d, dtype, {})
+    return p.init(d, dtype, {}), jax.jit(step, donate_argnums=0)
 
 
 def iter_csr_chunks(
@@ -1524,7 +1529,12 @@ def kmeans_streaming_fit(
     import jax
     import jax.numpy as jnp
 
-    from .ops.kmeans import _pairwise_sqdist, kmeans_init, kmeans_parallel_init
+    from .ops.kmeans import (
+        _pairwise_sqdist,
+        kmeans_init,
+        kmeans_parallel_init,
+        seed_sample_stride,
+    )
 
     dtype = np.dtype(dtype)
     d = probe_num_features(path, features_col, features_cols)
@@ -1536,33 +1546,31 @@ def kmeans_streaming_fit(
     lo, hi = _process_row_range(n_total)
 
     # ---- strided global subsample for seeding (every process contributes
-    # its rows at the same global stride, then all-gathers) ----
-    stride = max(1, -(-n_total // init_rows))
-    sampleX: list = []
-    samplew: list = []
-    at = lo
-    for cX, _, cw, n_c in iter_chunks(
-        path, features_col, features_cols, None, weight_col,
-        chunk_rows, dtype, row_range=(lo, hi),
-    ):
-        gidx = np.arange(at, at + n_c)
-        pick = (gidx % stride) == 0
-        if pick.any():
-            sampleX.append(cX[:n_c][pick].copy())
-            samplew.append(
-                np.ones((int(pick.sum()),), np.float64)
-                if cw is None
-                else cw[:n_c][pick].astype(np.float64)
-            )
-        at += n_c
-    Xs_host = (
-        np.concatenate(sampleX, axis=0)
-        if sampleX
-        else np.zeros((0, d), dtype)
+    # its rows at the same global stride, then all-gathers).  The
+    # collection runs as the registered `kmeans_sample` statistic
+    # program (stats/programs.py): slot-disjoint per-chunk folds, so any
+    # chunking assembles the identical sample (byte parity with the
+    # pre-migration inline loop asserted by tests/test_stat_programs.py)
+    stride = seed_sample_stride(n_total, init_rows)
+    cap = (n_total - 1) // stride + 1
+    from .stats.engine import iter_chunk_accs
+    from .stats.programs import get_program
+
+    sample = get_program("kmeans_sample").finalize(
+        iter_chunk_accs(
+            "kmeans_sample",
+            iter_chunks(
+                path, features_col, features_cols, None, weight_col,
+                chunk_rows, dtype, row_range=(lo, hi),
+            ),
+            d, dtype,
+            opts={"stride": stride, "cap": cap},
+            offset0=lo,
+        ),
+        {},
     )
-    ws_host = (
-        np.concatenate(samplew, axis=0) if samplew else np.zeros((0,))
-    )
+    Xs_host = np.asarray(sample["X"], dtype)
+    ws_host = np.asarray(sample["w"], np.float64)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 

@@ -163,6 +163,21 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "recovery": {"kind": "view", "labels": ("key",), "cardinality": 16},
     "fused_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "pca_solver_last": {"kind": "view", "labels": ("key",), "cardinality": 16},
+    # statistic-program engine (stats/engine.py): executions per
+    # registered program, wall seconds per fused multi-program pass
+    # (labeled by the run's caller-facing label — summarize / describe /
+    # estimator names, a fixed vocabulary), and the last-run state the
+    # fit report's `stats` section and bench.py's `summarize` section
+    # copy
+    "stat_program_runs_total": {
+        "kind": "counter", "labels": ("program",), "cardinality": 64,
+    },
+    "stat_program_pass_seconds": {
+        "kind": "histogram", "labels": ("label",), "cardinality": 32,
+    },
+    "stat_program_last": {
+        "kind": "view", "labels": ("key",), "cardinality": 32,
+    },
 }
 
 _DEFAULT_BUCKETS = (

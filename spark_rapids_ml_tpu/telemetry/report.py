@@ -330,6 +330,29 @@ class FitTelemetry:
                 }
         except Exception:
             pass
+        # statistic-program engine metrics (stats/engine.py
+        # STAT_METRICS): same last-run-state discipline — a fused
+        # multi-program pass that completed inside this fit's window
+        # lands as the report's `stats` section
+        stats_section: Dict[str, Any] = {}
+        try:
+            from ..stats.engine import STAT_METRICS
+
+            if (
+                not self._overlapped
+                and STAT_METRICS.get("stamp", 0) >= self._t0
+            ):
+                stats_section = {
+                    k: STAT_METRICS.get(k)
+                    for k in (
+                        "label", "programs", "passes", "chunks", "bytes",
+                        "wall_s", "host_prep_s", "device_acc_s",
+                        "overlap_s", "overlap_fraction",
+                    )
+                    if STAT_METRICS.get(k) is not None
+                }
+        except Exception:
+            pass
         try:
             from ..ops.pca import LAST_SOLVER_DECISION
 
@@ -385,6 +408,8 @@ class FitTelemetry:
             report["chunk_cache"] = chunk_cache
         if fused:
             report["fused"] = fused
+        if stats_section:
+            report["stats"] = stats_section
         if solver_decision:
             report["solver_decision"] = solver_decision
         if self._watermark is not None:

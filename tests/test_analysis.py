@@ -362,6 +362,80 @@ def test_check_cardinality_bounds():
 
 
 # ---------------------------------------------------------------------------
+# stat-program
+# ---------------------------------------------------------------------------
+
+
+_STATS_STUB = (
+    "def register_program(p):\n    pass\n"
+    "class StatProgram:\n"
+    "    def __init__(self, **kw):\n        pass\n"
+)
+
+
+def test_stat_program_violations(tmp_path):
+    from spark_rapids_ml_tpu.analysis.rules_stats import StatProgramRule
+
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/stats/programs.py": _STATS_STUB + (
+            "register_program(StatProgram(name='good', kind='device',\n"
+            "                             shapes=None))\n"
+            "register_program(StatProgram(name='good', kind='device',\n"
+            "                             shapes=None))\n"
+            "register_program(StatProgram(kind='device', shapes=None))\n"
+            "register_program(StatProgram(name='noshape', kind='device'))\n"
+        ),
+        "spark_rapids_ml_tpu/user.py": (
+            "from .stats.engine import run_program\n"
+            "def f(src):\n"
+            "    return run_program('missing', src)\n"
+        ),
+        "docs/statistics.md": "programs: `good`\n",
+    })
+    msgs = messages(run_analysis(project, rules=[StatProgramRule()]))
+    assert any("registered twice" in m for m in msgs)
+    assert any("literal `name=`" in m for m in msgs)
+    assert any("`shapes=`" in m for m in msgs)
+    assert any(
+        "names no registered statistic program" in m for m in msgs
+    )
+    assert any("`noshape` is not" in m for m in msgs)
+
+
+def test_stat_program_clean_and_docs_gate(tmp_path):
+    from spark_rapids_ml_tpu.analysis.rules_stats import StatProgramRule
+
+    files = {
+        "spark_rapids_ml_tpu/stats/programs.py": _STATS_STUB + (
+            "register_program(StatProgram(name='good', kind='device',\n"
+            "                             shapes=None))\n"
+        ),
+        "spark_rapids_ml_tpu/user.py": (
+            "from .stats.engine import run_program\n"
+            "def f(src):\n"
+            "    return run_program('good', src)\n"
+        ),
+        "docs/statistics.md": "programs: `good`\n",
+    }
+    assert not run_analysis(
+        make_tree(tmp_path / "clean", files), rules=[StatProgramRule()]
+    )
+    # a tree with no stats registry at all is clean too (the rule only
+    # anchors once programs exist)
+    assert not run_analysis(
+        make_tree(tmp_path / "bare", {}), rules=[StatProgramRule()]
+    )
+    # dropping the docs page fires the registry-documented gate
+    files_no_doc = dict(files)
+    files_no_doc["docs/statistics.md"] = "nothing here\n"
+    msgs = messages(run_analysis(
+        make_tree(tmp_path / "nodoc", files_no_doc),
+        rules=[StatProgramRule()],
+    ))
+    assert any("not listed in docs/statistics.md" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
 # thread-lock
 # ---------------------------------------------------------------------------
 

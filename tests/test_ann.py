@@ -284,7 +284,7 @@ def test_distance_precision_config_retraces():
     import jax
 
     from spark_rapids_ml_tpu.config import reset_config, set_config
-    from spark_rapids_ml_tpu.ops.distance import sqdist
+    from spark_rapids_ml_tpu.ops.distances import sqdist
 
     f = jax.jit(sqdist)
     a = np.ones((4, 3), np.float32)
