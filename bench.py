@@ -47,7 +47,7 @@ WORKLOADS = [
     for w in os.environ.get(
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
-        "serving,streaming,summarize,epoch_cache,refconfig,rf",
+        "serving,drift,streaming,summarize,epoch_cache,refconfig,rf",
     ).split(",")
 ]
 
@@ -1245,6 +1245,94 @@ def bench_serving(extra: dict):
         server.registry.clear()
 
 
+def bench_drift(extra: dict):
+    """Drift monitor (spark_rapids_ml_tpu/monitor/): serving-side fold
+    overhead in us/row (the host-tier cost every served batch pays once
+    a baseline is registered — acceptance < 5 us/row amortized), drift
+    detection latency for a sustained 2-sigma mean shift, and the
+    score separation between shifted and clean traffic (the
+    signal-vs-noise margin the alert threshold sits in)."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.config import get_config, set_config
+    from spark_rapids_ml_tpu.monitor import MONITOR, BaselineBuilder
+
+    n_fit = min(N_ROWS, 50_000)
+    d = int(os.environ.get("BENCH_DRIFT_COLS", 32))
+    rng = _rng(31)
+    X = rng.standard_normal((n_fit, d)).astype(np.float32)
+
+    # baseline straight from the builder (the fused fold is the same
+    # code path; the bench isolates the monitor's own cost)
+    bb = BaselineBuilder(d)
+    bb.update(X)
+    baseline = bb.finalize()
+
+    prev_conf = {
+        k: get_config(k)
+        for k in (
+            "drift_window_s", "drift_min_window_rows",
+            "drift_alert_threshold",
+        )
+    }
+    set_config(
+        drift_window_s=3600.0,  # no mid-bench tumble
+        drift_min_window_rows=256,
+        drift_alert_threshold=0.0,  # measuring, not alerting
+    )
+    MONITOR.register("bench_drift", baseline)
+    try:
+        # fold overhead: serving-shaped small batches through observe().
+        # Batches are DISTINCT draws — recycling a few buffers would
+        # repeat the same rows 50x and the uniqueness-ratio statistic
+        # would (correctly) flag the repetition as drift
+        batch_rows = 64
+        n_batches = int(os.environ.get("BENCH_DRIFT_BATCHES", 400))
+        traffic = rng.standard_normal(
+            (n_batches * batch_rows, d)
+        ).astype(np.float32)
+        MONITOR.observe("bench_drift", traffic[:batch_rows])  # warm
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            MONITOR.observe(
+                "bench_drift",
+                traffic[i * batch_rows:(i + 1) * batch_rows],
+            )
+        fold_s = time.perf_counter() - t0
+        rows = n_batches * batch_rows
+        extra["drift_fold_us_per_row"] = round(fold_s / rows * 1e6, 3)
+        extra["drift_fold_rows_per_sec"] = round(rows / fold_s, 1)
+
+        # clean score (the false-positive floor)
+        t = MONITOR.refresh("bench_drift")
+        extra["drift_clean_score"] = t["overall"] if t else None
+
+        # detection latency: re-register (fresh windows), stream a
+        # 2-sigma shifted column until the overall score crosses the
+        # classic 0.25 PSI action threshold
+        MONITOR.register("bench_drift", baseline)
+        shifted = traffic.copy()
+        shifted[:, 3] += 2.0
+        t0 = time.perf_counter()
+        detect_s = None
+        for i in range(n_batches):
+            MONITOR.observe(
+                "bench_drift",
+                shifted[i * batch_rows:(i + 1) * batch_rows],
+            )
+            t = MONITOR.refresh("bench_drift")
+            if t is not None and t["overall"] >= 0.25:
+                detect_s = time.perf_counter() - t0
+                extra["drift_detect_rows"] = (i + 1) * batch_rows
+                break
+        if detect_s is not None:
+            extra["drift_detection_sec"] = round(detect_s, 4)
+            extra["drift_shifted_score"] = t["overall"]
+    finally:
+        MONITOR.drop("bench_drift")
+        set_config(**prev_conf)  # later sections keep the operator confs
+
+
 def bench_cv_cached(extra: dict):
     """Device-resident dataset cache (parallel/device_cache.py): a
     k-fold CrossValidator run on the stage-once cached driver vs the
@@ -1947,6 +2035,7 @@ def main() -> None:
         "staging": bench_staging,
         "cv_cached": bench_cv_cached,
         "serving": bench_serving,
+        "drift": bench_drift,
         "streaming": bench_streaming,
         "summarize": bench_summarize,
         "epoch_cache": bench_epoch_cache,

@@ -48,6 +48,10 @@ _LOWER_BETTER = (
     # serving and epochs 2..n re-pay the parquet decode
     "_over_epoch1",
     "_projection_hours",
+    # the drift monitor's serving-side fold cost (bench.py `drift`
+    # section): the sketches must stay amortized-cheap per row or the
+    # host tier starts costing the dispatcher throughput
+    "_us_per_row",
 )
 _HIGHER_BETTER = (
     "_per_sec",

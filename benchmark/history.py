@@ -35,6 +35,10 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("cagra_", "ann"),
     ("knn_", "knn"),
     ("dbscan_", "dbscan"),
+    # drift monitor (bench.py `drift` section): serving-side fold
+    # overhead (us/row, lower-better), detection latency (sec), and the
+    # shifted/clean score separation (informational)
+    ("drift_", "drift"),
     ("epoch_cache_", "epoch_cache"),
     ("fused_", "fused_pca"),
     ("kmeans_", "kmeans"),

@@ -53,7 +53,7 @@ mods = [
     "spark_rapids_ml_tpu.tuning", "spark_rapids_ml_tpu.pipeline",
     "spark_rapids_ml_tpu.sklearn_api", "spark_rapids_ml_tpu.spark_interop",
     "spark_rapids_ml_tpu.streaming", "spark_rapids_ml_tpu.metrics",
-    "spark_rapids_ml_tpu.stats",
+    "spark_rapids_ml_tpu.stats", "spark_rapids_ml_tpu.monitor",
     "spark_rapids_ml_tpu.resilience", "spark_rapids_ml_tpu.telemetry",
     "benchmark.benchmark_runner", "benchmark.gen_data",
     "benchmark.gen_data_distributed",
@@ -118,7 +118,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
-    tests/test_serving.py \
+    tests/test_serving.py tests/test_drift_monitor.py \
     tests/test_flight_recorder.py tests/test_aggregate.py \
     tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
@@ -386,6 +386,92 @@ for fam, labels in (
 assert not any(k[0] == pre + "serving_rejections_total" for k in parsed)
 server.stop()
 print("serving smoke OK: zero rejections, families scrapeable")
+EOF
+
+echo "== drift smoke: shifted serving traffic trips the monitor =="
+# tier-1 marker-safe: a logreg fit (drift_baseline=on) pinned on the
+# serving mesh, then (a) UN-shifted traffic must stay below the alert
+# threshold with no post-mortem (no false positive), (b) mean-shifted
+# gaussian traffic must push drift_score past the threshold, and (c)
+# exactly ONE reason="drift" post-mortem bundle lands (the recorder's
+# per-reason cooldown absorbs the storm), parses, and carries BOTH
+# fingerprints + the divergence table.  tests/test_drift_monitor.py
+# covers the sketch/comparator matrix; this step keeps the drift gate
+# runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import glob
+import json
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.monitor import MONITOR, Fingerprint
+from spark_rapids_ml_tpu.serving import ServingServer
+from spark_rapids_ml_tpu.telemetry import REGISTRY
+
+rng = np.random.default_rng(0)
+n, d = 20_000, 8
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+df = pd.DataFrame({"features": list(X), "label": y})
+set_config(drift_baseline="on")
+model = LogisticRegression(maxIter=10).fit(df)
+assert model._drift_baseline is not None and model._drift_baseline.n == n
+
+with tempfile.TemporaryDirectory() as td:
+    set_config(flight_recorder_dir=td, drift_window_s=1.0,
+               drift_min_window_rows=64, drift_alert_threshold=0.25,
+               drift_alert_sustain_s=0.4, serving_max_wait_ms=2.0)
+    server = ServingServer()
+    server.register("logreg", model)
+    server.start()
+    try:
+        clean = rng.normal(size=(1200, d)).astype(np.float32)
+        for lo in range(0, 1200, 60):
+            server.transform("logreg", clean[lo:lo + 60], timeout=120)
+        MONITOR.refresh("logreg")
+        rep = server.report()["logreg"]
+        assert rep["drift"]["overall"] < 0.25, rep["drift"]
+        assert not glob.glob(f"{td}/postmortem_drift_*"), "false positive"
+        clean_score = rep["drift"]["overall"]
+
+        shifted = clean.copy()
+        shifted[:, 2] += 3.0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            for lo in range(0, 1200, 60):
+                server.transform("logreg", shifted[lo:lo + 60], timeout=120)
+            MONITOR.refresh("logreg")
+            if glob.glob(f"{td}/postmortem_drift_*"):
+                break
+        rep = server.report()["logreg"]
+        assert rep["drift"]["overall"] > 0.25, rep["drift"]
+        score = REGISTRY.get("drift_score").value(
+            default=None, model="logreg", column="_overall", stat="score")
+        assert score is not None and score > 0.25, score
+        bundles = glob.glob(f"{td}/postmortem_drift_*")
+        assert len(bundles) == 1, bundles
+        man = json.load(open(bundles[0] + "/manifest.json"))
+        assert man["reason"] == "drift"
+        dj = json.load(open(bundles[0] + "/drift.json"))
+        assert dj["divergence"]["top_columns"][0]["column"] == "x2"
+        bfp = Fingerprint.from_bytes(
+            open(bundles[0] + "/baseline_fingerprint.bin", "rb").read())
+        wfp = Fingerprint.from_bytes(
+            open(bundles[0] + "/window_fingerprint.bin", "rb").read())
+        assert bfp.n == n and wfp.n >= 64
+        print(f"drift smoke OK: clean {clean_score} -> shifted "
+              f"{rep['drift']['overall']} (threshold 0.25), one "
+              f"post-mortem with both fingerprints "
+              f"({bfp.n}/{wfp.n} rows)")
+    finally:
+        server.stop()
+        server.registry.clear()
 EOF
 
 echo "== staging-pipeline smoke: per-device engine parity at depth=2 =="

@@ -44,6 +44,7 @@ MODULES = [
     "spark_rapids_ml_tpu.data",
     "spark_rapids_ml_tpu.streaming",
     "spark_rapids_ml_tpu.stats",
+    "spark_rapids_ml_tpu.monitor",
     "spark_rapids_ml_tpu.fused",
     "spark_rapids_ml_tpu.telemetry",
     "spark_rapids_ml_tpu.analysis",

@@ -395,6 +395,41 @@ _DEFAULTS: Dict[str, Any] = {
     # ring stays queryable) but failure dumps are skipped with a log
     # line.
     "flight_recorder_dir": "",
+    # Fit-time drift-baseline capture (monitor/baseline.py): "auto"
+    # (default) captures a baseline fingerprint (per-column moments,
+    # KLL quantile sketch, Misra-Gries frequent items, HLL distinct
+    # counts) on the chunked fit paths — fused stage-and-solve and the
+    # multi-pass streamed-statistics fits — where the host chunks
+    # already flow (zero extra data passes); "on" additionally captures
+    # in-memory staged fits via one host pass over the extracted batch;
+    # "off" disables capture.  The fingerprint lands on the model
+    # (`model._drift_baseline`), persists as `drift_baseline.bin` next
+    # to the model arrays, and registers with the serving pin.
+    "drift_baseline": "auto",
+    # Serving-side drift window length (seconds): the monitor's
+    # sliding-window sketches tumble at this cadence, and scoring sees
+    # the last closed window merged with the current partial one —
+    # bounded memory (two sketch sets per model) regardless of traffic.
+    "drift_window_s": 60.0,
+    # Rows a serving window must hold before divergences are scored
+    # (below it the sketches are noise, not a distribution).
+    "drift_min_window_rows": 64,
+    # How many highest-scoring columns export `drift_score{model,
+    # column,stat}` gauges per model (the rest stay in the divergence
+    # table, off the metric surface — the family's cardinality bound).
+    "drift_top_k": 8,
+    # Alert threshold on the per-model overall drift score (the max of
+    # PSI / KS / frequent-churn / null-rate / cardinality deltas across
+    # columns; 0.25 is the classic "actionable PSI" level).  Breaching
+    # it for `drift_alert_sustain_s` fires a flight-recorder
+    # post-mortem (`postmortems_total{reason="drift"}`) carrying both
+    # fingerprints and the divergence table.  <= 0 disables alerting
+    # (the gauges still export).
+    "drift_alert_threshold": 0.25,
+    # How long (seconds) the overall drift score must stay above
+    # `drift_alert_threshold` before the alert fires — a single noisy
+    # window must not dump a post-mortem.
+    "drift_alert_sustain_s": 30.0,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

@@ -114,11 +114,21 @@ class Estimator(Params):
         # the assembled per-fit report lands on the model
         # (`model.fit_report()`; JSON artifact when `telemetry_dir` is
         # set)
+        from .monitor.baseline import baseline_mode, baseline_scope
         from .telemetry.report import FitTelemetry
 
         tel = FitTelemetry(type(est).__name__)
         with tel.span():
-            model = est._fit(dataset)
+            # drift-baseline capture (monitor/): the chunked fit paths
+            # (fused stage-and-solve, streamed statistics) fold their
+            # decoded host chunks into a baseline fingerprint when a
+            # collector is armed — zero extra data passes; conf "on"
+            # additionally folds in-memory batches (one host pass)
+            with baseline_scope(baseline_mode() != "off") as coll:
+                model = est._fit(dataset)
+            fp = coll.fingerprint() if coll is not None else None
+            if fp is not None:
+                model._drift_baseline = fp
         tel.attach(model, log=getattr(est, "logger", None))
         return model
 
@@ -233,6 +243,16 @@ class _Writer:
             os.remove(npz_path)  # stale arrays from a previous overwrite-save
         if arrays:
             np.savez(npz_path, **arrays)
+        # drift baseline (monitor/fingerprint.py): the fit-time
+        # distribution fingerprint persists NEXT TO the model arrays so
+        # a loaded model can register with the serving drift monitor
+        fp_path = os.path.join(path, "drift_baseline.bin")
+        if os.path.exists(fp_path):
+            os.remove(fp_path)  # stale baseline from an overwrite-save
+        fp = getattr(inst, "_drift_baseline", None)
+        if fp is not None:
+            with open(fp_path, "wb") as f:
+                f.write(fp.to_bytes())
 
 
 def _load_metadata(path: str) -> Dict[str, Any]:
@@ -291,6 +311,12 @@ class _ReadWriteMixin:
             attrs = dict(meta.get("attributes", {}))
             attrs.update(arrays)
             inst = cls._from_attributes(attrs)
+            fp_path = os.path.join(path, "drift_baseline.bin")
+            if os.path.exists(fp_path):
+                from .monitor.fingerprint import Fingerprint
+
+                with open(fp_path, "rb") as f:
+                    inst._drift_baseline = Fingerprint.from_bytes(f.read())
         else:
             inst = cls()
         cls._restore_params(inst, meta)
@@ -930,6 +956,24 @@ class _TpuEstimator(Estimator, _TpuCaller):
                         with trace("extract", self.logger):
                             batch = self._extract(dataset)
                             self._validate_input(batch)
+                        from .data import _is_sparse as _sparse_chk
+                        from .monitor.baseline import (
+                            baseline_mode,
+                            fold_batch,
+                        )
+
+                        if (
+                            baseline_mode() == "on"
+                            and not _sparse_chk(batch.X)
+                            and np.ndim(batch.X) == 2
+                        ):
+                            # conf "on": in-memory fits capture their
+                            # baseline from one host pass over the
+                            # extracted batch (no staging, no device
+                            # work; the chunked paths still prefer
+                            # their zero-cost chunk fold — fold_batch
+                            # no-ops once a pass has captured)
+                            fold_batch(batch.X, batch.weight)
                         attrs = self._maybe_fit_sparse_stats(batch)
                     if attrs is None:
                         # fused stage-and-solve for in-memory host

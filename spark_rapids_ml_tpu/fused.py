@@ -601,6 +601,17 @@ def accumulate_chunks(
     extra_dev = tuple(jax.device_put(a, rep_sh) for a in extra_args)
     step_w, step_unw = step if isinstance(step, tuple) else (step, None)
 
+    # drift-baseline capture (monitor/baseline.py): when a collector is
+    # armed for this fit, the decoded host chunks ALSO fold into the
+    # baseline fingerprint — zero extra data passes, host tier only.
+    # begin_pass resets a half-folded retried pass; pass_complete after
+    # the loop freezes the capture so the later passes of a multi-pass
+    # fit (randomized PCA re-streams) fold nothing
+    from .monitor import baseline as _baseline
+    from .stats.engine import _device_step_lock
+
+    _baseline.begin_pass()
+
     t0 = time.perf_counter()
     # a producer that tracks its own prep (the parallel parquet readers)
     # passes the shared dict in; otherwise the chunk iterator is wrapped
@@ -629,15 +640,25 @@ def accumulate_chunks(
             # state, never resumed mid-pass, so chunks cannot double-count
             maybe_inject("fused_accumulate")
             ta = time.perf_counter()
-            args = [jax.device_put(cX, mat_sh)]
-            if cw is not None:
-                args.append(jax.device_put(cw, row_sh))
-            if has_y:
-                args.append(jax.device_put(cy, row_sh))
-            args.extend(extra_dev)
-            step_j = step_w if cw is not None else (step_unw or step_w)
-            acc = step_j(acc, *args)
-            jax.block_until_ready(acc)
+            # dispatch-to-sync under the shared one-pass statistics
+            # device lock (stats/engine.py _device_step_lock):
+            # concurrent mesh-sharded accumulator dispatches — a fused
+            # fit racing another fused fit or a Summarizer pass — can
+            # interleave per-device executions into a runtime deadlock;
+            # the baseline fold rides inside the held region like the
+            # engine's host sketches, overlapped with the async device
+            # execution
+            with _device_step_lock:
+                args = [jax.device_put(cX, mat_sh)]
+                if cw is not None:
+                    args.append(jax.device_put(cw, row_sh))
+                if has_y:
+                    args.append(jax.device_put(cy, row_sh))
+                args.extend(extra_dev)
+                step_j = step_w if cw is not None else (step_unw or step_w)
+                acc = step_j(acc, *args)
+                _baseline.fold_chunk(cX, cw)
+                jax.block_until_ready(acc)
             tb = time.perf_counter()
             acc_s += tb - ta
             acc_iv.append((ta, tb))
@@ -647,6 +668,7 @@ def accumulate_chunks(
                 + (cw.nbytes if cw is not None else 0)
                 + (cy.nbytes if has_y else 0)
             )
+    _baseline.pass_complete()
     host = acc_to_host_f64(acc)
     wall = time.perf_counter() - t0
     prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]

@@ -1,0 +1,40 @@
+#
+# spark_rapids_ml_tpu.monitor — the data/model drift monitor: fit-time
+# baseline fingerprints captured from the chunk paths the fit already
+# decodes (baseline.py, fingerprint.py), serving-side sliding-window
+# sketches folded from the dispatcher's host batches (monitor.py),
+# sketch-paired divergence scoring (compare.py: PSI, KS, z-shift,
+# null-rate/cardinality deltas, frequent-item churn), bounded
+# `drift_score{model,column,stat}` gauges, and sustained-breach
+# alerting through the flight recorder.  See docs/observability.md
+# ("Data & model drift monitor") for the metric families and alert
+# flow.  Import-light: numpy/stdlib only — monitoring never initializes
+# the accelerator backend.
+#
+from .baseline import (
+    baseline_mode,
+    baseline_scope,
+    begin_pass,
+    fold_batch,
+    fold_chunk,
+    pass_complete,
+)
+from .compare import STAT_NAMES, divergence_table, divergences
+from .fingerprint import BaselineBuilder, Fingerprint
+from .monitor import MONITOR, DriftMonitor
+
+__all__ = [
+    "BaselineBuilder",
+    "DriftMonitor",
+    "Fingerprint",
+    "MONITOR",
+    "STAT_NAMES",
+    "baseline_mode",
+    "baseline_scope",
+    "begin_pass",
+    "divergence_table",
+    "divergences",
+    "fold_batch",
+    "fold_chunk",
+    "pass_complete",
+]

@@ -1,0 +1,151 @@
+#
+# Fit-time baseline capture — the tap the chunk paths feed.  A
+# `baseline_scope` installs a thread-local collector around a fit
+# (core.Estimator.fit); the chunked fit paths that already decode every
+# host chunk — the fused stage-and-solve loop (fused.accumulate_chunks)
+# and the multi-pass streamed-statistics fits (streaming.py
+# linreg/pca_streaming_stats) — call `begin_pass` / `fold_chunk` /
+# `pass_complete`, and the collector assembles the baseline fingerprint
+# from EXACTLY ONE complete pass:
+#
+#   begin_pass      resets the builder — a retried attempt (OOM /
+#                   device-loss restart of the pass) starts fresh, so a
+#                   half-folded failed pass can never double-count
+#   fold_chunk      folds one decoded host chunk (numpy only; chunks a
+#                   cache replay serves device-resident are skipped —
+#                   no D2H fetch is ever paid for monitoring)
+#   pass_complete   freezes the collector — the later passes of a
+#                   multi-pass fit (the randomized-PCA range-finder
+#                   re-streams the same data 2+p times) fold nothing
+#
+# Gating (`drift_baseline` conf): "auto" (default) captures on the
+# chunk paths above, where the fold rides decode work the fit pays
+# anyway (zero extra data passes — STAGE_COUNTS-asserted by
+# tests/test_drift_monitor.py); "on" additionally captures in-memory
+# staged fits via one host pass over the extracted batch (core.py);
+# "off" disables capture entirely.  Every hook is a cheap no-op when no
+# collector is active, so non-fit chunk consumers pay one thread-local
+# read.
+#
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..config import get_config
+from .fingerprint import BaselineBuilder, Fingerprint
+
+_tls = threading.local()
+
+
+def baseline_mode() -> str:
+    mode = str(get_config("drift_baseline")).lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"drift_baseline must be auto|on|off, got {mode!r}")
+    return mode
+
+
+class _Collector:
+    """Per-fit capture state (thread-local; nested fits — Pipeline
+    stages driving their own Estimator.fit — stack)."""
+
+    __slots__ = ("builder", "in_pass", "done")
+
+    def __init__(self) -> None:
+        self.builder: Optional[BaselineBuilder] = None
+        self.in_pass = False
+        self.done = False
+
+    def fingerprint(self) -> Optional[Fingerprint]:
+        if self.builder is None or not self.done:
+            return None
+        return self.builder.finalize()
+
+
+@contextlib.contextmanager
+def baseline_scope(enabled: bool = True):
+    """Install a collector for the duration of one fit.  `enabled`
+    short-circuits (conf off / estimator opted out): the hooks below
+    then see no collector and cost nothing."""
+    coll = _Collector() if enabled else None
+    prev = getattr(_tls, "coll", None)
+    _tls.coll = coll
+    try:
+        yield coll
+    finally:
+        _tls.coll = prev
+
+
+def _active() -> Optional[_Collector]:
+    return getattr(_tls, "coll", None)
+
+
+def begin_pass() -> None:
+    """A chunk pass is starting: reset the builder unless a complete
+    pass was already captured (multi-pass fits fold only the first; a
+    RETRY of a failed pass re-enters here and starts fresh)."""
+    coll = _active()
+    if coll is None or coll.done:
+        return
+    coll.builder = None  # lazily rebuilt at first fold (d is unknown here)
+    coll.in_pass = True
+
+
+def fold_chunk(X, w=None) -> None:
+    """Fold one decoded host chunk; `w` is the validity/weight vector
+    (None = all rows valid; w > 0 participates once).  Device-resident
+    chunks (cache replays) are skipped — monitoring never pays a D2H
+    fetch."""
+    coll = _active()
+    if coll is None or coll.done or not coll.in_pass:
+        return
+    if not isinstance(X, np.ndarray):
+        return
+    try:
+        if coll.builder is None:
+            coll.builder = BaselineBuilder(
+                int(X.shape[1]) if X.ndim == 2 else 1
+            )
+        coll.builder.update(X, None if w is None else np.asarray(w))
+    except Exception:
+        # capture must never fail the fit it rides on: drop the baseline
+        coll.builder = None
+        coll.done = True
+
+
+def pass_complete() -> None:
+    """The pass finished cleanly: freeze the capture (later passes fold
+    nothing).  A pass that folded zero host rows (fully device-served
+    replay) leaves the collector open so a later host-served pass can
+    still capture."""
+    coll = _active()
+    if coll is None or coll.done or not coll.in_pass:
+        return
+    coll.in_pass = False
+    if coll.builder is not None and coll.builder.n > 0:
+        coll.done = True
+
+
+def fold_batch(X, w=None) -> None:
+    """One-shot capture of an in-memory host batch (`drift_baseline=
+    "on"` — core.py folds the extracted batch before staging).  No-op
+    when a chunked pass already captured."""
+    coll = _active()
+    if coll is None or coll.done:
+        return
+    begin_pass()
+    fold_chunk(np.asarray(X), w)
+    pass_complete()
+
+
+__all__ = [
+    "baseline_mode",
+    "baseline_scope",
+    "begin_pass",
+    "fold_batch",
+    "fold_chunk",
+    "pass_complete",
+]

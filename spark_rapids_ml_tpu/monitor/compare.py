@@ -1,0 +1,231 @@
+#
+# Divergence computation — baseline fingerprint vs serving-window
+# fingerprint, per column, from the paired mergeable sketches (no raw
+# data is ever retained on either side):
+#
+#   psi            Population Stability Index over the baseline's decile
+#                  bins (edges from the baseline KLL sketch, observed
+#                  fractions from the window sketch's weighted CDF).
+#                  The industry thresholds apply: ~0.1 noticeable, 0.25
+#                  actionable — the `drift_alert_threshold` default.
+#   ks             Kolmogorov-Smirnov distance, evaluated at the
+#                  baseline's quantile grid (max |CDF_b - CDF_w|).
+#   z_mean         |mean_w - mean_b| / std_b — the mean shift in
+#                  baseline standard deviations.
+#   std_shift      |ln(std_w / std_b)| — spread change, symmetric.
+#   null_rate      |null_w - null_b| — NaN-rate delta.
+#   distinct       |distinct_w - distinct_b| / distinct_b — HLL
+#                  cardinality delta (an ID column suddenly constant, an
+#                  enum growing values).
+#   freq_churn     total-variation distance between the normalized
+#                  Misra-Gries tables (top-item churn on
+#                  categorical-coded columns).
+#
+# `column_score` collapses the per-stat values onto one comparable
+# [0, ~) scale per column (psi/ks/churn/null/distinct as-is, z_mean/3
+# and std_shift folded in), which ranks the top-k drifting columns for
+# the bounded gauge export and feeds the overall alert score.
+#
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .fingerprint import Fingerprint, PSI_QUANTILES
+
+_EPS = 1e-6
+# KS evaluation grid: baseline quantile levels (dense enough for the
+# serving shifts worth alerting on; the sketch itself bounds rank error)
+_KS_LEVELS = tuple(np.linspace(1.0 / 32.0, 31.0 / 32.0, 31))
+
+STAT_NAMES = (
+    "psi", "ks", "z_mean", "std_shift", "null_rate", "distinct",
+    "freq_churn",
+)
+
+
+def _sketch_cdf(state: Dict[str, np.ndarray], points: np.ndarray):
+    """(d, n_points) weighted CDF of a KLL state evaluated per column at
+    `points` (d, n_points): fraction of sketched mass <= point."""
+    from ..stats.sketches import QUANTILE_LEVELS
+
+    d = state["items"].shape[0]
+    cols_items: List[np.ndarray] = []
+    weights: List[np.ndarray] = []
+    for level in range(QUANTILE_LEVELS):
+        size = int(state["sizes"][level])
+        if size == 0:
+            continue
+        cols_items.append(state["items"][:, level, :size])
+        weights.append(np.full((size,), float(2 ** level)))
+    out = np.zeros((d, points.shape[1]))
+    if not cols_items:
+        return out
+    items = np.concatenate(cols_items, axis=1)  # (d, t)
+    w = np.concatenate(weights)  # (t,)
+    order = np.argsort(items, axis=1, kind="stable")
+    sorted_items = np.take_along_axis(items, order, axis=1)
+    cum = np.cumsum(w[order], axis=1)
+    total = np.maximum(cum[:, -1], _EPS)
+    for j in range(d):
+        idx = np.searchsorted(sorted_items[j], points[j], side="right")
+        out[j] = np.where(idx > 0, cum[j][np.maximum(idx - 1, 0)], 0.0)
+        out[j] /= total[j]
+    return out
+
+
+def _psi(base: Fingerprint, win: Fingerprint) -> np.ndarray:
+    """Per-column PSI over the baseline's decile bins.  Expected
+    fractions come from the baseline's own CDF at its edges (not an
+    assumed exact 0.1 — the sketch's rank error cancels)."""
+    edges = base.quantiles(PSI_QUANTILES)  # (d, 9)
+    cb = _sketch_cdf(base.quantile, edges)
+    cw = _sketch_cdf(win.quantile, edges)
+    ones = np.ones((base.d, 1))
+    zeros = np.zeros((base.d, 1))
+    pb = np.diff(np.concatenate([zeros, cb, ones], axis=1), axis=1)
+    pw = np.diff(np.concatenate([zeros, cw, ones], axis=1), axis=1)
+    pb = np.clip(pb, _EPS, None)
+    pw = np.clip(pw, _EPS, None)
+    return ((pw - pb) * np.log(pw / pb)).sum(axis=1)
+
+
+def _ks(base: Fingerprint, win: Fingerprint) -> np.ndarray:
+    grid = base.quantiles(_KS_LEVELS)  # (d, 31)
+    cb = _sketch_cdf(base.quantile, grid)
+    cw = _sketch_cdf(win.quantile, grid)
+    return np.abs(cb - cw).max(axis=1)
+
+
+# a column's frequent-item tables only SPEAK when their retained counts
+# cover a real fraction of the rows (categorical-coded data): on
+# continuous columns every value is unique, the Misra-Gries survivors
+# are arbitrary, and comparing two arbitrary tables would read as
+# permanent churn on perfectly healthy traffic
+_CHURN_MIN_COVERAGE = 0.2
+
+
+def _freq_churn(base: Fingerprint, win: Fingerprint) -> np.ndarray:
+    """Total-variation distance between the normalized frequent-item
+    tables, per column (union of keys), gated to columns where BOTH
+    tables cover >= `_CHURN_MIN_COVERAGE` of their side's valid rows —
+    the "is this column categorical-coded" test the sketch itself
+    answers."""
+    out = np.zeros((base.d,))
+    bk, bc = base.frequent["keys"], base.frequent["counts"]
+    wk, wc = win.frequent["keys"], win.frequent["counts"]
+    rows_b = np.maximum(base.n - base.nan, 1)
+    rows_w = np.maximum(win.n - win.nan, 1)
+    for j in range(base.d):
+        tb = {
+            k: c for k, c in zip(bk[j].tolist(), bc[j].tolist())
+            if not np.isnan(k) and c > 0
+        }
+        tw = {
+            k: c for k, c in zip(wk[j].tolist(), wc[j].tolist())
+            if not np.isnan(k) and c > 0
+        }
+        if not tb and not tw:
+            continue
+        sb = max(sum(tb.values()), 1)
+        sw = max(sum(tw.values()), 1)
+        if (
+            sb / float(rows_b[j]) < _CHURN_MIN_COVERAGE
+            or sw / float(rows_w[j]) < _CHURN_MIN_COVERAGE
+        ):
+            continue
+        keys = set(tb) | set(tw)
+        out[j] = 0.5 * sum(
+            abs(tb.get(k, 0) / sb - tw.get(k, 0) / sw) for k in keys
+        )
+    return out
+
+
+def divergences(base: Fingerprint, win: Fingerprint) -> Dict[str, np.ndarray]:
+    """Every per-column divergence statistic, `{stat: (d,) array}`."""
+    if base.d != win.d:
+        raise ValueError(
+            f"fingerprint width mismatch: baseline d={base.d}, "
+            f"window d={win.d}"
+        )
+    std_b = np.maximum(base.std(), _EPS)
+    std_w = np.maximum(win.std(), _EPS)
+    # cardinality compares as the UNIQUENESS RATIO (distinct / valid
+    # rows, clamped to 1): raw distinct counts scale with window size,
+    # so two healthy windows of different lengths would "drift"; the
+    # ratio is size-invariant — an ID column collapsing to a constant
+    # moves it from ~1 to ~0, a continuous column stays ~1 on both sides
+    ratio_b = np.clip(
+        base.distinct() / np.maximum(base.n - base.nan, 1), 0.0, 1.0
+    )
+    ratio_w = np.clip(
+        win.distinct() / np.maximum(win.n - win.nan, 1), 0.0, 1.0
+    )
+    return {
+        "psi": _psi(base, win),
+        "ks": _ks(base, win),
+        "z_mean": np.abs(win.mean() - base.mean()) / std_b,
+        "std_shift": np.abs(np.log(std_w / std_b)),
+        "null_rate": np.abs(win.null_rate() - base.null_rate()),
+        "distinct": np.abs(ratio_w - ratio_b),
+        "freq_churn": _freq_churn(base, win),
+    }
+
+
+def column_scores(divs: Dict[str, np.ndarray]) -> np.ndarray:
+    """One comparable score per column: the max over the bounded stats,
+    with the unbounded z_mean folded in at /3 (a 3-sigma mean shift
+    scores 1.0) and std_shift as-is (ln 2 ~ 0.69 for a doubled spread)."""
+    return np.maximum.reduce([
+        divs["psi"],
+        divs["ks"],
+        divs["freq_churn"],
+        divs["null_rate"],
+        divs["distinct"],
+        divs["z_mean"] / 3.0,
+        divs["std_shift"],
+    ])
+
+
+def _r(v: Any) -> float:
+    """Round for the JSON surfaces; a non-finite divergence (degenerate
+    sketch) reads as 0.0 rather than poisoning strict JSON replies."""
+    v = float(v)
+    return round(v, 4) if np.isfinite(v) else 0.0
+
+
+def divergence_table(
+    base: Fingerprint, win: Fingerprint, top_k: int
+) -> Dict[str, Any]:
+    """The comparator's full output: per-stat values for the `top_k`
+    highest-scoring columns, the overall score, and the window/baseline
+    row counts — `server.report()`'s drift section, the per-model HTTP
+    detail, and the post-mortem attachment all render this."""
+    divs = divergences(base, win)
+    scores = np.nan_to_num(
+        column_scores(divs), nan=0.0, posinf=0.0, neginf=0.0
+    )
+    order = np.argsort(-scores)[: max(int(top_k), 1)]
+    cols = []
+    for j in order:
+        cols.append({
+            "column": base.column_name(int(j)),
+            "index": int(j),
+            "score": _r(scores[j]),
+            **{s: _r(divs[s][j]) for s in STAT_NAMES},
+        })
+    return {
+        "overall": _r(scores.max(initial=0.0)),
+        "baseline_rows": base.n,
+        "window_rows": win.n,
+        "top_columns": cols,
+    }
+
+
+__all__ = [
+    "STAT_NAMES",
+    "column_scores",
+    "divergence_table",
+    "divergences",
+]

@@ -8,6 +8,10 @@
 #       -> 404 unknown model, 400 malformed input, 429 ServingOverload
 #          (admission control — the caller sheds load or retries)
 #   GET  /v1/models                    registered + pinned model names
+#   GET  /v1/models/<name>             per-model detail: pin status and
+#                                      accounted bytes, p50/p99, SLO
+#                                      burn, and the drift summary
+#                                      (404 for unknown names)
 #   GET  /v1/report                    the per-model latency report
 #                                      (p50/p99 ms, request counts)
 #
@@ -90,6 +94,17 @@ def start_serving_http(server, port: int, host: str = "127.0.0.1"):
                 })
             elif path == "/v1/report":
                 self._reply(200, server.report())
+            elif (
+                path.startswith("/v1/models/")
+                and not path.endswith(":transform")
+            ):
+                # per-model detail: pin status + accounted bytes,
+                # p50/p99 and SLO burn, and the drift summary
+                name = path[len("/v1/models/"):]
+                try:
+                    self._reply(200, server.model_detail(name))
+                except KeyError as e:
+                    self._reply(404, {"error": str(e)})
             else:
                 self._reply(404, {"error": f"no route {path!r}"})
 

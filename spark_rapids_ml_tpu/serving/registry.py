@@ -149,11 +149,26 @@ class ModelRegistry:
                 "n_features": n_features,
                 "transform": transform,
             }
+        # drift monitor (monitor/): a model carrying a fit-time baseline
+        # fingerprint registers it WITH the pin — serving traffic for
+        # this name folds into the monitor's sliding windows from the
+        # first request (re-registering under the same name restarts
+        # the windows against the new model's baseline: hot swap)
+        fp = getattr(model, "_drift_baseline", None)
+        from ..monitor import MONITOR
+
+        if fp is not None:
+            MONITOR.register(name, fp)
+        else:
+            MONITOR.drop(name)
         return self._pin(name, event="pin")
 
     def unregister(self, name: str) -> None:
+        from ..monitor import MONITOR
+
         with self._mu:
             self._host.pop(name, None)
+        MONITOR.drop(name)
         self._drop(name, event="unpin")
 
     def names(self) -> List[str]:
@@ -327,11 +342,32 @@ class ModelRegistry:
             self._drop(name, event="evict")
             self._pin(name, event="repin")
 
+    def pin_info(self, name: str) -> Dict[str, Any]:
+        """Pin status + accounting for ONE model (the per-model HTTP
+        detail endpoint): KeyError for unregistered names."""
+        with self._mu:
+            reg = self._host.get(name)
+            if reg is None:
+                raise KeyError(f"no serving model registered as {name!r}")
+            e = self._pinned.get(name)
+            return {
+                "pinned": e is not None,
+                "device": bool(e.device) if e is not None else False,
+                "pinned_bytes": int(e.nbytes) if e is not None else 0,
+                "n_features": reg.get("n_features"),
+                "dtype": str(np.dtype(reg["dtype"])),
+            }
+
     def clear(self) -> None:
+        from ..monitor import MONITOR
+
         with self._mu:
             names = list(self._pinned)
+            hosted = list(self._host)
         for name in names:
             self._drop(name, event="unpin")
+        for name in hosted:
+            MONITOR.drop(name)
         with self._mu:
             self._host.clear()
 

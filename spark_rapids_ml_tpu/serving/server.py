@@ -433,48 +433,61 @@ class ServingServer:
 
     # -- report --------------------------------------------------------------
 
+    def _model_entry(self, name: str, pinned_names) -> Dict[str, Any]:
+        """One model's report entry (the shared body of `report()` and
+        `model_detail`)."""
+        with self._lock:
+            lat = list(self._lat.get(name, ()))
+            requests = self._req_counts.get(name, 0)
+            rejections = self._rej_counts.get(name, 0)
+        entry: Dict[str, Any] = {
+            # per-instance counts: the prometheus families are
+            # process-global, a fresh server must not report a
+            # predecessor's history
+            "requests": requests,
+            "rejections_queue_full": rejections,
+            "pinned": name in pinned_names,
+        }
+        if lat:
+            srt = sorted(lat)
+
+            def _pct(p: float) -> float:
+                i = min(len(srt) - 1, int(round(p * (len(srt) - 1))))
+                return srt[i]
+
+            entry.update(
+                latency_samples=len(srt),
+                p50_ms=round(_pct(0.50) * 1e3, 3),
+                p99_ms=round(_pct(0.99) * 1e3, 3),
+                mean_ms=round(sum(srt) / len(srt) * 1e3, 3),
+            )
+        target_s = self._slo_target_s(name)
+        if target_s > 0:
+            entry["slo_p99_target_ms"] = round(target_s * 1e3, 3)
+            for window, _span in _SLO_WINDOWS:
+                burn = SLO_BURN.value(
+                    default=None, model=name, window=window
+                )
+                if burn is not None:
+                    entry[f"slo_burn_{window}"] = burn
+        # drift summary (monitor/): rows observed, overall score, top
+        # drifting columns — absent for models without a registered
+        # fit-time baseline
+        from ..monitor import MONITOR
+
+        drift = MONITOR.summary(name)
+        if drift is not None:
+            entry["drift"] = drift
+        return entry
+
     def report(self) -> Dict[str, Any]:
         """Per-model serving report: request/batch counts, mean batch
         rows, and exact p50/p99 latency over the last `_REPORT_SAMPLES`
         requests — the operator-facing SLO view (docs/serving.md)."""
         out: Dict[str, Any] = {}
-        with self._lock:
-            samples = {k: list(v) for k, v in self._lat.items()}
-            req_counts = dict(self._req_counts)
-            rej_counts = dict(self._rej_counts)
+        pinned_names = self.registry.pinned_names()
         for name in self.registry.names():
-            lat = samples.get(name, [])
-            entry: Dict[str, Any] = {
-                # per-instance counts: the prometheus families are
-                # process-global, a fresh server must not report a
-                # predecessor's history
-                "requests": req_counts.get(name, 0),
-                "rejections_queue_full": rej_counts.get(name, 0),
-                "pinned": name in self.registry.pinned_names(),
-            }
-            if lat:
-                srt = sorted(lat)
-
-                def _pct(p: float) -> float:
-                    i = min(len(srt) - 1, int(round(p * (len(srt) - 1))))
-                    return srt[i]
-
-                entry.update(
-                    latency_samples=len(srt),
-                    p50_ms=round(_pct(0.50) * 1e3, 3),
-                    p99_ms=round(_pct(0.99) * 1e3, 3),
-                    mean_ms=round(sum(srt) / len(srt) * 1e3, 3),
-                )
-            target_s = self._slo_target_s(name)
-            if target_s > 0:
-                entry["slo_p99_target_ms"] = round(target_s * 1e3, 3)
-                for window, _span in _SLO_WINDOWS:
-                    burn = SLO_BURN.value(
-                        default=None, model=name, window=window
-                    )
-                    if burn is not None:
-                        entry[f"slo_burn_{window}"] = burn
-            out[name] = entry
+            out[name] = self._model_entry(name, pinned_names)
         with self._lock:
             n_slow = len(self._slow)
         out["_totals"] = {
@@ -484,6 +497,16 @@ class ServingServer:
             "slow_traces": n_slow,
         }
         return out
+
+    def model_detail(self, name: str) -> Dict[str, Any]:
+        """Everything about ONE served model — pin status and accounted
+        bytes, the latency/SLO report entry, and the drift summary (the
+        `GET /v1/models/<name>` payload) — built for THIS model only
+        (a dashboard polling every model must not pay a full all-model
+        report per request).  KeyError for unknown names."""
+        info = self.registry.pin_info(name)  # KeyError gate
+        entry = self._model_entry(name, self.registry.pinned_names())
+        return {"model": name, **info, **entry}
 
     # -- sizing --------------------------------------------------------------
 
@@ -793,11 +816,37 @@ class ServingServer:
                     pass  # cancelled in the race window; result dropped
         if slow_hits:
             self._capture_slow(flight, slow_hits)
+        # drift monitor fold (monitor/): the batch's already-decoded
+        # host rows + its output columns fold into the model's sliding
+        # window sketches HERE — on the dispatcher's collect phase,
+        # after the next batch's device work is already in flight, so
+        # the device hot path pays nothing (host-tier only, bounded
+        # memory; the fold itself is buffered-amortized — bench `drift`
+        # section measures us/row)
+        self._observe_drift(flight, outs)
         # refresh EVERY served model, not just this flight's: a model
         # whose traffic stopped must decay even while the dispatcher
         # stays busy with other models' batches (the per-model rate
         # limit inside _update_slo bounds the cost to ~1 scan/s/model)
         self._refresh_slo_all()
+
+    def _observe_drift(
+        self, flight: _InFlight, outs: Dict[str, np.ndarray]
+    ) -> None:
+        """Fold one served batch into the drift monitor: the decoded
+        request rows (feature side) and the batch's output columns
+        (prediction side).  No-op for models without a registered
+        baseline; never fails the scatter."""
+        from ..monitor import MONITOR
+
+        if not MONITOR.tracks(flight.name):
+            return
+        try:
+            for r in flight.reqs:
+                MONITOR.observe(flight.name, r.X)
+            MONITOR.observe_output(flight.name, outs)
+        except Exception as e:  # monitoring must never fail serving
+            logger.warning(f"drift fold failed ({e})")
 
     def _capture_slow(
         self, flight: _InFlight, hits: List[tuple]

@@ -23,6 +23,7 @@
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
@@ -50,6 +51,33 @@ _pass_seconds = histogram(
     "stat_program_pass_seconds",
     "Wall seconds per fused statistic pass by run label",
 )
+
+# STAT_METRICS is process-wide LAST-RUN state: two concurrent passes
+# (a caller running describe() from several threads) must not
+# interleave their clear+update into a chimera of both runs — the
+# writes are ATOMIC under this lock (whichever pass finishes last wins,
+# a consistent single-run view), and a pass that overlapped another —
+# in EITHER direction: every live pass is marked when a new one starts,
+# so the first starter finishing last still knows — records
+# `concurrent_passes` so readers know the engine counters around it are
+# process-level (the PR-5 concurrent-fits report guard, mirrored)
+_stat_metrics_lock = threading.Lock()
+_PASS_STATE: Dict[str, Any] = {"live": []}  # per-pass mutable tokens
+
+# CONCURRENT one-pass statistics folds serialize their DEVICE step on
+# this lock: two threads dispatching multi-device (mesh-sharded) jitted
+# accumulator steps simultaneously can interleave their per-device
+# executions into a resource-ordering deadlock inside the runtime
+# (observed wedging the full CPU-mesh suite at the concurrent-describe
+# test — both threads frozen inside the jitted call, zero CPU).  The
+# lock is SHARED with the fused stage-and-solve engine
+# (fused.accumulate_chunks — the other mesh-sharded accumulator
+# dispatch site), so a describe() racing a fused fit serializes too.
+# Chunk prep and the prefetch producers still interleave freely; the
+# host sketch folds run INSIDE the held region, between the async
+# dispatch and the sync, so a lone pass keeps its device/host overlap
+# and pays one uncontended acquire per chunk.
+_device_step_lock = threading.Lock()
 
 
 def _chunk_rows_for(n: int, d: int, itemsize: int, n_dev: int) -> int:
@@ -350,84 +378,113 @@ def _one_pass(
     # dies mid-loop deliberately leaves its last state visible for the
     # flight recorder
     rid = current_run_id() or mint_run_id("summarize")
-    with run_context(rid), compile_label("stat_programs"):
-        hb = Heartbeat("stat_programs")
-        for cX, cy, cw, in prefetch_iter(chunks, _staging_depth()):
-            # the engine's fault site: a failure here fails the WHOLE
-            # pass; the retry restarts with fresh accumulators
-            maybe_inject("stat_program_step")
-            chunk_rows = int(cX.shape[0])
-            ta = time.perf_counter()
-            if step_for is not None:
-                # full unweighted chunks (cw None) dispatch the
-                # unweighted fast variant: no weight transfer, no X*w
-                # chunk copy for programs that declare an unw step
-                args = [jax.device_put(cX, mat_sh)]
-                if cw is not None:
-                    args.append(jax.device_put(cw, row_sh))
-                if needs_y:
-                    args.append(jax.device_put(cy, row_sh))
-                dev_acc = step_for(cw is not None)(dev_acc, *args)
-            if host_progs:
-                from ..streaming import _weights_host
+    pass_token = {"overlapped": False}
+    with _stat_metrics_lock:
+        if _PASS_STATE["live"]:
+            pass_token["overlapped"] = True
+            for t in _PASS_STATE["live"]:
+                t["overlapped"] = True
+        _PASS_STATE["live"].append(pass_token)
+    try:
+        with run_context(rid), compile_label("stat_programs"):
+            hb = Heartbeat("stat_programs")
+            for cX, cy, cw, in prefetch_iter(chunks, _staging_depth()):
+                # the engine's fault site: a failure here fails the WHOLE
+                # pass; the retry restarts with fresh accumulators
+                maybe_inject("stat_program_step")
+                chunk_rows = int(cX.shape[0])
+                ta = time.perf_counter()
 
-                # cached read-only ones for the common full-unweighted
-                # chunk: the validity mask allocates nothing
-                w_host = cw if cw is not None else _weights_host(
-                    None, chunk_rows, chunk_rows, dtype
-                )
-                ctx = {
-                    "offset": offset,
-                    "n_valid": int(np.count_nonzero(w_host > 0)),
-                }
-                for p in host_progs:
-                    host_acc[p.name] = host_steps[p.name](
-                        host_acc[p.name], cX, w_host, cy, ctx
+                def _fold_host() -> None:
+                    if not host_progs:
+                        return
+                    from ..streaming import _weights_host
+
+                    # cached read-only ones for the common full-
+                    # unweighted chunk: the validity mask allocates
+                    # nothing
+                    w_host = cw if cw is not None else _weights_host(
+                        None, chunk_rows, chunk_rows, dtype
                     )
-            if step_for is not None:
-                jax.block_until_ready(dev_acc)
-            tb = time.perf_counter()
-            acc_s += tb - ta
-            acc_iv.append((ta, tb))
-            offset += chunk_rows
-            n_chunks += 1
-            nbytes += cX.nbytes + (
-                cw.nbytes if cw is not None else 0
-            ) + (cy.nbytes if needs_y and cy is not None else 0)
-            hb.beat(n_chunks)
-        hb.close()
+                    ctx = {
+                        "offset": offset,
+                        "n_valid": int(np.count_nonzero(w_host > 0)),
+                    }
+                    for p in host_progs:
+                        host_acc[p.name] = host_steps[p.name](
+                            host_acc[p.name], cX, w_host, cy, ctx
+                        )
 
-    folded: Dict[str, Dict[str, Any]] = {}
-    for p in device_progs:
-        folded[p.name] = acc_to_host_f64(dev_acc[p.name])
-    folded.update(host_acc)
-    wall = time.perf_counter() - t0
+                if step_for is not None:
+                    # full unweighted chunks (cw None) dispatch the
+                    # unweighted fast variant: no weight transfer, no
+                    # X*w chunk copy for programs that declare an unw
+                    # step.  Dispatch-to-sync holds _device_step_lock
+                    # (see the lock's comment); the host folds run
+                    # between dispatch and sync so the async device
+                    # execution still overlaps them
+                    with _device_step_lock:
+                        args = [jax.device_put(cX, mat_sh)]
+                        if cw is not None:
+                            args.append(jax.device_put(cw, row_sh))
+                        if needs_y:
+                            args.append(jax.device_put(cy, row_sh))
+                        dev_acc = step_for(cw is not None)(dev_acc, *args)
+                        _fold_host()
+                        jax.block_until_ready(dev_acc)
+                else:
+                    _fold_host()
+                tb = time.perf_counter()
+                acc_s += tb - ta
+                acc_iv.append((ta, tb))
+                offset += chunk_rows
+                n_chunks += 1
+                nbytes += cX.nbytes + (
+                    cw.nbytes if cw is not None else 0
+                ) + (cy.nbytes if needs_y and cy is not None else 0)
+                hb.beat(n_chunks)
+            hb.close()
 
-    ctx = {"d": d, "rows": offset, "quantiles": tuple(quantiles or ())}
-    results = {p.name: p.finalize(folded[p.name], ctx) for p in progs}
+        folded: Dict[str, Dict[str, Any]] = {}
+        for p in device_progs:
+            folded[p.name] = acc_to_host_f64(dev_acc[p.name])
+        folded.update(host_acc)
+        wall = time.perf_counter() - t0
 
-    prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
-    overlap_s = _interval_overlap_s(prep_iv, acc_iv)
-    overlap = 0.0
-    if min(prep["s"], acc_s) > 1e-9:
-        overlap = max(0.0, min(overlap_s / min(prep["s"], acc_s), 1.0))
-    for p in progs:
-        _runs_total.inc(program=p.name)
-    _pass_seconds.observe(wall, label=label)
-    STAT_METRICS.clear()
-    STAT_METRICS.update(
-        stamp=round(time.time(), 3),
-        label=label,
-        programs=len(progs),
-        passes=1,
-        chunks=n_chunks,
-        bytes=int(nbytes),
-        wall_s=round(wall, 4),
-        host_prep_s=round(prep["s"], 4),
-        device_acc_s=round(acc_s, 4),
-        overlap_s=round(overlap_s, 4),
-        overlap_fraction=round(overlap, 4),
-    )
+        ctx = {"d": d, "rows": offset, "quantiles": tuple(quantiles or ())}
+        results = {p.name: p.finalize(folded[p.name], ctx) for p in progs}
+
+        prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
+        overlap_s = _interval_overlap_s(prep_iv, acc_iv)
+        overlap = 0.0
+        if min(prep["s"], acc_s) > 1e-9:
+            overlap = max(0.0, min(overlap_s / min(prep["s"], acc_s), 1.0))
+        for p in progs:
+            _runs_total.inc(program=p.name)
+        _pass_seconds.observe(wall, label=label)
+        # the clear+update is ATOMIC under the lock: a reader (or the
+        # other pass's writer) sees one complete run's record, never an
+        # interleaving of two (asserted by the concurrent-describe test)
+        with _stat_metrics_lock:
+            overlapped = pass_token["overlapped"]
+            STAT_METRICS.clear()
+            STAT_METRICS.update(
+                stamp=round(time.time(), 3),
+                label=label,
+                programs=len(progs),
+                passes=1,
+                chunks=n_chunks,
+                bytes=int(nbytes),
+                wall_s=round(wall, 4),
+                host_prep_s=round(prep["s"], 4),
+                device_acc_s=round(acc_s, 4),
+                overlap_s=round(overlap_s, 4),
+                overlap_fraction=round(overlap, 4),
+                **({"concurrent_passes": True} if overlapped else {}),
+            )
+    finally:
+        with _stat_metrics_lock:
+            _PASS_STATE["live"].remove(pass_token)
     from ..tracing import event
 
     event(

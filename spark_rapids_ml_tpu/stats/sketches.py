@@ -22,6 +22,8 @@
 #
 from __future__ import annotations
 
+import io
+import struct
 from typing import Dict, Tuple
 
 import numpy as np
@@ -253,6 +255,50 @@ def frequent_items_result(acc: Dict[str, np.ndarray]) -> list:
 # ---------------------------------------------------------------------------
 
 
+def hll_init(d: int, p_bits: int) -> Dict[str, np.ndarray]:
+    """Fresh host-side HyperLogLog state: (cols, 2^p_bits) int32 max-rank
+    registers — the same register layout as the device `distinct_count`
+    program, so `hll_estimate` serves both."""
+    return {"regs": np.zeros((d, 2 ** p_bits), np.int32)}
+
+
+def hll_update(
+    acc: Dict[str, np.ndarray], X: np.ndarray, valid: np.ndarray,
+    p_bits: int,
+) -> Dict[str, np.ndarray]:
+    """Numpy twin of the device `distinct_count` step (stats/programs.py
+    `_hll_make_step`): same -0.0 canonicalization, same murmur3
+    finalizer over the f32 bit pattern, same bucket/rank split — so a
+    host-folded register table estimates with identical accuracy.  Rows
+    with `valid`=False never enter."""
+    vals = np.asarray(X[np.asarray(valid, bool)])
+    if vals.size == 0:
+        return acc
+    h = (np.asarray(vals, np.float32) + 0.0).view(np.uint32)
+    h = h ^ (h >> np.uint32(16))
+    h = h * np.uint32(0x85EBCA6B)
+    h = h ^ (h >> np.uint32(13))
+    h = h * np.uint32(0xC2B2AE35)
+    h = h ^ (h >> np.uint32(16))
+    bucket = (h >> np.uint32(32 - p_bits)).astype(np.int64)
+    rest = (h << np.uint32(p_bits)).astype(np.uint32)
+    # clz(rest) + 1 without a hardware clz: 32 - bit_length(rest); the
+    # float64 log2 is exact for every uint32 (52-bit mantissa)
+    nz = rest > 0
+    bitlen = np.zeros(rest.shape, np.int32)
+    bitlen[nz] = np.floor(np.log2(rest[nz].astype(np.float64))).astype(
+        np.int32
+    ) + 1
+    rho = np.minimum(32 - bitlen + 1, 32 - p_bits + 1).astype(np.int32)
+    m = 2 ** p_bits
+    regs = acc["regs"].reshape(-1)
+    cols = np.broadcast_to(
+        np.arange(vals.shape[1], dtype=np.int64)[None, :], bucket.shape
+    )
+    np.maximum.at(regs, (cols * m + bucket).reshape(-1), rho.reshape(-1))
+    return acc
+
+
 def hll_estimate(registers: np.ndarray) -> np.ndarray:
     """(cols,) distinct-count estimates from (cols, m) max-rank
     registers — the standard HLL estimator with the small-range
@@ -271,3 +317,63 @@ def hll_estimate(registers: np.ndarray) -> np.ndarray:
         raw,
     )
     return est
+
+
+# ---------------------------------------------------------------------------
+# Versioned wire format for sketch state (KLL quantiles, Misra-Gries,
+# HyperLogLog) — the persistence the drift monitor's baseline
+# fingerprints (monitor/fingerprint.py) stand on.  A serialized state
+# restores to NUMERICALLY IDENTICAL arrays (np.savez round-trip), so
+# merging two round-tripped states is byte-exact with merging the
+# originals (asserted by tests/test_drift_monitor.py).  The version is
+# checked on load and a mismatch REJECTS: silently reinterpreting an
+# old layout would corrupt every divergence computed from it.
+# ---------------------------------------------------------------------------
+
+SKETCH_WIRE_MAGIC = b"SRSK"
+SKETCH_WIRE_VERSION = 1
+
+_SKETCH_KINDS = ("quantile", "frequent", "hll")
+
+
+def sketch_to_bytes(kind: str, state: Dict[str, np.ndarray]) -> bytes:
+    """Serialize one sketch state dict.  `kind` names which sketch
+    family the arrays belong to (quantile | frequent | hll); the state
+    arrays are stored compressed (sketch buffers are mostly zeros)."""
+    if kind not in _SKETCH_KINDS:
+        raise ValueError(
+            f"unknown sketch kind {kind!r}; known: {_SKETCH_KINDS}"
+        )
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, **{k: np.asarray(v) for k, v in state.items()}
+    )
+    payload = buf.getvalue()
+    kind_b = kind.encode()
+    return (
+        SKETCH_WIRE_MAGIC
+        + struct.pack("<HH", SKETCH_WIRE_VERSION, len(kind_b))
+        + kind_b
+        + payload
+    )
+
+
+def sketch_from_bytes(blob: bytes) -> Tuple[str, Dict[str, np.ndarray]]:
+    """Inverse of `sketch_to_bytes`: (kind, state).  Raises ValueError
+    on a bad magic or a version this build does not speak — a sketch
+    from a different wire version must be re-captured, never guessed
+    at."""
+    if blob[:4] != SKETCH_WIRE_MAGIC:
+        raise ValueError("not a serialized sketch (bad magic)")
+    version, klen = struct.unpack("<HH", blob[4:8])
+    if version != SKETCH_WIRE_VERSION:
+        raise ValueError(
+            f"sketch wire version {version} unsupported (this build "
+            f"speaks {SKETCH_WIRE_VERSION}); re-capture the sketch"
+        )
+    kind = blob[8:8 + klen].decode()
+    if kind not in _SKETCH_KINDS:
+        raise ValueError(f"unknown sketch kind {kind!r} in payload")
+    with np.load(io.BytesIO(blob[8 + klen:]), allow_pickle=False) as z:
+        state = {k: z[k] for k in z.files}
+    return kind, state

@@ -903,16 +903,23 @@ def linreg_streaming_stats(
     lo, hi = _process_row_range(n_total)
 
     # accumulate in f32 on device (MXU matmuls); final sums come back f64
+    # (drift-baseline capture rides the same decoded chunks — zero extra
+    # passes; replayed device-resident chunks are skipped host-side)
+    from .monitor import baseline as _baseline
+
     acc, step = _linreg_acc(d, dtype)
+    _baseline.begin_pass()
     for cX, cy, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, label_col, weight_col,
         chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
     ):
         w_host = _weights_host(cw, n_c, chunk_rows, dtype)
+        _baseline.fold_chunk(cX, w_host)
         acc = step(
             acc, _dev_chunk(cX, dtype), jnp.asarray(w_host),
             jnp.asarray(np.asarray(cy, dtype)),
         )
+    _baseline.pass_complete()
     return _acc_to_host_f64(acc)
 
 
@@ -971,13 +978,18 @@ def pca_streaming_stats(
     n_total = parquet_row_count(path)
     lo, hi = _process_row_range(n_total)
 
+    from .monitor import baseline as _baseline
+
     acc, step = _pca_acc(d, dtype)
+    _baseline.begin_pass()
     for cX, _, cw, n_c in iter_chunks_prefetch(
         path, features_col, features_cols, None, weight_col,
         chunk_rows, dtype, row_range=(lo, hi), device_ok=True,
     ):
         w_host = _weights_host(cw, n_c, chunk_rows, dtype)
+        _baseline.fold_chunk(cX, w_host)
         acc = step(acc, _dev_chunk(cX, dtype), jnp.asarray(w_host))
+    _baseline.pass_complete()
     return _acc_to_host_f64(acc)
 
 

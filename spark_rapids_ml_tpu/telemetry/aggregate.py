@@ -16,7 +16,12 @@
 #   histograms   merge BUCKET-WISE per labelset: per-`le` counts, sums
 #                and totals add (cumulative buckets stay cumulative), so
 #                fleet-level latency quantiles come out of the merged
-#                buckets with no per-process resampling
+#                buckets with no per-process resampling.  EXEMPLARS on
+#                the bucket lines (request ids) are PRESERVED across the
+#                merge — the newest `MERGE_MAX_EXEMPLARS` per labelset
+#                by timestamp — so a fleet-level latency bucket still
+#                names the requests that landed in it (request-id
+#                forensics survive aggregation)
 #   untyped      treated like gauges (per-process, labeled)
 #
 # A process that is GONE is reported absent — `scrape_endpoints` returns
@@ -36,6 +41,13 @@ from typing import Any, Dict, Iterable, Optional, Tuple
 from .exporters import parse_prometheus_families, render_families
 
 LabelPairs = Tuple[Tuple[str, str], ...]
+
+# exemplars retained per histogram labelset across a merge (newest by
+# timestamp win): enough to answer "which request was that" at fleet
+# level without the merged page growing with process count — each
+# source page already carries at most Metric._MAX_EXEMPLARS per
+# labelset, this bounds the union
+MERGE_MAX_EXEMPLARS = 8
 
 
 def _with_process(labels: LabelPairs, process: str) -> LabelPairs:
@@ -89,6 +101,13 @@ def merge_prometheus(pages: Dict[str, str]) -> Dict[str, Dict[str, Any]]:
                         acc["buckets"][le] = acc["buckets"].get(le, 0) + c
                     acc["sum"] += h["sum"]
                     acc["count"] += h["count"]
+                    if h.get("exemplars"):
+                        merged_ex = sorted(
+                            list(acc.get("exemplars", ()))
+                            + [dict(e) for e in h["exemplars"]],
+                            key=lambda e: e.get("t", 0.0),
+                        )
+                        acc["exemplars"] = merged_ex[-MERGE_MAX_EXEMPLARS:]
             else:  # gauge / untyped: per-process series
                 for lk, v in entry["samples"].items():
                     out[_with_process(lk, process)] = v

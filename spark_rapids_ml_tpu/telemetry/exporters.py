@@ -33,13 +33,22 @@ from typing import Any, Dict, List, Optional, Tuple
 # \\ / \" / \n escapes per the exposition format
 _RE_LABEL = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
 _RE_ESCAPE = re.compile(r"\\(.)")
-# OpenMetrics-style exemplar suffix our own dump appends when asked
-# (` # {request_id="..."} value timestamp`, end-anchored so an
-# adversarial LABEL VALUE merely containing the shape cannot truncate a
-# sample — inside a label it sits before the real sample value, never at
-# end-of-line)
+# OpenMetrics-style exemplar suffix (` # {labels} value timestamp`,
+# end-anchored so an adversarial LABEL VALUE merely containing the
+# shape cannot truncate a sample — inside a label its quotes are
+# escaped, so the label-pair body below cannot match and the real
+# sample value stays in place).  ANY exemplar labelset is recognized —
+# our own dump writes `request_id=`, but foreign pages (federation
+# output, other exporters) ship `trace_id=`-style exemplars and those
+# must strip cleanly too, never leak into the sample value/labels.
+_EXEMPLAR_BODY = r'(?:\w+="(?:[^"\\]|\\.)*"(?:,\w+="(?:[^"\\]|\\.)*")*)?'
 _RE_EXEMPLAR = re.compile(
-    r' # \{request_id="(?:[^"\\]|\\.)*"\} \S+ \S+$'
+    r' # \{' + _EXEMPLAR_BODY + r'\} \S+ \S+$'
+)
+# capturing twin: the family-level parser keeps the exemplar (labels,
+# value, timestamp) so merged fleet pages preserve request-id forensics
+_RE_EXEMPLAR_CAP = re.compile(
+    r' # \{(' + _EXEMPLAR_BODY + r')\} (\S+) (\S+)$'
 )
 
 
@@ -332,10 +341,17 @@ def parse_prometheus_families(text: str) -> Dict[str, Dict[str, Any]]:
     Escaped label values (backslash, quote, newline — and commas/spaces/
     braces, which need no escape but break naive splitters) round-trip
     byte-exactly; integer sample values stay `int` so counter sums
-    across processes are exact.  `render_families` is the inverse."""
+    across processes are exact.  OpenMetrics exemplars on `_bucket`
+    lines are KEPT (`{"exemplars": [{"id", "value", "t"}, ...]}` beside
+    the histogram sample, oldest first) so a fleet merge
+    (telemetry/aggregate.py) preserves the request-id forensics instead
+    of silently dropping them.  `render_families` is the inverse."""
     kinds: Dict[str, str] = {}
     helps: Dict[str, str] = {}
     raw: Dict[str, Dict[Tuple[Tuple[str, str], ...], Any]] = {}
+    exemplars_raw: Dict[
+        Tuple[str, Tuple[Tuple[str, str], ...]], List[Dict[str, Any]]
+    ] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line:
@@ -352,8 +368,24 @@ def parse_prometheus_families(text: str) -> Dict[str, Dict[str, Any]]:
             continue
         if line.startswith("#"):
             continue
+        ex = _RE_EXEMPLAR_CAP.search(line)
         name, labels, value = _parse_sample_line(line)
         raw.setdefault(name, {})[labels] = _parse_value(value)
+        if ex is not None and name.endswith("_bucket"):
+            # keep only request_id exemplars (the shape our dump writes
+            # and render_families re-emits); foreign exemplar labelsets
+            # were stripped from the sample above and are dropped here
+            ex_labels = dict(_RE_LABEL.findall(ex.group(1)))
+            rid = ex_labels.get("request_id")
+            if rid is not None:
+                base = tuple(p for p in labels if p[0] != "le")
+                exemplars_raw.setdefault(
+                    (name[:-len("_bucket")], base), []
+                ).append({
+                    "id": _RE_ESCAPE.sub(_unescape_one, rid),
+                    "value": float(ex.group(2)),
+                    "t": float(ex.group(3)),
+                })
     out: Dict[str, Dict[str, Any]] = {}
     for fam, kind in kinds.items():
         entry: Dict[str, Any] = {"kind": kind, "help": helps.get(fam, "")}
@@ -366,6 +398,9 @@ def parse_prometheus_families(text: str) -> Dict[str, Dict[str, Any]]:
                     base, {"buckets": {}, "sum": 0.0, "count": 0}
                 )
                 h["buckets"][le] = v
+                exs = exemplars_raw.get((fam, base))
+                if exs and "exemplars" not in h:
+                    h["exemplars"] = sorted(exs, key=lambda e: e["t"])
             for lk, v in raw.pop(fam + "_sum", {}).items():
                 samples.setdefault(
                     lk, {"buckets": {}, "sum": 0.0, "count": 0}
@@ -404,11 +439,23 @@ def render_families(families: Dict[str, Dict[str, Any]]) -> str:
                     h["buckets"],
                     key=lambda s: float("inf") if s == "+Inf" else float(s),
                 )
+                # re-attach retained exemplars to their bucket lines
+                # (newest per bucket wins, the dump_prometheus shape) so
+                # merged pages keep the request-id forensics and still
+                # re-parse through this module
+                ex_by_le: Dict[str, Dict[str, Any]] = {}
+                for e in h.get("exemplars", ()):
+                    for le in les:
+                        le_f = float("inf") if le == "+Inf" else float(le)
+                        if e["value"] <= le_f:
+                            ex_by_le[le] = e
+                            break
                 for le in les:
                     extra = f'le="{le}"'
+                    suffix = _fmt_exemplar(ex_by_le.get(le))
                     lines.append(
                         f"{fam}_bucket{_fmt_labels(lk, extra)} "
-                        f"{_fmt_value(h['buckets'][le])}"
+                        f"{_fmt_value(h['buckets'][le])}{suffix}"
                     )
                 lines.append(
                     f"{fam}_sum{_fmt_labels(lk)} {_fmt_value(h['sum'])}"

@@ -157,13 +157,19 @@ class FlightRecorder:
         )
 
     def note_failure(
-        self, reason: str, detail: str = "", log: Optional[object] = None
+        self, reason: str, detail: str = "",
+        log: Optional[object] = None,
+        attachments: Optional[Dict[str, Any]] = None,
     ) -> Optional[str]:
         """A typed failure path fired: write a post-mortem bundle
         (rate-limited — one per `reason` per cooldown window) and return
         its directory, or None when skipped (cooldown, recorder off, no
-        destination configured).  NEVER raises: the black box must not
-        add a second failure to the one being recorded."""
+        destination configured).  `attachments` adds caller evidence to
+        the bundle (the drift monitor ships both distribution
+        fingerprints + the divergence table): `bytes` values write
+        verbatim under their key, anything else as `<key>.json`.  NEVER
+        raises: the black box must not add a second failure to the one
+        being recorded."""
         prev = None
         claimed = False
         try:
@@ -180,7 +186,8 @@ class FlightRecorder:
                 # a concurrent storm writes one bundle, not N...
                 self._last_dump[reason] = now
                 claimed = True
-            bdir = self.dump(reason, detail, log=log)
+            bdir = self.dump(reason, detail, log=log,
+                             attachments=attachments)
             if bdir is None:
                 # ...but a dump that wrote NOTHING (no destination
                 # configured yet) must not burn the slot: the operator
@@ -205,7 +212,9 @@ class FlightRecorder:
             return None
 
     def dump(
-        self, reason: str, detail: str = "", log: Optional[object] = None
+        self, reason: str, detail: str = "",
+        log: Optional[object] = None,
+        attachments: Optional[Dict[str, Any]] = None,
     ) -> Optional[str]:
         """Write the bundle unconditionally (no cooldown — operator/test
         entry point).  Returns the bundle directory, or None when no
@@ -242,6 +251,18 @@ class FlightRecorder:
             f.write(dump_prometheus(exemplars=True))
         with open(os.path.join(bdir, "config.json"), "w") as f:
             json.dump(config_snapshot(), f, indent=1, default=str)
+        attached = []
+        for key in sorted(attachments or {}):
+            val = (attachments or {})[key]
+            if isinstance(val, (bytes, bytearray)):
+                fname = key
+                with open(os.path.join(bdir, fname), "wb") as f:
+                    f.write(val)
+            else:
+                fname = f"{key}.json"
+                with open(os.path.join(bdir, fname), "w") as f:
+                    json.dump(val, f, indent=1, default=str)
+            attached.append(fname)
         manifest = {
             "reason": reason,
             "detail": detail,
@@ -252,6 +273,7 @@ class FlightRecorder:
             "run_ids": sorted({e.run_id for e in evs if e.run_id}),
             "solver_state": _solver_state(),
             "metric_deltas": self.metric_deltas(),
+            **({"attachments": attached} if attached else {}),
         }
         with open(os.path.join(bdir, "manifest.json"), "w") as f:
             json.dump(manifest, f, indent=1)
@@ -310,12 +332,14 @@ def install() -> FlightRecorder:
 
 
 def note_failure(
-    reason: str, detail: str = "", log: Optional[object] = None
+    reason: str, detail: str = "", log: Optional[object] = None,
+    attachments: Optional[Dict[str, Any]] = None,
 ) -> Optional[str]:
     """Module-level convenience over `RECORDER.note_failure` — the one
     call the failure hooks (retry exhaustion, DispatchTimeout,
-    device-loss recovery, sustained overload) make."""
-    return RECORDER.note_failure(reason, detail, log=log)
+    device-loss recovery, sustained overload, sustained drift) make."""
+    return RECORDER.note_failure(reason, detail, log=log,
+                                 attachments=attachments)
 
 
 def measure_overhead(n: int = 2000) -> float:

@@ -130,7 +130,7 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     # failure flight recorder (telemetry/flight_recorder.py): one bump
     # per post-mortem bundle written, labeled by the typed failure path
     # that triggered the dump (retry_exhausted / dispatch_timeout /
-    # device_lost / serving_overload / manual)
+    # device_lost / serving_overload / drift / manual)
     "postmortems_total": {
         "kind": "counter", "labels": ("reason",), "cardinality": 16,
     },
@@ -177,6 +177,20 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     },
     "stat_program_last": {
         "kind": "view", "labels": ("key",), "cardinality": 32,
+    },
+    # drift monitor (monitor/): per-model divergence gauges, bounded to
+    # the `drift_top_k` highest-scoring columns per model (stale column
+    # series are REMOVED on every refresh — monitor._export), plus the
+    # per-model `_overall` alert series and per-output-column scores;
+    # `column` is therefore enumerable by construction, never a raw
+    # feature index stream.  512 covers ~8 models x (8 columns x 7
+    # stats + outputs + overall).
+    "drift_score": {
+        "kind": "gauge", "labels": ("model", "column", "stat"),
+        "cardinality": 512,
+    },
+    "drift_rows_observed_total": {
+        "kind": "counter", "labels": ("model",), "cardinality": 32,
     },
 }
 

@@ -425,6 +425,16 @@ class FitTelemetry:
         solver = solver_summary(model) if model is not None else {}
         if solver:
             report["solver"] = solver
+        # drift baseline (monitor/): a fit that captured a fingerprint
+        # records what it holds — the serving-side comparison is live
+        # state (server.report()), but "did THIS fit capture a
+        # baseline, from how many rows" belongs in the fit artifact
+        fp = getattr(model, "_drift_baseline", None)
+        if fp is not None:
+            report["drift"] = {
+                "baseline_rows": int(fp.n),
+                "columns": int(fp.d),
+            }
         self.report = report
         return report
 

@@ -107,9 +107,81 @@ def test_exemplar_suffix_is_stripped_not_misparsed():
     assert parse_prometheus(with_ex) == parse_prometheus(
         dump_prometheus(reg)
     )
-    assert parse_prometheus_families(with_ex) == parse_prometheus_families(
-        dump_prometheus(reg)
+    # the family parser KEEPS the exemplars (the merge preserves them);
+    # everything else — buckets, sums, counts, labels — parses
+    # identically to the exemplar-free page
+    fams_ex = parse_prometheus_families(with_ex)
+    sample = fams_ex["spark_rapids_ml_tpu_lat"]["samples"][
+        (("model", "m"),)
+    ]
+    assert [e["id"] for e in sample.pop("exemplars")] == ["req-x"]
+    assert fams_ex == parse_prometheus_families(dump_prometheus(reg))
+
+
+def test_merge_preserves_bounded_exemplars_round_trip():
+    """Satellite: a fleet merge keeps up to MERGE_MAX_EXEMPLARS
+    request-id exemplars per histogram labelset (newest by timestamp),
+    and the merged page re-renders them so a re-parse still carries the
+    forensics — merged scrapes stop silently dropping request ids."""
+    from spark_rapids_ml_tpu.telemetry.aggregate import (
+        MERGE_MAX_EXEMPLARS,
+        dump_merged,
     )
+
+    pages = {}
+    for proc in ("hostA", "hostB"):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "h", buckets=(0.01, 0.1, 1.0))
+        for i in range(6):
+            h.observe(
+                0.02 * (i + 1), exemplar=f"req-{proc}-{i}", model="m"
+            )
+        pages[proc] = dump_prometheus(reg, exemplars=True)
+    merged = merge_prometheus(pages)
+    sample = merged["spark_rapids_ml_tpu_lat"]["samples"][
+        (("model", "m"),)
+    ]
+    ids = [e["id"] for e in sample["exemplars"]]
+    assert 0 < len(ids) <= MERGE_MAX_EXEMPLARS
+    assert any(i.startswith("req-hostA") for i in ids)
+    assert any(i.startswith("req-hostB") for i in ids)
+    # counts merged exactly alongside (exemplars never perturb samples)
+    assert sample["count"] == 12
+    # the rendered merged page carries them and re-parses
+    text = dump_merged(merged)
+    assert "req-host" in text
+    re_sample = parse_prometheus_families(text)[
+        "spark_rapids_ml_tpu_lat"
+    ]["samples"][(("model", "m"),)]
+    assert re_sample["count"] == 12
+    assert re_sample["exemplars"], "render dropped the exemplars"
+    # a second-tier merge (pod level) stays bounded
+    tier2 = merge_prometheus({"pod": text, "pod2": text})
+    s2 = tier2["spark_rapids_ml_tpu_lat"]["samples"][(("model", "m"),)]
+    assert len(s2["exemplars"]) <= MERGE_MAX_EXEMPLARS
+
+
+def test_foreign_exemplar_labels_stripped_not_misparsed():
+    """A foreign page's exemplar with a non-request_id labelset
+    (trace_id, span_id — other exporters' shapes) must strip cleanly:
+    the real bucket count survives, no phantom labelset appears, and
+    the foreign exemplar is dropped (only request_id exemplars are
+    retained for re-rendering)."""
+    page = (
+        "# TYPE x histogram\n"
+        'x_bucket{le="1.0"} 42 # {trace_id="abc"} 0.93 1700000000\n'
+        'x_bucket{le="+Inf"} 42\n'
+        "x_sum 39.0\n"
+        "x_count 42\n"
+    )
+    fams = parse_prometheus_families(page)
+    sample = fams["x"]["samples"][()]
+    assert sample["buckets"]["1.0"] == 42
+    assert sample["count"] == 42
+    assert "exemplars" not in sample
+    assert list(fams["x"]["samples"]) == [()]
+    # the simple parser strips it identically
+    assert parse_prometheus(page)[("x_bucket", (("le", "1.0"),))] == 42.0
 
 
 def test_trailing_timestamp_tolerated_not_misparsed():

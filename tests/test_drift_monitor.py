@@ -1,0 +1,634 @@
+#
+# Data/model drift monitor (spark_rapids_ml_tpu/monitor/): fit-time
+# baseline fingerprints, sketch wire format, serving-side sliding
+# windows, divergence scoring, the sustained-drift flight-recorder
+# alert, and the per-model HTTP detail endpoint.
+#
+import glob
+import json
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.config import reset_config, set_config
+from spark_rapids_ml_tpu.monitor import (
+    MONITOR,
+    BaselineBuilder,
+    Fingerprint,
+    divergence_table,
+    divergences,
+)
+from spark_rapids_ml_tpu.stats.sketches import (
+    SKETCH_WIRE_VERSION,
+    frequent_init,
+    frequent_merge,
+    frequent_update,
+    hll_estimate,
+    hll_init,
+    hll_update,
+    quantile_init,
+    quantile_merge,
+    quantile_update,
+    sketch_from_bytes,
+    sketch_to_bytes,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    reset_config()
+    set_config(retry_backoff_s=0.01, retry_jitter=0.0)
+    yield
+    MONITOR.clear()
+    reset_config()
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)
+
+
+# ---------------------------------------------------------------------------
+# sketch wire format (satellite: versioned to_bytes/from_bytes)
+# ---------------------------------------------------------------------------
+
+
+class TestSketchWire:
+    def test_round_trip_merge_byte_exact(self, rng):
+        """Merging two round-tripped states is byte-exact with merging
+        the originals — the serialization loses nothing."""
+        X = rng.normal(size=(5000, 4))
+        a = quantile_init(4, 64)
+        quantile_update(a, X[:2500], np.ones(2500, bool), 64)
+        b = quantile_init(4, 64)
+        quantile_update(b, X[2500:], np.ones(2500, bool), 64)
+        a2 = sketch_from_bytes(sketch_to_bytes("quantile", a))[1]
+        b2 = sketch_from_bytes(sketch_to_bytes("quantile", b))[1]
+        m1 = quantile_merge(a, b, 64)
+        m2 = quantile_merge(a2, b2, 64)
+        for k in m1:
+            np.testing.assert_array_equal(m1[k], m2[k])
+            assert m1[k].dtype == m2[k].dtype
+
+        f = frequent_init(4, 8)
+        frequent_update(f, np.round(X * 2), np.ones(5000, bool), 8)
+        kind, f2 = sketch_from_bytes(sketch_to_bytes("frequent", f))
+        assert kind == "frequent"
+        fm1 = frequent_merge(f, f, 8)
+        fm2 = frequent_merge(f2, f2, 8)
+        for k in fm1:
+            np.testing.assert_array_equal(fm1[k], fm2[k])
+
+        h = hll_init(4, 10)
+        hll_update(h, X, np.ones(5000, bool), 10)
+        kind, h2 = sketch_from_bytes(sketch_to_bytes("hll", h))
+        assert kind == "hll"
+        np.testing.assert_array_equal(h["regs"], h2["regs"])
+        assert h2["regs"].dtype == np.int32
+
+    def test_cross_version_reject(self, rng):
+        st = quantile_init(2, 32)
+        quantile_update(st, rng.normal(size=(100, 2)),
+                        np.ones(100, bool), 32)
+        blob = sketch_to_bytes("quantile", st)
+        bad = blob[:4] + struct.pack(
+            "<HH", SKETCH_WIRE_VERSION + 1, blob[6] | (blob[7] << 8)
+        ) + blob[8:]
+        with pytest.raises(ValueError, match="wire version"):
+            sketch_from_bytes(bad)
+        with pytest.raises(ValueError, match="magic"):
+            sketch_from_bytes(b"XXXX" + blob[4:])
+
+    def test_host_hll_matches_device_program(self, rng):
+        """The numpy HLL fold mirrors the device `distinct_count`
+        hashing, so the two tiers estimate identically on the same
+        data."""
+        from spark_rapids_ml_tpu.stats import run_program
+
+        X = rng.normal(size=(4096, 3)).astype(np.float32)
+        X[:, 1] = rng.integers(0, 50, size=4096)
+        dev = run_program(
+            "distinct_count", X, opts={"distinct_count": {"bits": 10}}
+        )
+        host = hll_init(3, 10)
+        hll_update(host, X, np.ones(4096, bool), 10)
+        np.testing.assert_allclose(
+            hll_estimate(host["regs"]), dev["distinct"], rtol=1e-9
+        )
+
+
+# ---------------------------------------------------------------------------
+# baseline builder + fingerprint
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    def test_builder_matches_numpy(self, rng):
+        X = rng.normal(size=(30_000, 5))
+        X[:, 2] = rng.integers(0, 4, size=30_000)
+        b = BaselineBuilder(5)
+        for lo in range(0, 30_000, 4096):
+            b.update(X[lo:lo + 4096])
+        fp = b.finalize()
+        assert fp.n == 30_000
+        np.testing.assert_allclose(fp.mean(), X.mean(axis=0), atol=1e-9)
+        np.testing.assert_allclose(fp.std(), X.std(axis=0), atol=1e-9)
+        np.testing.assert_array_equal(fp.vmin, X.min(axis=0))
+        np.testing.assert_array_equal(fp.vmax, X.max(axis=0))
+        med = fp.quantiles([0.5])[:, 0]
+        assert abs(med[0] - np.median(X[:, 0])) < 0.05
+        # the enum column's distinct estimate is near-exact
+        assert abs(fp.distinct()[2] - 4) < 0.5
+
+    def test_validity_mask_and_nan(self, rng):
+        X = rng.normal(size=(1000, 3))
+        X[100:400, 1] = np.nan
+        w = np.ones(1000)
+        w[800:] = 0.0  # padding-style invalid tail
+        b = BaselineBuilder(3)
+        b.update(X, w)
+        fp = b.finalize()
+        assert fp.n == 800
+        assert fp.nan[1] == 300
+        assert abs(fp.null_rate()[1] - 300 / 800) < 1e-9
+        valid = X[:800, 0]
+        np.testing.assert_allclose(fp.mean()[0], valid.mean(), atol=1e-9)
+
+    def test_wire_round_trip_and_version_reject(self, rng):
+        b = BaselineBuilder(3)
+        b.update(rng.normal(size=(500, 3)))
+        fp = b.finalize()
+        blob = fp.to_bytes()
+        fp2 = Fingerprint.from_bytes(blob)
+        assert fp2.n == fp.n and fp2.d == fp.d
+        np.testing.assert_array_equal(
+            fp2.quantile["items"], fp.quantile["items"]
+        )
+        np.testing.assert_array_equal(fp2.hll["regs"], fp.hll["regs"])
+        bad = blob[:4] + struct.pack("<HI", 99, 0) + blob[10:]
+        with pytest.raises(ValueError, match="wire version"):
+            Fingerprint.from_bytes(bad)
+
+    def test_merge_is_order_free(self, rng):
+        X = rng.normal(size=(8000, 4))
+        one = BaselineBuilder(4)
+        one.update(X)
+        a = BaselineBuilder(4)
+        a.update(X[:3000])
+        c = BaselineBuilder(4)
+        c.update(X[3000:])
+        merged = a.merge(c).finalize()
+        whole = one.finalize()
+        np.testing.assert_allclose(merged.mean(), whole.mean(), atol=1e-9)
+        assert merged.n == whole.n
+        np.testing.assert_array_equal(
+            merged.hll["regs"], whole.hll["regs"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# divergences
+# ---------------------------------------------------------------------------
+
+
+class TestComparator:
+    def _fp(self, X):
+        b = BaselineBuilder(X.shape[1])
+        b.update(X)
+        return b.finalize()
+
+    def test_identical_is_quiet_and_shift_is_loud(self, rng):
+        X = rng.normal(size=(40_000, 6))
+        base = self._fp(X[:25_000])
+        clean = self._fp(X[25_000:])
+        t = divergence_table(base, clean, 3)
+        assert t["overall"] < 0.15, t
+        Y = X[25_000:].copy()
+        Y[:, 1] += 2.5
+        t2 = divergence_table(base, self._fp(Y), 3)
+        assert t2["overall"] > 0.5
+        assert t2["top_columns"][0]["column"] == "x1"
+        assert t2["top_columns"][0]["psi"] > 0.5
+        assert t2["top_columns"][0]["ks"] > 0.3
+
+    def test_null_rate_and_churn(self, rng):
+        X = rng.normal(size=(20_000, 4))
+        X[:, 3] = rng.integers(0, 5, size=20_000)
+        base = self._fp(X)
+        N = X.copy()
+        N[rng.random(20_000) < 0.4, 0] = np.nan
+        d = divergences(base, self._fp(N))
+        assert abs(d["null_rate"][0] - 0.4) < 0.05
+        Z = X.copy()
+        Z[:, 3] = rng.integers(5, 10, size=20_000)  # disjoint enum
+        d2 = divergences(base, self._fp(Z))
+        assert d2["freq_churn"][3] > 0.9
+        # continuous columns never churn (coverage gate)
+        assert d2["freq_churn"][0] == 0.0
+
+    def test_width_mismatch_rejected(self, rng):
+        a = self._fp(rng.normal(size=(500, 3)))
+        b = self._fp(rng.normal(size=(500, 4)))
+        with pytest.raises(ValueError, match="width"):
+            divergence_table(a, b, 2)
+
+
+# ---------------------------------------------------------------------------
+# fit-time capture
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineCapture:
+    def test_fused_fit_captures_with_zero_extra_passes(self, rng):
+        """The acceptance scenario: a fused stage-and-solve fit captures
+        its baseline from the chunks it already decodes — dataset
+        stagings unchanged, fingerprint statistics match the data."""
+        from spark_rapids_ml_tpu.parallel.mesh import STAGE_COUNTS
+        from spark_rapids_ml_tpu.regression import LinearRegression
+
+        n, d = 24_000, 6
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = X @ rng.normal(size=d).astype(np.float32)
+        df = pd.DataFrame(
+            {"features": list(X), "label": y.astype(np.float64)}
+        )
+        set_config(fused_stage_solve="on")
+        s0 = STAGE_COUNTS["dataset_stagings"]
+        model = LinearRegression().fit(df)
+        assert STAGE_COUNTS["dataset_stagings"] == s0, (
+            "baseline capture must not stage the dataset"
+        )
+        fp = model._drift_baseline
+        assert fp is not None and fp.n == n and fp.d == d
+        np.testing.assert_allclose(
+            fp.mean(), X.mean(axis=0), rtol=1e-4, atol=1e-4
+        )
+        # the fit report records the capture
+        assert model.fit_report()["drift"]["baseline_rows"] == n
+
+    def test_randomized_pca_multi_pass_folds_once(self, rng):
+        """The Halko range-finder re-streams the data 2+p times; the
+        baseline must fold exactly ONE pass (n rows, not (2+p)*n)."""
+        from spark_rapids_ml_tpu.feature import PCA
+
+        n = 16_000
+        X = rng.normal(size=(n, 48)).astype(np.float32)
+        df = pd.DataFrame({"features": list(X)})
+        set_config(fused_stage_solve="on", pca_solver="randomized")
+        m = PCA(k=2).setInputCol("features").setOutputCol("o").fit(df)
+        assert m._drift_baseline is not None
+        assert m._drift_baseline.n == n
+
+    def test_conf_modes(self, rng):
+        """"off" captures nothing; "on" captures in-memory staged fits
+        (logreg has no fused path) from one host pass."""
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        X = rng.normal(size=(2000, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        df = pd.DataFrame({"features": list(X), "label": y})
+        set_config(drift_baseline="off")
+        m = LogisticRegression(maxIter=5).fit(df)
+        assert getattr(m, "_drift_baseline", None) is None
+        set_config(drift_baseline="auto")
+        m = LogisticRegression(maxIter=5).fit(df)
+        assert getattr(m, "_drift_baseline", None) is None  # not chunked
+        set_config(drift_baseline="on")
+        m = LogisticRegression(maxIter=5).fit(df)
+        assert m._drift_baseline is not None
+        assert m._drift_baseline.n == 2000
+
+    def test_streaming_stats_capture(self, rng, tmp_path):
+        """The multi-pass streamed-statistics fit folds its decoded
+        chunks (parquet path, chunk-cache cold)."""
+        from spark_rapids_ml_tpu.regression import LinearRegression
+
+        n, d = 12_000, 8
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X @ rng.normal(size=d)).astype(np.float64)
+        path = str(tmp_path / "t.parquet")
+        pd.DataFrame({"features": list(X), "label": y}).to_parquet(path)
+        set_config(
+            force_streaming_stats=True, fused_stage_solve="off",
+            chunk_cache="off",
+        )
+        m = LinearRegression().fit(path)
+        fp = m._drift_baseline
+        assert fp is not None and fp.n == n
+        np.testing.assert_allclose(
+            fp.mean(), X.mean(axis=0, dtype=np.float64),
+            rtol=1e-3, atol=1e-3,
+        )
+
+    def test_save_load_round_trip(self, rng, tmp_path):
+        from spark_rapids_ml_tpu.classification import (
+            LogisticRegression,
+            LogisticRegressionModel,
+        )
+
+        X = rng.normal(size=(1500, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        df = pd.DataFrame({"features": list(X), "label": y})
+        set_config(drift_baseline="on")
+        m = LogisticRegression(maxIter=5).fit(df)
+        m.save(str(tmp_path / "m"))
+        m2 = LogisticRegressionModel.load(str(tmp_path / "m"))
+        assert m2._drift_baseline.n == 1500
+        np.testing.assert_array_equal(
+            m2._drift_baseline.hll["regs"], m._drift_baseline.hll["regs"]
+        )
+        # a model without a baseline saves/loads clean
+        set_config(drift_baseline="off")
+        m3 = LogisticRegression(maxIter=5).fit(df)
+        m3.save(str(tmp_path / "m3"))
+        m4 = LogisticRegressionModel.load(str(tmp_path / "m3"))
+        assert getattr(m4, "_drift_baseline", None) is None
+
+
+# ---------------------------------------------------------------------------
+# serving-side monitor
+# ---------------------------------------------------------------------------
+
+
+def _fit_logreg(rng, n=20_000, d=8):
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    set_config(drift_baseline="on")
+    return LogisticRegression(maxIter=8).fit(df), X
+
+
+class TestServingDrift:
+    def test_scores_windows_and_report(self, rng):
+        from spark_rapids_ml_tpu.serving import ServingServer
+        from spark_rapids_ml_tpu.telemetry import REGISTRY
+
+        model, X = _fit_logreg(rng)
+        set_config(
+            drift_window_s=1.0, drift_min_window_rows=64,
+            drift_alert_threshold=0.0,  # alerting off for this test
+            serving_max_wait_ms=2.0,
+        )
+        server = ServingServer()
+        server.register("logreg", model)
+        server.start()
+        try:
+            clean = rng.normal(size=(1200, 8)).astype(np.float32)
+            for lo in range(0, 1200, 60):
+                server.transform("logreg", clean[lo:lo + 60], timeout=60)
+            MONITOR.refresh("logreg")
+            rep = server.report()["logreg"]
+            assert rep["drift"]["rows_observed"] == 1200
+            assert rep["drift"]["overall"] < 0.25
+            shifted = clean.copy()
+            shifted[:, 3] += 3.0
+            for lo in range(0, 1200, 60):
+                server.transform("logreg", shifted[lo:lo + 60], timeout=60)
+            # roll past the clean window so the sliding view is shifted
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                for lo in range(0, 1200, 120):
+                    server.transform(
+                        "logreg", shifted[lo:lo + 120], timeout=60
+                    )
+                t = MONITOR.refresh("logreg")
+                if t is not None and t["overall"] > 0.25:
+                    break
+            rep = server.report()["logreg"]
+            assert rep["drift"]["overall"] > 0.25
+            assert rep["drift"]["top_columns"][0]["column"] == "x3"
+            # gauges: bounded export with the _overall alert series
+            score = REGISTRY.get("drift_score")
+            assert score.value(
+                default=None, model="logreg", column="_overall",
+                stat="score",
+            ) is not None
+            rows = REGISTRY.get("drift_rows_observed_total")
+            assert rows.value(model="logreg") >= 2400
+        finally:
+            server.stop()
+            server.registry.clear()
+        # unregistering drops the monitor state and its gauge series
+        assert not MONITOR.tracks("logreg")
+        score = REGISTRY.get("drift_score")
+        assert score.value(
+            default=None, model="logreg", column="_overall", stat="score"
+        ) is None
+
+    def test_sustained_alert_dumps_one_bundle(self, rng, tmp_path):
+        """A sustained injected shift fires EXACTLY ONE reason="drift"
+        post-mortem within the cooldown window, carrying both
+        fingerprints and the divergence table; clean traffic never
+        fires."""
+        from spark_rapids_ml_tpu.serving import ServingServer
+
+        model, X = _fit_logreg(rng)
+        set_config(
+            flight_recorder_dir=str(tmp_path),
+            drift_window_s=1.0, drift_min_window_rows=64,
+            drift_alert_threshold=0.25, drift_alert_sustain_s=0.4,
+            serving_max_wait_ms=2.0,
+        )
+        server = ServingServer()
+        server.register("logreg", model)
+        server.start()
+        try:
+            clean = rng.normal(size=(800, 8)).astype(np.float32)
+            for lo in range(0, 800, 80):
+                server.transform("logreg", clean[lo:lo + 80], timeout=60)
+            MONITOR.refresh("logreg")
+            assert not glob.glob(str(tmp_path / "postmortem_drift_*")), (
+                "clean traffic must not alert"
+            )
+            shifted = clean.copy()
+            shifted[:, 2] += 3.0
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                for lo in range(0, 800, 80):
+                    server.transform(
+                        "logreg", shifted[lo:lo + 80], timeout=60
+                    )
+                MONITOR.refresh("logreg")
+                if glob.glob(str(tmp_path / "postmortem_drift_*")):
+                    break
+            bundles = glob.glob(str(tmp_path / "postmortem_drift_*"))
+            assert len(bundles) == 1, bundles  # cooldown absorbs repeats
+            man = json.load(open(bundles[0] + "/manifest.json"))
+            assert man["reason"] == "drift"
+            assert set(man["attachments"]) == {
+                "baseline_fingerprint.bin", "drift.json",
+                "window_fingerprint.bin",
+            }
+            d = json.load(open(bundles[0] + "/drift.json"))
+            assert d["model"] == "logreg"
+            assert d["divergence"]["overall"] > 0.25
+            assert d["divergence"]["top_columns"][0]["column"] == "x2"
+            bfp = Fingerprint.from_bytes(
+                open(bundles[0] + "/baseline_fingerprint.bin", "rb").read()
+            )
+            wfp = Fingerprint.from_bytes(
+                open(bundles[0] + "/window_fingerprint.bin", "rb").read()
+            )
+            assert bfp.n == 20_000 and wfp.n >= 64
+            # postmortems_total counted the drift reason
+            from spark_rapids_ml_tpu.telemetry.flight_recorder import (
+                POSTMORTEMS,
+            )
+
+            assert POSTMORTEMS.value(reason="drift") >= 1
+        finally:
+            server.stop()
+            server.registry.clear()
+
+    def test_output_side_reference_window(self, rng):
+        """Prediction-side drift: output sketches score against the
+        FIRST closed output window."""
+        from spark_rapids_ml_tpu.serving import ServingServer
+
+        model, X = _fit_logreg(rng)
+        set_config(
+            drift_window_s=0.3, drift_min_window_rows=32,
+            drift_alert_threshold=0.0, serving_max_wait_ms=1.0,
+        )
+        server = ServingServer()
+        server.register("logreg", model)
+        server.start()
+        try:
+            clean = rng.normal(size=(400, 8)).astype(np.float32)
+            deadline = time.time() + 10
+            summary = None
+            while time.time() < deadline:
+                for lo in range(0, 400, 40):
+                    server.transform(
+                        "logreg", clean[lo:lo + 40], timeout=60
+                    )
+                time.sleep(0.1)
+                MONITOR.refresh("logreg")
+                summary = MONITOR.summary("logreg")
+                if summary and summary.get("output_scores"):
+                    break
+            assert summary and summary.get("output_scores"), summary
+            # self-similar traffic: the outputs do not drift from their
+            # own reference window
+            assert all(
+                v < 0.6 for v in summary["output_scores"].values()
+            ), summary
+        finally:
+            server.stop()
+            server.registry.clear()
+
+    def test_http_model_detail(self, rng):
+        """Satellite: GET /v1/models/<name> — pin status, bytes,
+        latency, and the drift summary; 404 for unknown names."""
+        from spark_rapids_ml_tpu.serving import ServingServer
+        from spark_rapids_ml_tpu.serving.http import start_serving_http
+
+        model, X = _fit_logreg(rng)
+        set_config(
+            drift_window_s=1.0, drift_min_window_rows=32,
+            drift_alert_threshold=0.0, serving_max_wait_ms=2.0,
+            serving_slo_p99_ms=60_000.0,
+        )
+        server = ServingServer()
+        server.register("logreg", model)
+        server.start()
+        srv = start_serving_http(server, 0)
+        try:
+            for lo in range(0, 400, 40):
+                server.transform("logreg", X[lo:lo + 40], timeout=60)
+            MONITOR.refresh("logreg")
+            base = f"http://127.0.0.1:{srv.server_port}"
+            det = json.load(
+                urllib.request.urlopen(f"{base}/v1/models/logreg")
+            )
+            assert det["model"] == "logreg"
+            assert det["pinned"] is True
+            assert det["n_features"] == 8
+            assert det["requests"] == 10
+            assert det["p50_ms"] <= det["p99_ms"]
+            assert "slo_burn_1m" in det or "slo_p99_target_ms" in det
+            assert det["drift"]["rows_observed"] == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/v1/models/missing")
+            assert ei.value.code == 404
+            # the transform POST route is untouched by the new GET route
+            body = json.dumps(
+                {"instances": X[:2].tolist()}
+            ).encode()
+            req = urllib.request.Request(
+                f"{base}/v1/models/logreg:transform", data=body,
+                method="POST",
+            )
+            out = json.load(urllib.request.urlopen(req))
+            assert out["rows"] == 2
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            server.stop()
+            server.registry.clear()
+
+    def test_window_survives_sketch_conf_change(self, rng):
+        """Changing a summarizer_* sketch conf mid-serving re-geometries
+        the next tumbled window; the stale closed window is DISCARDED
+        instead of wedging refresh() on a merge-geometry error (the
+        stats engine made conf-geometry changes safe; so must this)."""
+        from spark_rapids_ml_tpu.monitor.monitor import _Window
+
+        w = _Window(3)
+        w.fold(rng.normal(size=(200, 3)))
+        assert w.maybe_roll(0.0) is not None  # closed at old geometry
+        set_config(summarizer_sketch_k=32)
+        w.cur = BaselineBuilder(3)  # the next tumble's new-geometry builder
+        w.fold(rng.normal(size=(150, 3)))
+        view = w.view()  # must not raise
+        assert view is not None and view.n == 150  # stale last dropped
+
+    def test_model_without_baseline_is_untracked(self, rng):
+        from spark_rapids_ml_tpu.serving import ServingServer
+
+        set_config(drift_baseline="off")
+        from spark_rapids_ml_tpu.classification import LogisticRegression
+
+        X = rng.normal(size=(1000, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        df = pd.DataFrame({"features": list(X), "label": y})
+        model = LogisticRegression(maxIter=5).fit(df)
+        server = ServingServer()
+        server.register("plain", model)
+        server.start()
+        try:
+            server.transform("plain", X[:4], timeout=60)
+            assert not MONITOR.tracks("plain")
+            assert "drift" not in server.report()["plain"]
+            det = server.model_detail("plain")
+            assert det["pinned"] and "drift" not in det
+        finally:
+            server.stop()
+            server.registry.clear()
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder attachments (unit)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_attachments(tmp_path):
+    from spark_rapids_ml_tpu.telemetry.flight_recorder import RECORDER
+
+    set_config(flight_recorder_dir=str(tmp_path))
+    bdir = RECORDER.dump(
+        "manual", "attachment unit",
+        attachments={"evidence": {"a": 1}, "blob.bin": b"\x00\x01drift"},
+    )
+    assert bdir is not None
+    man = json.load(open(bdir + "/manifest.json"))
+    assert man["attachments"] == ["blob.bin", "evidence.json"]
+    assert json.load(open(bdir + "/evidence.json")) == {"a": 1}
+    assert open(bdir + "/blob.bin", "rb").read() == b"\x00\x01drift"

@@ -443,6 +443,58 @@ def test_describe_closes_heartbeat_gauges(rng):
     )
 
 
+def test_concurrent_describes_do_not_cross_contaminate(rng):
+    """Satellite (ISSUE 14): two threads running describe()
+    simultaneously must each get THEIR OWN correct summary, and the
+    process-wide `stat_program_last` view must hold one internally
+    consistent run's record (whichever finished last, marked
+    `concurrent_passes`) — never an interleaving of both (the PR-5
+    concurrent-fits report guard, mirrored)."""
+    import threading
+
+    X1 = rng.normal(size=(48_000, 6)).astype(np.float32)
+    X2 = rng.normal(size=(16_000, 3)).astype(np.float32) + 4.0
+    ref1 = describe(X1)
+    chunks1 = int(STAT_METRICS["chunks"])
+    ref2 = describe(X2)
+    chunks2 = int(STAT_METRICS["chunks"])
+    results = {}
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def run(key, X):
+        try:
+            barrier.wait(timeout=30)
+            results[key] = describe(X)
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=("a", X1)),
+        threading.Thread(target=run, args=("b", X2)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    pd.testing.assert_frame_equal(results["a"], ref1)
+    pd.testing.assert_frame_equal(results["b"], ref2)
+    snap = dict(STAT_METRICS)
+    # one consistent record: its (bytes, chunks) pair belongs to exactly
+    # one of the two runs — an interleaved clear/update would mix them
+    assert snap["label"] == "summarize"
+    assert snap["programs"] == 2  # moments + quantile_sketch
+    assert (int(snap["bytes"]), int(snap["chunks"])) in {
+        (X1.nbytes, chunks1),
+        (X2.nbytes, chunks2),
+    }, snap
+    # both passes overlapped: the record says so, and the report-side
+    # consumers (FitTelemetry stats section) know the engine counters
+    # around it are process-level
+    assert snap.get("concurrent_passes") is True
+
+
 def test_fit_report_carries_stats_section(rng):
     """A statistic pass completing inside a fit's telemetry window
     lands as the report's `stats` section (the FUSED_METRICS last-run
