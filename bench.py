@@ -47,7 +47,8 @@ WORKLOADS = [
     for w in os.environ.get(
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
-        "serving,drift,streaming,summarize,epoch_cache,refconfig,rf",
+        "serving,drift,utilization,streaming,summarize,epoch_cache,"
+        "refconfig,rf",
     ).split(",")
 ]
 
@@ -60,7 +61,8 @@ WORKLOADS = [
 if (
     WORKLOADS
     and all(
-        w in ("staging", "cv_cached", "fused_pca", "serving", "epoch_cache")
+        w in ("staging", "cv_cached", "fused_pca", "serving", "epoch_cache",
+              "utilization")
         for w in WORKLOADS
     )
     and os.environ.get("JAX_PLATFORMS", "") == "cpu"
@@ -1333,6 +1335,103 @@ def bench_drift(extra: dict):
         set_config(**prev_conf)  # later sections keep the operator confs
 
 
+def bench_utilization(extra: dict):
+    """Progress observatory (telemetry/locks.py + hang_doctor.py +
+    utilization.py): the instrumentation's own cost, measured.  Three
+    numbers: (1) named-lock overhead in us/acquire over a bare
+    `threading.Lock` (the profiling tax every guarded section pays),
+    (2) hang-doctor tick cost (the watchdog's per-evaluation spend),
+    (3) serving QPS with the full observatory ON vs OFF — the
+    acceptance gate is the ON/OFF ratio staying within noise of 1.0
+    (`utilization_observatory_speedup_x`; ci/test.sh gates >= 0.95)."""
+    import threading as _threading
+
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.feature import PCA
+    from spark_rapids_ml_tpu.serving import ServingServer
+    from spark_rapids_ml_tpu.telemetry.hang_doctor import HangDoctor
+    from spark_rapids_ml_tpu.telemetry.locks import named_lock
+
+    # (1) lock overhead us/acquire: named vs bare, uncontended hot path
+    n = 50_000
+
+    def _spin(lock) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lock:
+                pass
+        return (time.perf_counter() - t0) / n * 1e6
+
+    bare_us = min(_spin(_threading.Lock()) for _ in range(3))
+    named_us = min(_spin(named_lock("bench_overhead")) for _ in range(3))
+    extra["utilization_lock_overhead_us_per_acquire"] = round(
+        max(named_us - bare_us, 0.0), 3
+    )
+    extra["utilization_lock_acquire_us"] = round(named_us, 3)
+
+    # (2) doctor tick cost (a private doctor; same code path as the
+    # daemon's evaluation, conf reads included)
+    doc = HangDoctor(force_enabled=True)
+    doc.tick()  # warm the metric registrations
+    m = 200
+    t0 = time.perf_counter()
+    for _ in range(m):
+        doc.tick()
+    extra["utilization_doctor_tick_us"] = round(
+        (time.perf_counter() - t0) / m * 1e6, 1
+    )
+
+    # (3) serving QPS with the observatory ON vs OFF
+    d = 32
+    n_req = int(os.environ.get("BENCH_UTILIZATION_REQUESTS", 200))
+    rng = _rng(31)
+    X = rng.standard_normal((8000, d)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    model = PCA(k=8).setInputCol("features").setOutputCol("proj").fit(df)
+    rows = [rng.standard_normal((1, d)).astype(np.float32)
+            for _ in range(n_req)]
+
+    def _qps(observatory_on: bool) -> float:
+        if observatory_on:
+            set_config(flight_recorder="on", hang_doctor="on")
+        else:
+            set_config(flight_recorder="off", hang_doctor="off")
+        server = ServingServer()
+        try:
+            server.register("pca", model, n_features=d)
+            server.start()
+            server.transform("pca", rows[0], timeout=300)  # warm
+            t0 = time.perf_counter()
+            futs = [server.submit("pca", r) for r in rows]
+            for f in futs:
+                f.result(timeout=300)
+            return n_req / max(time.perf_counter() - t0, 1e-9)
+        finally:
+            server.stop()
+            server.registry.clear()
+
+    try:
+        _qps(True)  # burn-in: compile + pin caches warm for both sides
+        # interleaved SYMMETRIC best-of-two per side: scheduler noise on
+        # a shared CI box dwarfs the instrumentation cost, so neither
+        # side may own the "warmest" slot — and both sides must draw the
+        # same number of max() samples or the gated ratio is biased
+        qps_off = _qps(False)
+        qps_on = _qps(True)
+        qps_off = max(qps_off, _qps(False))
+        qps_on = max(qps_on, _qps(True))
+    finally:
+        reset_config()
+    extra["utilization_serving_qps_on"] = round(qps_on, 1)
+    extra["utilization_serving_qps_off"] = round(qps_off, 1)
+    extra["utilization_observatory_speedup_x"] = round(
+        qps_on / max(qps_off, 1e-9), 3
+    )
+
+
 def bench_cv_cached(extra: dict):
     """Device-resident dataset cache (parallel/device_cache.py): a
     k-fold CrossValidator run on the stage-once cached driver vs the
@@ -2036,6 +2135,7 @@ def main() -> None:
         "cv_cached": bench_cv_cached,
         "serving": bench_serving,
         "drift": bench_drift,
+        "utilization": bench_utilization,
         "streaming": bench_streaming,
         "summarize": bench_summarize,
         "epoch_cache": bench_epoch_cache,

@@ -52,6 +52,12 @@ _LOWER_BETTER = (
     # section): the sketches must stay amortized-cheap per row or the
     # host tier starts costing the dispatcher throughput
     "_us_per_row",
+    # progress-observatory instrumentation costs (bench.py
+    # `utilization` section): the per-acquire named-lock tax and the
+    # hang doctor's per-evaluation spend must stay microseconds
+    "_us_per_acquire",
+    "_acquire_us",
+    "_tick_us",
 )
 _HIGHER_BETTER = (
     "_per_sec",

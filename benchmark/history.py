@@ -56,6 +56,11 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("summarize_", "summarize"),
     ("ingest_", "streaming"),
     ("umap_", "umap"),
+    # progress observatory (bench.py `utilization` section): named-lock
+    # overhead us/acquire, hang-doctor tick cost, and serving QPS with
+    # the observatory ON vs OFF (`_observatory_speedup_x` gates the
+    # within-noise-of-1.0 acceptance)
+    ("utilization_", "utilization"),
 )
 
 # run-level numeric context worth trending as its own pseudo-section

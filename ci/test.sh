@@ -18,6 +18,19 @@ for a in "$@"; do
 done
 set -- "${ARGS[@]+"${ARGS[@]}"}"
 
+# Wedge-proof CI: every python this script spawns runs under the wedge
+# guard — ci/wedge/sitecustomize.py (non-pytest invocations) and
+# tests/conftest.py (pytest) arm faulthandler.dump_traceback_later from
+# WEDGE_GUARD_S, so a wedged process (the PR-14 two-thread deadlock
+# class) dumps ALL thread stacks and exits nonzero instead of silently
+# burning the CI window.  Generous deadline: the longest single
+# invocations here (notebook execution, tier-1 batches) finish well
+# inside it; per-process, so subprocesses re-arm with the full budget.
+# The in-process hang doctor (`hang_doctor` conf, default on) fires
+# first with the lock wait-for graph; this is the backstop.
+export WEDGE_GUARD_S="${WEDGE_GUARD_S:-2400}"
+export PYTHONPATH="$(pwd)/ci/wedge${PYTHONPATH:+:$PYTHONPATH}"
+
 echo "== lint: byte-compile all sources =="
 python -m compileall -q spark_rapids_ml_tpu benchmark tests bench.py __graft_entry__.py
 
@@ -120,6 +133,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
     tests/test_serving.py tests/test_drift_monitor.py \
     tests/test_flight_recorder.py tests/test_aggregate.py \
+    tests/test_locks_utilization.py tests/test_hang_doctor.py \
     tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
@@ -623,6 +637,59 @@ print(f"stats smoke OK: {STAT_METRICS['programs']} programs, "
       "bit-identical, families scrapeable, gauges end-marked")
 EOF
 
+echo "== hang-doctor smoke: a seeded deadlock leaves a diagnosed bundle =="
+# tier-1 marker-safe: two threads taking two named locks in opposite
+# order (the PR-14 interleaved-dispatch class with the serializer
+# bypassed) must be diagnosed by the ALWAYS-ON daemon within
+# ~hang_doctor_stall_s — a reason="stall" bundle with all-thread
+# stacks and a wait-for CYCLE naming both threads and both locks.
+# tests/test_hang_doctor.py covers the detector matrix; this step keeps
+# the stall gate runnable in isolation.
+JAX_PLATFORMS=cpu python - << 'EOF'
+import glob
+import json
+import threading
+import time
+import tempfile
+
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.telemetry.hang_doctor import DOCTOR
+from spark_rapids_ml_tpu.telemetry.locks import named_lock
+from spark_rapids_ml_tpu.tracing import event
+
+with tempfile.TemporaryDirectory() as td:
+    set_config(hang_doctor="on", hang_doctor_stall_s=0.5,
+               flight_recorder_dir=td)
+    la, lb = named_lock("smoke_a"), named_lock("smoke_b")
+    barrier = threading.Barrier(2, timeout=10)
+
+    def p(first, second):
+        with first:
+            barrier.wait()
+            if second.acquire(timeout=15):
+                second.release()
+
+    ta = threading.Thread(target=p, args=(la, lb), name="pass-a")
+    tb = threading.Thread(target=p, args=(lb, la), name="pass-b")
+    event("smoke_seed")  # spawn the daemon
+    assert DOCTOR._started
+    ta.start(); tb.start()
+    deadline = time.monotonic() + 10
+    bundles = []
+    while time.monotonic() < deadline and not bundles:
+        bundles = glob.glob(f"{td}/postmortem_stall_*/manifest.json")
+        time.sleep(0.05)
+    ta.join(); tb.join()
+    assert bundles, "daemon never diagnosed the seeded deadlock"
+    b = bundles[0].rsplit("/", 1)[0]
+    wf = json.load(open(f"{b}/waitfor.json"))
+    assert wf["cycles"] and set(wf["cycles"][0]["locks"]) == {
+        "smoke_a", "smoke_b"}, wf
+    stacks = open(f"{b}/stacks.txt").read()
+    assert "pass-a" in stacks and "pass-b" in stacks
+    print("hang-doctor smoke OK:", wf["cycles"][0]["description"])
+EOF
+
 echo "== benchmark smoke =="
 BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_WORKLOADS=none \
     JAX_PLATFORMS=cpu python bench.py
@@ -721,6 +788,35 @@ print("perf smoke OK: history records per section per run, gate trips "
       "on 2x slowdown")
 EOF
 rm -rf "$PERF_DIR"
+
+echo "== observatory overhead gate: serving QPS ON within 5% of OFF =="
+# the progress observatory (named locks + flight recorder + hang
+# doctor) must stay cheap enough to leave on: bench.py's `utilization`
+# section measures serving QPS with the full observatory ON vs OFF and
+# the ON/OFF ratio must hold >= 0.95 (a 2-core CI box is noisy, so the
+# ratio — both sides on the same box in the same process — is the
+# stable signal, not the absolute QPS).  Lock overhead and doctor tick
+# cost land in the same section for the history trend.
+UTIL_DIR=$(mktemp -d)
+BENCH_WORKLOADS=utilization BENCH_UTILIZATION_REQUESTS=200 \
+    BENCH_ISOLATE=0 BENCH_PROBE_TIMEOUT=0 \
+    BENCH_RUN_ID="util-gate" BENCH_HISTORY_PATH="$UTIL_DIR/history.jsonl" \
+    JAX_PLATFORMS=cpu python bench.py > "$UTIL_DIR/bench.json"
+python - "$UTIL_DIR/bench.json" << 'EOF'
+import json, sys
+
+extra = json.load(open(sys.argv[1]))["extra"]
+ratio = extra["utilization_observatory_speedup_x"]
+lock_us = extra["utilization_lock_overhead_us_per_acquire"]
+tick_us = extra["utilization_doctor_tick_us"]
+assert ratio >= 0.95, (
+    f"observatory ON costs more than 5% serving QPS: ON/OFF={ratio}")
+assert lock_us < 25.0, f"named-lock overhead {lock_us} us/acquire"
+assert tick_us < 50_000.0, f"hang-doctor tick {tick_us} us"
+print(f"observatory gate OK: ON/OFF={ratio}, lock +{lock_us} us/acquire, "
+      f"doctor tick {tick_us} us")
+EOF
+rm -rf "$UTIL_DIR"
 
 echo "== pod benchmark smoke (2-process jax.distributed) =="
 python benchmark/pod/launch.py --num_processes 2 --devices_per_process 2 \

@@ -1,0 +1,24 @@
+#
+# Wedge guard for NON-pytest CI invocations (the heredoc smokes, bench
+# runs, notebook execution): ci/test.sh prepends this directory to
+# PYTHONPATH, so every python process it spawns imports this
+# sitecustomize and arms `faulthandler.dump_traceback_later` from the
+# WEDGE_GUARD_S env var — a wedged process dumps all thread stacks to
+# stderr and exits nonzero instead of hanging until the outer timeout
+# SIGKILLs it with no evidence (the PR-14 deadlock class burned three
+# tier-1 windows exactly that way).  tests/conftest.py arms the same
+# guard for direct pytest runs that bypass the PYTHONPATH shim.
+#
+# Unset or 0 disables; the deadline is per process (subprocesses re-arm
+# with the full budget).  The in-process hang doctor
+# (spark_rapids_ml_tpu/telemetry/hang_doctor.py) remains the first
+# line of defense — it fires earlier and attaches the lock wait-for
+# graph; this guard is the backstop that cannot itself deadlock.
+#
+import os
+
+_wedge_s = float(os.environ.get("WEDGE_GUARD_S", "0") or 0)
+if _wedge_s > 0:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(_wedge_s, exit=True)

@@ -53,6 +53,7 @@ DOC_FILES = (
     "docs/performance.md",
     "docs/analysis.md",
     "docs/statistics.md",
+    "docs/troubleshooting.md",
 )
 
 _SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable(-file)?\s*(?:=\s*([\w\-*,\s]+))?")
@@ -353,6 +354,15 @@ class Project:
             )
             self.cache["metric_catalog"] = dict(raw) if raw else {}
         return self.cache["metric_catalog"]
+
+    def lock_catalog(self) -> Dict[str, Dict[str, Any]]:
+        """`telemetry.locks.LOCK_CATALOG`."""
+        if "lock_catalog" not in self.cache:
+            raw = self._module_literal(
+                "spark_rapids_ml_tpu/telemetry/locks.py", "LOCK_CATALOG"
+            )
+            self.cache["lock_catalog"] = dict(raw) if raw else {}
+        return self.cache["lock_catalog"]
 
 
 # ---------------------------------------------------------------------------

@@ -33,7 +33,16 @@ _MUTATORS = {
     "append", "extend", "insert", "add", "discard", "remove", "pop",
     "popitem", "clear", "update", "setdefault",
 }
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+# `named_lock` counts as a lock ctor so converting `_lock =
+# threading.Lock()` to `_lock = named_lock("x")` keeps the module in the
+# thread-lock rule's "lock-declaring" set (the guarded-mutation check
+# must not silently weaken with adoption)
+_LOCK_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "named_lock",
+}
+# the ctors named_lock replaces (Semaphores have no named flavor)
+_NAMEABLE_CTORS = {"Lock", "RLock", "Condition"}
 _MUTABLE_CTORS = {
     "list", "dict", "set", "defaultdict", "OrderedDict", "deque", "Counter",
 }
@@ -396,4 +405,165 @@ class SpanPairingRule(Rule):
                 )
 
 
-RULES = [ThreadLockRule(), SpanPairingRule()]
+# ---------------------------------------------------------------------------
+# named-lock: module-level locks come from telemetry.locks.named_lock
+# ---------------------------------------------------------------------------
+
+# the instrumentation's own bootstrap: locks.py cannot instrument
+# itself, and config/tracing are its lazy dependencies (conf threshold,
+# slow-wait instants) — a named lock there would recurse.  Everything
+# else in the package profiles its locks.
+_NAMED_LOCK_EXEMPT = {
+    "spark_rapids_ml_tpu/config.py",
+    "spark_rapids_ml_tpu/tracing.py",
+    "spark_rapids_ml_tpu/telemetry/locks.py",
+}
+_LOCKS_MODULE = "spark_rapids_ml_tpu/telemetry/locks.py"
+_LOCK_KINDS = {"lock", "rlock", "condition"}
+
+
+class NamedLockRule(Rule):
+    name = "named-lock"
+    description = (
+        "module-level locks come from telemetry.locks.named_lock with a "
+        "literal name resolving to LOCK_CATALOG; stale catalog entries "
+        "flagged"
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        catalog = project.lock_catalog()
+        if not catalog:
+            # no catalog (a fixture mini-repo without telemetry/locks.py):
+            # there is nothing to resolve names against, so the rule
+            # stands down — the real tree always carries the catalog
+            return
+        minted: Set[str] = set()
+        for sf in project.package_files():
+            if sf.tree is None:
+                continue
+            if sf.rel not in _NAMED_LOCK_EXEMPT:
+                yield from self._check_bare_locks(sf)
+            yield from self._check_named_calls(sf, catalog, minted)
+        yield from self._check_catalog(project, catalog, minted)
+
+    def _module_scope_calls(self, tree: ast.Module):
+        """(assign value, lineno) for assignments at module scope AND in
+        module-scope class bodies (a class-attribute lock is process-
+        global state exactly like a module global)."""
+        bodies = [tree.body]
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                bodies.append(node.body)
+        for body in bodies:
+            for node in body:
+                value: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign):
+                    value = node.value
+                elif isinstance(node, ast.AnnAssign):
+                    value = node.value
+                if isinstance(value, ast.Call):
+                    yield value, node.lineno
+
+    def _check_bare_locks(self, sf: SourceFile) -> Iterable[Finding]:
+        for call, lineno in self._module_scope_calls(sf.tree):
+            fn = call.func
+            ctor = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            if ctor in _NAMEABLE_CTORS:
+                kind = {"Lock": "lock", "RLock": "rlock",
+                        "Condition": "condition"}[ctor]
+                yield Finding(
+                    sf.rel, lineno, self.name,
+                    f"module-level `threading.{ctor}()` is invisible to "
+                    "the contention profiler and the hang doctor's "
+                    "wait-for graph — use `named_lock(\"<name>\", "
+                    f"kind=\"{kind}\")` (telemetry/locks.py) with the "
+                    "name declared in LOCK_CATALOG",
+                )
+
+    def _check_named_calls(
+        self, sf: SourceFile, catalog: Dict, minted: Set[str]
+    ) -> Iterable[Finding]:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            if callee != "named_lock":
+                continue
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    "non-literal lock name in `named_lock(...)` defeats "
+                    "the LOCK_CATALOG cross-check",
+                )
+                continue
+            lname = node.args[0].value
+            minted.add(lname)
+            if sf.rel == _LOCKS_MODULE:
+                continue  # the factory's own internals
+            spec = catalog.get(lname)
+            if spec is None:
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    f"lock `{lname}` is not declared in "
+                    "telemetry.locks.LOCK_CATALOG",
+                )
+                continue
+            kind = next(
+                (
+                    kw.value.value
+                    for kw in node.keywords
+                    if kw.arg == "kind"
+                    and isinstance(kw.value, ast.Constant)
+                ),
+                "lock",
+            )
+            if kind not in _LOCK_KINDS:
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    f"unknown named_lock kind `{kind}` "
+                    f"(expected one of {sorted(_LOCK_KINDS)})",
+                )
+            elif spec.get("kind") != kind:
+                yield Finding(
+                    sf.rel, node.lineno, self.name,
+                    f"lock `{lname}` minted as kind `{kind}` but "
+                    f"cataloged as `{spec.get('kind')}`",
+                )
+
+    def _check_catalog(
+        self, project: Project, catalog: Dict, minted: Set[str]
+    ) -> Iterable[Finding]:
+        locks_sf = project.file(_LOCKS_MODULE)
+
+        def _line(lname: str) -> int:
+            if locks_sf is not None:
+                for i, text in enumerate(locks_sf.lines, 1):
+                    if f'"{lname}"' in text:
+                        return i
+            return 1
+
+        for lname in sorted(set(catalog) - minted):
+            yield Finding(
+                _LOCKS_MODULE, _line(lname), self.name,
+                f"cataloged lock `{lname}` is never minted in the "
+                "package (stale catalog entry)",
+            )
+        for lname, spec in sorted(catalog.items()):
+            mod = str((spec or {}).get("module", ""))
+            if mod and not project.exists(mod):
+                yield Finding(
+                    _LOCKS_MODULE, _line(lname), self.name,
+                    f"cataloged lock `{lname}` declares module `{mod}` "
+                    "which does not exist",
+                )
+
+
+RULES = [ThreadLockRule(), SpanPairingRule(), NamedLockRule()]

@@ -430,6 +430,25 @@ _DEFAULTS: Dict[str, Any] = {
     # `drift_alert_threshold` before the alert fires — a single noisy
     # window must not dump a post-mortem.
     "drift_alert_sustain_s": 30.0,
+    # Named-lock contention profiling (telemetry/locks.py): a blocked
+    # acquire that waited at least this many milliseconds drops a
+    # `lock_slow_wait[<name>]` instant marker into the active run's
+    # span tree (the cumulative wait/hold counters record regardless).
+    # <= 0 disables the markers.
+    "lock_slow_wait_ms": 50.0,
+    # Automatic hang doctor (telemetry/hang_doctor.py): "on" (default)
+    # runs the always-on stall watchdog — a daemon thread watching
+    # trace-event flow, heartbeat gauge advance and serving collect
+    # counts; a thread stuck on a named lock (or in-flight work making
+    # no progress) for `hang_doctor_stall_s` dumps a reason="stall"
+    # flight-recorder bundle with all-thread stacks and the lock
+    # wait-for graph.  "off" disables the watchdog.
+    "hang_doctor": "on",
+    # Seconds of no forward progress (or of one thread stuck waiting on
+    # one named lock) before the hang doctor declares a stall.  Long XLA
+    # compiles emit no progress signals while they run, so keep this
+    # comfortably above the slowest expected compile.
+    "hang_doctor_stall_s": 120.0,
 }
 
 _ENV_PREFIX = "SPARK_RAPIDS_ML_TPU_"

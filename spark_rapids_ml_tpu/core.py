@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from abc import abstractmethod
 from dataclasses import dataclass, field
@@ -25,6 +24,7 @@ import numpy as np
 from .data import DatasetLike, DeviceDataset, _ensure_dense, extract_arrays
 from .params import Param, Params, _TpuParams
 from .parallel import TpuContext
+from .telemetry.locks import named_lock
 from .utils import PartitionDescriptor, _ArrayBatch, get_logger
 
 
@@ -627,7 +627,19 @@ class _TpuEstimator(Estimator, _TpuCaller):
 
         def _kernel() -> Dict[str, Any]:
             maybe_inject("fit_kernel")
-            return self._fit_array(cell["fi"])
+            from .telemetry import utilization
+
+            t0 = time.perf_counter()
+            try:
+                return self._fit_array(cell["fi"])
+            finally:
+                # the blocking kernel window is device activity on the
+                # run's utilization timeline (telemetry/utilization.py):
+                # the two-phase fit paths get a device-busy series even
+                # though their solve is one opaque dispatch
+                utilization.note_interval(
+                    "device", t0, time.perf_counter(), cause="fit_kernel"
+                )
 
         def _on_device_loss() -> None:
             from .resilience.elastic import recover_from_device_loss
@@ -1140,7 +1152,7 @@ class _FitMultipleIterator:
         self.fitSingleModel = fitSingleModel
         self.numModels = numModels
         self.counter = 0
-        self.lock = threading.Lock()
+        self.lock = named_lock("fit_multiple")
 
     def __iter__(self) -> "_FitMultipleIterator":
         return self

@@ -30,6 +30,10 @@ import numpy as np
 
 from .config import get_config
 from .telemetry.registry import dict_view as _dict_view
+from .telemetry.utilization import (
+    interval_overlap_s as _interval_overlap_s,
+    merge_intervals as _merge_intervals,
+)
 from .utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.fused")
@@ -521,42 +525,15 @@ def _parquet_reader_pool(
         stop.set()
 
 
-def _merge_intervals(iv):
-    """Sort + coalesce possibly-overlapping intervals (parallel readers
-    decode concurrently) into a disjoint sorted list."""
-    if not iv:
-        return []
-    iv = sorted(iv)
-    out = [list(iv[0])]
-    for lo, hi in iv[1:]:
-        if lo <= out[-1][1]:
-            out[-1][1] = max(out[-1][1], hi)
-        else:
-            out.append([lo, hi])
-    return [tuple(x) for x in out]
+# The interval math this engine introduced is now owned by
+# telemetry/utilization.py (the whole-run idle-gap attribution surface);
+# these aliases keep the engine's (and stats/engine.py's) call sites —
+# the overlap measure is unchanged: chunk-prep intervals (producer
+# thread) intersected with device-busy intervals, so 'the solve ran
+# inside the stage window' is read off the clock directly instead of
+# inferred from duration sums (which a time-sliced single-core host
+# systematically under-attributes).
 
-
-def _interval_overlap_s(a, b) -> float:
-    """Total length of the pairwise intersection of two sorted,
-    non-overlapping wall-clock interval lists — how long BOTH sides were
-    simultaneously active.  This is the engine's overlap measure: chunk
-    prep intervals (producer thread) against device-busy intervals
-    (put + accumulate-in-flight), so 'the solve ran inside the stage
-    window' is read off the clock directly instead of inferred from
-    duration sums (which a time-sliced single-core host systematically
-    under-attributes)."""
-    total = 0.0
-    i = j = 0
-    while i < len(a) and j < len(b):
-        lo = max(a[i][0], b[j][0])
-        hi = min(a[i][1], b[j][1])
-        if hi > lo:
-            total += hi - lo
-        if a[i][1] <= b[j][1]:
-            i += 1
-        else:
-            j += 1
-    return total
 
 
 def accumulate_chunks(
@@ -672,6 +649,13 @@ def accumulate_chunks(
     host = acc_to_host_f64(acc)
     wall = time.perf_counter() - t0
     prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
+    # feed the run's utilization timeline (telemetry/utilization.py):
+    # the same intervals the overlap fraction is computed from become
+    # the fit report's device-busy / gap-attribution evidence
+    from .telemetry import utilization
+
+    utilization.note_intervals("device", acc_iv, cause="fused_accumulate")
+    utilization.note_intervals("host_prep", prep_iv, cause="chunk_prep")
     return host, {
         "wall_s": wall,
         "host_prep_s": prep["s"],

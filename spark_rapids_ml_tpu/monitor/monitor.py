@@ -36,7 +36,8 @@
 #
 from __future__ import annotations
 
-import threading
+
+from ..telemetry.locks import named_lock
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -148,7 +149,7 @@ class DriftMonitor:
     path, and hold only this monitor's lock."""
 
     def __init__(self) -> None:
-        self._mu = threading.RLock()
+        self._mu = named_lock("drift_monitor", kind="rlock")
         self._models: Dict[str, _ModelState] = {}
 
     # -- registration --------------------------------------------------------

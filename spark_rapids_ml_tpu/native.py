@@ -12,19 +12,19 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
 
 from .utils import get_logger
+from .telemetry.locks import named_lock
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO_ROOT, "native", "staging.cpp")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libstaging.so")
 
-_lock = threading.Lock()
+_lock = named_lock("native_build")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

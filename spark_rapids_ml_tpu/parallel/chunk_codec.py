@@ -17,14 +17,15 @@
 #
 from __future__ import annotations
 
-import threading
+
+from ..telemetry.locks import named_lock
 import zlib
 from typing import Callable, Dict, Tuple
 
 Compress = Callable[[bytes], bytes]
 Decompress = Callable[[bytes], bytes]
 
-_lock = threading.Lock()
+_lock = named_lock("chunk_codec")
 
 
 def _zlib_pair() -> Tuple[Compress, Decompress]:

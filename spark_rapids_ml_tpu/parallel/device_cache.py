@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import functools
 import hashlib
-import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -53,6 +52,7 @@ from .mesh import (
 # Now a VIEW over the telemetry registry (the `device_cache{key=...}`
 # Prometheus family) — the mapping surface is unchanged.
 from ..telemetry.registry import dict_view as _dict_view
+from ..telemetry.locks import named_lock
 
 CACHE_METRICS = _dict_view(
     "device_cache",
@@ -67,7 +67,7 @@ CACHE_METRICS = _dict_view(
     },
 )
 
-_lock = threading.Lock()
+_lock = named_lock("device_cache")
 
 
 def _note(kind: str, detail: str = "") -> None:
@@ -455,7 +455,7 @@ class DeviceDatasetCache:
     def __init__(self) -> None:
         self._entries: Dict[str, CacheEntry] = {}
         self._clock = 0
-        self._mu = threading.RLock()
+        self._mu = named_lock("dataset_cache", kind="rlock")
         # bytes reserve()d but not yet insert()ed (staging in flight):
         # without this ledger two concurrent misses could both pass
         # reserve() against the same headroom and overcommit the budget
@@ -982,7 +982,7 @@ class ChunkCache:
     the lock at small-chunk configurations)."""
 
     def __init__(self) -> None:
-        self._mu = threading.RLock()
+        self._mu = named_lock("chunk_cache", kind="rlock")
         self._streams: Dict[Any, _ChunkStream] = {}
         self._clock = 0
         self._host_b = 0  # host-resident array bytes

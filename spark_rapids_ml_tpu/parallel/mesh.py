@@ -289,6 +289,7 @@ def assemble_rows_chunked(shape, dtype, pieces, out_shardings=None,
 # next piece while the current one rides the wire.  Padding rows are
 # never transferred at all — the zeros buffers already hold them.
 
+from ..telemetry.locks import named_lock
 from ..telemetry.registry import dict_view as _dict_view
 
 # last staging-engine run: bytes, seconds, mb_per_s, host_prep_s,
@@ -408,8 +409,6 @@ class ShardedRowWriter:
     (`_writer_devices` decides eligibility)."""
 
     def __init__(self, shape, dtype, sharding=None) -> None:
-        import threading
-
         self.shape = tuple(int(x) for x in shape)
         self.dtype = np.dtype(dtype)
         ensure_x64(self.dtype)
@@ -436,7 +435,7 @@ class ShardedRowWriter:
         # the lock protects the per-device buffer swap + metrics — the
         # transfers themselves stay async and the donated single-device
         # updates already serialize per device
-        self._mu = threading.Lock()
+        self._mu = named_lock("staging_writer")
 
     @property
     def shard_rows(self) -> int:
@@ -532,7 +531,7 @@ def run_staging_pipeline(
     event."""
     depth = _staging_depth()
     t0 = time.perf_counter()
-    prep = {"s": 0.0}
+    prep = {"s": 0.0, "iv": []}
 
     def timed() -> Iterator:
         return timed_iter(producer, prep)
@@ -575,6 +574,13 @@ def run_staging_pipeline(
         depth=depth,
         n_dev=writer.n_dev,
     )
+    # the staging engine's prep + wall windows feed the run's
+    # utilization timeline: host->device transfer time is "stage"
+    # activity (gap evidence), chunk prep is "host_prep"
+    from ..telemetry import utilization
+
+    utilization.note_intervals("host_prep", prep["iv"], cause="stage_prep")
+    utilization.note_interval("stage", t0, t0 + wall, cause=label)
     from ..tracing import event
 
     event(

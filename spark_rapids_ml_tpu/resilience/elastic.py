@@ -51,15 +51,15 @@
 #
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 from ..config import get_config
+from ..telemetry.locks import named_lock
 from ..utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.resilience")
 
-_lock = threading.Lock()
+_lock = named_lock("elastic")
 
 # cumulative process-wide recovery counters (tests, bench, operators):
 #   losses_detected      devices the probe confirmed gone

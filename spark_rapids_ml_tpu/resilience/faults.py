@@ -20,16 +20,16 @@
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Dict, Iterator, List
 
 from ..config import get_config
+from ..telemetry.locks import named_lock
 from ..utils import get_logger
 
 logger = get_logger("spark_rapids_ml_tpu.resilience")
 
-_lock = threading.Lock()
+_lock = named_lock("faults")
 
 # The canonical fault-site registry.  Every `maybe_inject("<site>")`
 # literal in the package must be registered here, every registered site

@@ -28,7 +28,8 @@
 from __future__ import annotations
 
 import copy
-import threading
+
+from ..telemetry.locks import named_lock
 import time
 from typing import Any, Dict, List, Optional
 
@@ -100,7 +101,7 @@ class ModelRegistry:
     replication cannot stall concurrent resolves of other models."""
 
     def __init__(self) -> None:
-        self._mu = threading.RLock()
+        self._mu = named_lock("serving_registry", kind="rlock")
         self._host: Dict[str, Dict[str, Any]] = {}  # name -> registration
         self._pinned: Dict[str, PinnedModel] = {}
 

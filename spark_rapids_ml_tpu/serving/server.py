@@ -33,6 +33,7 @@ from typing import Any, Deque, Dict, List, Optional
 import numpy as np
 
 from ..config import get_config
+from ..telemetry.locks import named_lock
 from ..telemetry.registry import counter, gauge, histogram
 from ..tracing import (
     adopt_trace_context,
@@ -80,6 +81,20 @@ SLO_BURN = gauge(
     "Measured over-p99-target request fraction / the 1% error budget, "
     "per model and window",
 )
+# queueing sensors for ROADMAP item 2's feedback controller (and the
+# hang doctor's work-pending check): live queued requests per model,
+# and how far past its intended wake deadline the dispatcher loop ran —
+# a loop lagging its own deadlines is saturated before p99 shows it
+QUEUE_DEPTH = gauge(
+    "serving_queue_depth", "Requests queued awaiting dispatch, per model"
+)
+DISPATCH_LAG = gauge(
+    "serving_dispatcher_lag_seconds",
+    "Dispatcher wake overshoot past its intended deadline",
+)
+
+# window the report()'s serving utilization summary covers
+_UTILIZATION_WINDOW_S = 60.0
 
 # exact per-model latency samples for the p50/p99 report (the registry
 # histogram's buckets are for Prometheus; percentiles in the per-model
@@ -186,7 +201,7 @@ class ServingServer:
 
     def __init__(self, registry: Optional[ModelRegistry] = None) -> None:
         self.registry = registry or ModelRegistry()
-        self._cv = threading.Condition()
+        self._cv = named_lock("serving_dispatch", kind="condition")
         self._queues: Dict[str, Deque[_Request]] = {}
         self._queued = 0
         self._running = False
@@ -211,7 +226,7 @@ class ServingServer:
         # a fresh server must not report a predecessor's history
         self._req_counts: Dict[str, int] = {}
         self._rej_counts: Dict[str, int] = {}
-        self._lock = threading.Lock()  # report/latency state
+        self._lock = named_lock("serving_report")  # report/latency state
         # request-scoped tracing + SLO sensing state:
         #   _lat_ts     per-model (monotonic_t, total_s) samples feeding
         #               the windowed burn-rate scan (bounded like _lat)
@@ -288,8 +303,9 @@ class ServingServer:
             self._running = False
             if not drain:
                 doomed = [r for q in self._queues.values() for r in q]
-                for q in self._queues.values():
+                for name, q in self._queues.items():
                     q.clear()
+                    QUEUE_DEPTH.set(0, model=name)
                 self._queued = 0
             else:
                 doomed = []
@@ -377,10 +393,10 @@ class ServingServer:
                 overload_detail = self._note_overload_locked(name)
                 queued = self._queued
             else:
-                self._queues.setdefault(
-                    name, collections.deque()
-                ).append(req)
+                q = self._queues.setdefault(name, collections.deque())
+                q.append(req)
                 self._queued += 1
+                QUEUE_DEPTH.set(len(q), model=name)
                 self._cv.notify_all()
         if not admitted:
             if overload_detail:
@@ -496,6 +512,17 @@ class ServingServer:
             "pinned_bytes": self.registry.pinned_bytes(),
             "slow_traces": n_slow,
         }
+        # the serving utilization view (telemetry/utilization.py): how
+        # busy the device was over the recent window and what the idle
+        # gaps are attributable to (lock waits, host-side dispatch)
+        from ..telemetry import utilization
+
+        util = utilization.summarize(
+            window_s=_UTILIZATION_WINDOW_S, scope="serving",
+            domain="serving",
+        )
+        if util:
+            out["_totals"]["utilization"] = util
         return out
 
     def model_detail(self, name: str) -> Dict[str, Any]:
@@ -593,6 +620,7 @@ class ServingServer:
                 continue  # the caller gave up while it queued
             reqs.append(r)
             rows += r.rows
+        QUEUE_DEPTH.set(len(q), model=name)
         return reqs
 
     def _requeue_front(self, reqs: List[_Request]) -> None:
@@ -602,6 +630,8 @@ class ServingServer:
                     r.model, collections.deque()
                 ).appendleft(r)
                 self._queued += 1
+            for name in {r.model for r in reqs}:
+                QUEUE_DEPTH.set(len(self._queues[name]), model=name)
             self._cv.notify_all()
 
     def _next_deadline_locked(self, now: float) -> float:
@@ -638,13 +668,24 @@ class ServingServer:
                         break  # collect finished work instead of idling
                     if draining and self._queued == 0:
                         break
-                    if not self._cv.wait(
-                        timeout=self._next_deadline_locked(now)
-                    ):
+                    t_wait = self._next_deadline_locked(now)
+                    if not self._cv.wait(timeout=t_wait):
                         # timed-out idle tick: break to the outer loop so
                         # _refresh_slo_all runs (burn gauges must decay
                         # when traffic STOPS; with work ready the very
-                        # next inner pass picks it up)
+                        # next inner pass picks it up).  The overshoot
+                        # past the intended deadline is the loop-lag
+                        # sensor: a dispatcher that cannot wake on time
+                        # is saturated before p99 shows it.
+                        DISPATCH_LAG.set(
+                            round(
+                                max(
+                                    0.0,
+                                    time.perf_counter() - now - t_wait,
+                                ),
+                                6,
+                            )
+                        )
                         break
             if batch is None and pending is None:
                 with self._cv:
@@ -705,13 +746,30 @@ class ServingServer:
         collect/scatter spans next round) all carry it, so one request's
         path through the server reconstructs as one tree — the
         slow-request capture and the flight recorder both key off it."""
-        from ..parallel.mesh import RowStager
-        from ..resilience import maybe_inject
+        from ..telemetry import utilization
 
         name = reqs[0].model
         pinned: PinnedModel = self.registry.resolve(name)
         rows = sum(r.rows for r in reqs)
         t0 = time.perf_counter()
+        try:
+            return self._dispatch_timed(reqs, name, pinned, rows, t0)
+        finally:
+            # the host-side dispatch window (coalesce + stage + the
+            # async compute launch) feeds the serving utilization
+            # timeline; the device window lands at collect
+            utilization.note_interval(
+                "dispatch", t0, time.perf_counter(), cause=name,
+                domain="serving",
+            )
+
+    def _dispatch_timed(
+        self, reqs: List[_Request], name: str, pinned: PinnedModel,
+        rows: int, t0: float,
+    ) -> _InFlight:
+        from ..parallel.mesh import RowStager
+        from ..resilience import maybe_inject
+
         with run_context(prefix="batch") as batch_id:
             with trace(f"serving_dispatch[{name}]", logger):
                 event(
@@ -761,13 +819,26 @@ class ServingServer:
             self._collect_traced(flight)
 
     def _collect_traced(self, flight: _InFlight) -> None:
+        from ..telemetry import utilization
+
         if flight.host_outs is not None:
             outs = flight.host_outs
         else:
+            t_fetch = time.perf_counter()
             with trace(f"serving_collect[{flight.name}]", logger):
                 outs = flight.model._fetch_transform_outputs(
                     flight.stager, flight.dev
                 )
+            # the window from the batch's dispatch to the fetch
+            # completing is device-or-transfer activity: the serving
+            # timeline's "device" series (host prep rode in at dispatch)
+            utilization.note_interval(
+                "device",
+                min(flight.t_dispatch, t_fetch),
+                time.perf_counter(),
+                cause=flight.name,
+                domain="serving",
+            )
         t_done = time.perf_counter()
         slow_s = (
             max(0.0, float(get_config("serving_slow_trace_ms"))) / 1e3

@@ -23,7 +23,8 @@
 from __future__ import annotations
 
 import functools
-import threading
+
+from ..telemetry.locks import named_lock
 import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
@@ -61,7 +62,7 @@ _pass_seconds = histogram(
 # so the first starter finishing last still knows — records
 # `concurrent_passes` so readers know the engine counters around it are
 # process-level (the PR-5 concurrent-fits report guard, mirrored)
-_stat_metrics_lock = threading.Lock()
+_stat_metrics_lock = named_lock("stat_metrics")
 _PASS_STATE: Dict[str, Any] = {"live": []}  # per-pass mutable tokens
 
 # CONCURRENT one-pass statistics folds serialize their DEVICE step on
@@ -77,7 +78,7 @@ _PASS_STATE: Dict[str, Any] = {"live": []}  # per-pass mutable tokens
 # host sketch folds run INSIDE the held region, between the async
 # dispatch and the sync, so a lone pass keeps its device/host overlap
 # and pays one uncontended acquire per chunk.
-_device_step_lock = threading.Lock()
+_device_step_lock = named_lock("device_step")
 
 
 def _chunk_rows_for(n: int, d: int, itemsize: int, n_dev: int) -> int:
@@ -455,6 +456,13 @@ def _one_pass(
         results = {p.name: p.finalize(folded[p.name], ctx) for p in progs}
 
         prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
+        # the pass's device/prep windows feed the run's utilization
+        # timeline (telemetry/utilization.py) — same evidence the
+        # overlap fraction below is computed from
+        from ..telemetry import utilization
+
+        utilization.note_intervals("device", acc_iv, cause="stat_programs")
+        utilization.note_intervals("host_prep", prep_iv, cause="chunk_prep")
         overlap_s = _interval_overlap_s(prep_iv, acc_iv)
         overlap = 0.0
         if min(prep["s"], acc_s) > 1e-9:

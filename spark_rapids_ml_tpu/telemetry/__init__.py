@@ -71,7 +71,20 @@ from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     note_failure,
 )
+from .hang_doctor import (  # noqa: F401
+    DOCTOR,
+    HangDoctor,
+    all_thread_stacks,
+    build_wait_graph,
+    find_cycles,
+)
 from .heartbeat import Heartbeat  # noqa: F401
+from .locks import (  # noqa: F401
+    LOCK_CATALOG,
+    lock_table,
+    named_lock,
+    publish_lock_metrics,
+)
 from .memory import (  # noqa: F401
     FitMemoryWatermark,
     SimulatedMemoryProvider,
@@ -97,6 +110,10 @@ from .registry import (  # noqa: F401
     snapshot,
 )
 from .report import FitTelemetry, solver_summary, span_tree  # noqa: F401
+from .utilization import (  # noqa: F401
+    note_interval,
+    summarize_utilization,
+)
 
 # the flight recorder is ALWAYS-ON by design: hook it onto the tracing
 # tap as soon as the telemetry package loads (every fit/serving path
@@ -107,12 +124,22 @@ from .flight_recorder import install as _install_flight_recorder  # noqa: E402
 
 _install_flight_recorder()
 
+# the hang doctor rides the same tap (always-on, `hang_doctor` conf):
+# its watchdog thread spawns lazily on the first recorded event, so
+# importing the package starts no threads
+from .hang_doctor import install as _install_hang_doctor  # noqa: E402
+
+_install_hang_doctor()
+
 __all__ = [
+    "DOCTOR",
     "DictView",
     "FitMemoryWatermark",
     "FitTelemetry",
     "FlightRecorder",
+    "HangDoctor",
     "Heartbeat",
+    "LOCK_CATALOG",
     "METRIC_CATALOG",
     "Metric",
     "MetricsRegistry",
@@ -133,10 +160,18 @@ __all__ = [
     "get_provider",
     "histogram",
     "install_jax_listener",
+    "all_thread_stacks",
+    "build_wait_graph",
+    "find_cycles",
+    "lock_table",
     "maybe_start_http_server",
     "merge_prometheus",
+    "named_lock",
     "note_failure",
+    "note_interval",
     "note_recompile",
+    "publish_lock_metrics",
+    "summarize_utilization",
     "parse_prometheus",
     "parse_prometheus_families",
     "record_budget_decision",

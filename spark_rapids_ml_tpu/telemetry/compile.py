@@ -40,6 +40,8 @@ from __future__ import annotations
 
 import contextlib
 import threading
+
+from .locks import named_lock
 from typing import Iterator
 
 from .registry import counter, histogram
@@ -74,7 +76,7 @@ _PHASE_BY_KEY = {
 }
 
 _tls = threading.local()
-_install_lock = threading.Lock()
+_install_lock = named_lock("compile_install")
 _installed = False
 
 

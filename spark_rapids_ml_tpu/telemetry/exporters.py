@@ -27,6 +27,8 @@ import json
 import os
 import re
 import threading
+
+from .locks import named_lock
 from typing import Any, Dict, List, Optional, Tuple
 
 # one label pair inside a sample's {...} body; values are quoted with
@@ -223,6 +225,13 @@ def dump_prometheus(
     exemplars on, so a latency bucket in the black box names the
     requests that landed in it."""
     reg = registry or REGISTRY
+    if reg is REGISTRY:
+        # fold the named locks' pending accounting into the lock_*
+        # counter families first, so every scrape sees current numbers
+        # (publication is deferred off the acquire hot path by design)
+        from .locks import publish_lock_metrics
+
+        publish_lock_metrics()
     lines: List[str] = []
     for m in reg.metrics():
         name = PROM_PREFIX + m.name
@@ -475,7 +484,7 @@ def render_families(families: Dict[str, Dict[str, Any]]) -> str:
 # Opt-in stdlib HTTP endpoint (`telemetry_port` conf)
 # ---------------------------------------------------------------------------
 
-_server_lock = threading.Lock()
+_server_lock = named_lock("telemetry_http")
 _server = None
 
 

@@ -37,7 +37,8 @@ from __future__ import annotations
 import collections
 import json
 import os
-import threading
+
+from .locks import named_lock
 import time
 from typing import Any, Deque, Dict, List, Optional
 
@@ -65,7 +66,11 @@ class FlightRecorder:
     onto the tracing tap at telemetry import (`install()`)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # REENTRANT: the tracing tap re-enters record() when the
+        # slow-wait instrumentation (telemetry/locks.py) emits an
+        # event while this very lock is held — a plain Lock here
+        # self-deadlocks the whole trace-emission path
+        self._lock = named_lock("flight_recorder", kind="rlock")
         self._ring: Optional[Deque[Any]] = None  # built lazily from conf
         self._deltas: Deque[Dict[str, Any]] = collections.deque(
             maxlen=_MAX_DELTAS
@@ -315,7 +320,7 @@ def _warn(log: Optional[object], msg: str) -> None:
 RECORDER = FlightRecorder()
 
 _installed = False
-_install_lock = threading.Lock()
+_install_lock = named_lock("flight_recorder_install")
 
 
 def install() -> FlightRecorder:

@@ -12,6 +12,8 @@
 from __future__ import annotations
 
 import threading
+
+from .locks import named_lock
 import time
 from typing import Any, Optional
 
@@ -32,7 +34,7 @@ _loss_gauge = gauge(
 # heartbeat (device-loss resume creates a fresh one) never blocks the
 # resumed loop's close from end-marking.  Bounded by the solver-label
 # vocabulary (METRIC_CATALOG cardinality 16).
-_owners_lock = threading.Lock()
+_owners_lock = named_lock("heartbeat_owners")
 _owners: dict = {}
 
 

@@ -43,6 +43,8 @@
 from __future__ import annotations
 
 import threading
+
+from .locks import named_lock
 import time
 from typing import Any, Dict, Optional
 
@@ -69,7 +71,7 @@ _samples_c = counter(
     "memory_samples_total", "Device memory samples taken, by provider"
 )
 
-_lock = threading.Lock()
+_lock = named_lock("memory_telemetry")
 # run_id -> FitMemoryWatermark for every fit currently inside its span
 _active: Dict[str, "FitMemoryWatermark"] = {}
 # process-lifetime peaks the _peak_g gauge mirrors (provider peaks reset

@@ -192,6 +192,48 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "drift_rows_observed_total": {
         "kind": "counter", "labels": ("model",), "cardinality": 32,
     },
+    # named-lock contention profiling (telemetry/locks.py): per-lock
+    # acquire / contended / wait-seconds / hold-seconds counters,
+    # published from the per-instance accounting by
+    # `publish_lock_metrics` (exporters, fit reports, hang-doctor
+    # ticks).  `lock` label values come from LOCK_CATALOG — a fixed
+    # vocabulary the graft-lint `named-lock` rule enforces.
+    "lock_acquisitions_total": {
+        "kind": "counter", "labels": ("lock",), "cardinality": 64,
+    },
+    "lock_contended_total": {
+        "kind": "counter", "labels": ("lock",), "cardinality": 64,
+    },
+    "lock_wait_seconds_total": {
+        "kind": "counter", "labels": ("lock",), "cardinality": 64,
+    },
+    "lock_hold_seconds_total": {
+        "kind": "counter", "labels": ("lock",), "cardinality": 64,
+    },
+    # utilization timeline (telemetry/utilization.py): fraction of the
+    # observed wall the device was busy, per scope (fit | serving)
+    "device_busy_fraction": {
+        "kind": "gauge", "labels": ("scope",), "cardinality": 8,
+    },
+    # hang doctor (telemetry/hang_doctor.py): watchdog liveness + stall
+    # episodes by kind (lock_wait | no_progress); the dumped bundles
+    # themselves count on postmortems_total{reason="stall"}
+    "hang_doctor_ticks_total": {
+        "kind": "counter", "labels": (), "cardinality": 1,
+    },
+    "hang_doctor_stalls_total": {
+        "kind": "counter", "labels": ("kind",), "cardinality": 8,
+    },
+    # serving queue sensors (serving/server.py): live queued rows per
+    # model and the dispatcher's loop lag (how far past its intended
+    # wake deadline the loop ran) — the queueing half of ROADMAP item
+    # 2's feedback controller, next to `slo_burn_rate`
+    "serving_queue_depth": {
+        "kind": "gauge", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_dispatcher_lag_seconds": {
+        "kind": "gauge", "labels": (), "cardinality": 1,
+    },
 }
 
 _DEFAULT_BUCKETS = (
@@ -417,7 +459,15 @@ class MetricsRegistry:
     reset.  One RLock guards registration and every sample mutation."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # the registry's internal lock is itself a NAMED lock — it is
+        # one of the hottest in the process (every metric op holds it)
+        # and the contention profile must cover it.  Imported lazily:
+        # locks.py publishes INTO this registry, so the two modules
+        # bootstrap in either order (locks.py is stdlib-only at module
+        # scope; publication is deferred, never inline in acquire).
+        from .locks import named_lock
+
+        self._lock = named_lock("metrics_registry", kind="rlock")
         self._metrics: Dict[str, Metric] = {}
         self._views: Dict[str, DictView] = {}
 

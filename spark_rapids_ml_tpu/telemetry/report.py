@@ -14,10 +14,10 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .locks import named_lock
 from .registry import REGISTRY, delta, histogram
 
 _fit_seconds = histogram(
@@ -126,7 +126,7 @@ class FitTelemetry:
     # fits currently inside span(); >1 means the registry deltas span
     # more than this fit
     _active = 0
-    _active_lock = threading.Lock()
+    _active_lock = named_lock("fit_telemetry_active")
 
     def __init__(self, estimator_name: str) -> None:
         self.estimator = estimator_name
@@ -148,6 +148,12 @@ class FitTelemetry:
         maybe_start_http_server()
         install_jax_listener()
         self.run_id = mint_run_id("fit")
+        # fold the named locks' pending accounting in BEFORE the
+        # baseline snapshot, so this fit's registry delta reflects only
+        # the lock traffic of its own window
+        from .locks import publish_lock_metrics
+
+        publish_lock_metrics()
         self._before = REGISTRY.snapshot()
         self._t0 = time.time()
         cls = FitTelemetry
@@ -282,6 +288,9 @@ class FitTelemetry:
         events = [
             e for e in get_trace_events() if e.run_id == self.run_id
         ]
+        from .locks import publish_lock_metrics
+
+        publish_lock_metrics()
         deltas = delta(self._before, REGISTRY.snapshot())
         wall = max(self._t1 - self._t0, 0.0)
         _fit_seconds.observe(wall, estimator=self.estimator)
@@ -403,6 +412,36 @@ class FitTelemetry:
             "cache": _view_delta(deltas, "device_cache"),
             "resilience": self._resilience_section(events, deltas),
         }
+        # per-fit lock profile: this window's acquisitions / contended
+        # acquires / wait seconds per lock (registry counter deltas,
+        # process-global like the other delta sections — `concurrent_
+        # fits` marks the overlap caveat above)
+        lock_sec: Dict[str, Any] = {}
+        for fam, short in (
+            ("lock_wait_seconds_total", "wait_s"),
+            ("lock_contended_total", "contended"),
+            ("lock_acquisitions_total", "acquisitions"),
+        ):
+            for ls, v in deltas.get(fam, {}).items():
+                name = ls.split("=", 1)[1] if ls.startswith("lock=") else ls
+                lock_sec.setdefault(name, {})[short] = (
+                    round(v, 6) if isinstance(v, float) else v
+                )
+        if any(e.get("wait_s") for e in lock_sec.values()):
+            report["locks"] = {
+                k: v for k, v in sorted(
+                    lock_sec.items(),
+                    key=lambda kv: -(kv[1].get("wait_s", 0) or 0),
+                )
+                if v.get("wait_s")
+            }
+        # the run's utilization timeline (telemetry/utilization.py):
+        # device-busy fraction + ranked idle-gap attribution
+        from . import utilization as _utilization
+
+        util = _utilization.summarize(run_id=self.run_id, scope="fit")
+        if util:
+            report["utilization"] = util
         chunk_cache = _view_delta(deltas, "chunk_cache")
         if any(chunk_cache.values()):
             report["chunk_cache"] = chunk_cache

@@ -37,6 +37,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Wedge guard (the hang doctor's out-of-process fallback for CI): with
+# WEDGE_GUARD_S=<seconds> set, a pytest process that is still running
+# after the deadline dumps ALL thread stacks to stderr and exits
+# nonzero — a wedged suite (the PR-14 deadlock class) leaves evidence
+# and a red build instead of silently burning the CI window until the
+# outer `timeout` SIGKILLs it.  ci/test.sh arms it for every batch and
+# smoke (ci/wedge/sitecustomize.py arms non-pytest invocations); unset
+# or 0 disables.  The in-process hang doctor (telemetry/hang_doctor.py)
+# stays the first line — it fires earlier and attaches the lock
+# wait-for graph — this guard is the backstop that cannot itself
+# deadlock, because faulthandler dumps from a C watchdog thread.
+_wedge_s = float(os.environ.get("WEDGE_GUARD_S", "0") or 0)
+if _wedge_s > 0:
+    import faulthandler
+
+    faulthandler.dump_traceback_later(_wedge_s, exit=True)
+
 
 @pytest.fixture(params=[1, 2, 4])
 def num_workers(request):

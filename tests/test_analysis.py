@@ -18,6 +18,7 @@ from spark_rapids_ml_tpu.analysis import Project, run_analysis
 from spark_rapids_ml_tpu.analysis.__main__ import main as cli_main
 from spark_rapids_ml_tpu.analysis.rules_builtin import RULES as BUILTIN_RULES
 from spark_rapids_ml_tpu.analysis.rules_concurrency import (
+    NamedLockRule,
     SpanPairingRule,
     ThreadLockRule,
 )
@@ -487,6 +488,121 @@ def test_thread_lock_trace_adoption(tmp_path):
     )
     project = make_tree(tmp_path, {"spark_rapids_ml_tpu/mod.py": fixed})
     assert not run_analysis(project, rules=[ThreadLockRule()])
+
+
+# ---------------------------------------------------------------------------
+# named-lock
+# ---------------------------------------------------------------------------
+
+LOCKS_PY = """
+LOCK_CATALOG = {
+    "good": {"kind": "lock", "module": "spark_rapids_ml_tpu/mod.py"},
+    "good_r": {"kind": "rlock", "module": "spark_rapids_ml_tpu/mod.py"},
+}
+def named_lock(name, kind="lock"):
+    pass
+"""
+
+
+def test_named_lock_bare_lock_flagged(tmp_path):
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/telemetry/locks.py": LOCKS_PY,
+        "spark_rapids_ml_tpu/mod.py": (
+            "import threading\n"
+            "from .telemetry.locks import named_lock\n"
+            "_lock = named_lock('good')\n"
+            "_bare = threading.Lock()\n"
+            "class C:\n"
+            "    _cls_lock = threading.RLock()\n"
+            "def f():\n"
+            "    local = threading.Lock()\n"  # function-local: not flagged
+            "    return local\n"
+        ),
+        "spark_rapids_ml_tpu/mod2.py": (
+            "from .telemetry.locks import named_lock\n"
+            "_r = named_lock('good_r', kind='rlock')\n"
+        ),
+    })
+    findings = run_analysis(project, rules=[NamedLockRule()])
+    msgs = messages(findings, "named-lock")
+    assert len(msgs) == 2, findings
+    assert any("threading.Lock()" in m for m in msgs)
+    assert any("threading.RLock()" in m for m in msgs)
+
+
+def test_named_lock_unknown_name_and_kind_mismatch(tmp_path):
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/telemetry/locks.py": LOCKS_PY,
+        "spark_rapids_ml_tpu/mod.py": (
+            "from .telemetry.locks import named_lock\n"
+            "_a = named_lock('good')\n"
+            "_b = named_lock('rogue')\n"          # not cataloged
+            "_c = named_lock('good_r')\n"         # cataloged rlock, minted lock
+            "def f(n):\n"
+            "    return named_lock(n)\n"          # non-literal name
+        ),
+    })
+    msgs = messages(
+        run_analysis(project, rules=[NamedLockRule()]), "named-lock"
+    )
+    assert any("`rogue` is not declared" in m for m in msgs)
+    assert any(
+        "minted as kind `lock` but cataloged as `rlock`" in m for m in msgs
+    )
+    assert any("non-literal lock name" in m for m in msgs)
+
+
+def test_named_lock_stale_catalog_and_dead_module(tmp_path):
+    stale = LOCKS_PY.replace(
+        '"good_r": {"kind": "rlock", "module": "spark_rapids_ml_tpu/mod.py"},',
+        '"good_r": {"kind": "rlock", "module": "spark_rapids_ml_tpu/mod.py"},\n'
+        '    "ghost": {"kind": "lock", "module": "spark_rapids_ml_tpu/gone.py"},',
+    )
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/telemetry/locks.py": stale,
+        "spark_rapids_ml_tpu/mod.py": (
+            "from .telemetry.locks import named_lock\n"
+            "_a = named_lock('good')\n"
+            "_b = named_lock('good_r', kind='rlock')\n"
+        ),
+    })
+    msgs = messages(
+        run_analysis(project, rules=[NamedLockRule()]), "named-lock"
+    )
+    assert any("`ghost` is never minted" in m for m in msgs)
+    assert any("`spark_rapids_ml_tpu/gone.py` which does not exist" in m
+               for m in msgs)
+
+
+def test_named_lock_rule_stands_down_without_catalog(tmp_path):
+    # a tree with no telemetry/locks.py (rule fixtures, partial
+    # checkouts): the rule yields nothing rather than flagging every
+    # bare lock against a catalog that does not exist
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/mod.py": (
+            "import threading\n_bare = threading.Lock()\n"
+        ),
+    })
+    assert not run_analysis(project, rules=[NamedLockRule()])
+
+
+def test_thread_lock_rule_treats_named_lock_as_lock(tmp_path):
+    # converting `_lock = threading.Lock()` to `named_lock(...)` must
+    # keep the module in the guarded-mutation rule's lock-declaring set
+    project = make_tree(tmp_path, {
+        "spark_rapids_ml_tpu/mod.py": (
+            "from .telemetry.locks import named_lock\n"
+            "_mu = named_lock('good')\n"
+            "_cache = {}\n"
+            "def good(k, v):\n"
+            "    with _mu:\n"
+            "        _cache[k] = v\n"
+            "def bad(k, v):\n"
+            "    _cache[k] = v\n"
+        ),
+    })
+    findings = run_analysis(project, rules=[ThreadLockRule()])
+    assert [f.line for f in findings] == [8], findings
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,377 @@
+#
+# Automatic hang doctor (telemetry/hang_doctor.py): wait-for graph
+# units, stall detection, and the acceptance fixture — a seeded
+# two-thread interleaved-device-dispatch deadlock (the PR-14 class,
+# with the serializing `_device_step_lock` bypassed in-fixture) that
+# the doctor must diagnose within `hang_doctor_stall_s`, naming both
+# threads and the lock cycle, with a parseable reason="stall" bundle.
+#
+import glob
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from spark_rapids_ml_tpu.config import reset_config, set_config
+from spark_rapids_ml_tpu.telemetry import hang_doctor
+from spark_rapids_ml_tpu.telemetry.flight_recorder import RECORDER
+from spark_rapids_ml_tpu.telemetry.hang_doctor import (
+    DOCTOR,
+    HangDoctor,
+    all_thread_stacks,
+    build_wait_graph,
+    describe_cycle,
+    find_cycles,
+)
+from spark_rapids_ml_tpu.telemetry.locks import named_lock
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# wait-for graph units
+# ---------------------------------------------------------------------------
+
+
+def _table(*rows):
+    return [
+        {
+            "name": name,
+            "holder": {"thread_id": h_id, "thread": h},
+            "waiters": [
+                {"thread_id": w_id, "thread": w, "waited_s": 9.0}
+                for w_id, w in waiters
+            ],
+        }
+        for name, (h_id, h), waiters in rows
+    ]
+
+
+def test_wait_graph_edges():
+    table = _table(
+        ("la", (1, "A"), [(2, "B")]),
+        ("lb", (2, "B"), []),
+    )
+    edges = build_wait_graph(table)
+    assert len(edges) == 1
+    e = edges[0]
+    assert (e["waiter"], e["lock"], e["holder"]) == ("B", "la", "A")
+
+
+def test_find_cycles_two_thread_deadlock():
+    table = _table(
+        ("la", (1, "A"), [(2, "B")]),
+        ("lb", (2, "B"), [(1, "A")]),
+    )
+    cycles = find_cycles(build_wait_graph(table))
+    assert len(cycles) == 1
+    cyc = cycles[0]
+    assert {e["lock"] for e in cyc} == {"la", "lb"}
+    assert {e["waiter"] for e in cyc} == {"A", "B"}
+    desc = describe_cycle(cyc)
+    assert "la" in desc and "lb" in desc
+    assert desc.count("A") + desc.count("B") >= 3  # closes the loop
+
+
+def test_find_cycles_chain_is_not_a_cycle():
+    # C waits on B's lock, B waits on A's lock, A runs free: a chain
+    table = _table(
+        ("la", (1, "A"), [(2, "B")]),
+        ("lb", (2, "B"), [(3, "C")]),
+    )
+    assert find_cycles(build_wait_graph(table)) == []
+
+
+def test_find_cycles_three_thread_ring():
+    table = _table(
+        ("la", (1, "A"), [(3, "C")]),
+        ("lb", (2, "B"), [(1, "A")]),
+        ("lc", (3, "C"), [(2, "B")]),
+    )
+    cycles = find_cycles(build_wait_graph(table))
+    assert len(cycles) == 1
+    assert len(cycles[0]) == 3
+
+
+def test_all_thread_stacks_names_threads():
+    ev = threading.Event()
+
+    def parked():
+        ev.wait(timeout=10)
+
+    t = threading.Thread(target=parked, name="parked-thread")
+    t.start()
+    try:
+        text = all_thread_stacks()
+        assert "parked-thread" in text
+        assert "ev.wait" in text or "parked" in text
+    finally:
+        ev.set()
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# stall detection (private doctor instances; ticks driven by the test)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def stall_conf(tmp_path):
+    """Private-doctor tests: the GLOBAL daemon is conf'd off so it
+    cannot race the test's own tick-driven doctor for the recorder's
+    per-reason cooldown; private instances pass force_enabled=True."""
+    set_config(
+        hang_doctor="off",
+        hang_doctor_stall_s=0.4,
+        flight_recorder_dir=str(tmp_path),
+    )
+    RECORDER.clear()  # reset per-reason dump cooldowns
+    yield tmp_path
+    reset_config()
+    RECORDER.clear()
+
+
+def test_tick_quiet_process_is_not_a_stall(stall_conf):
+    doc = HangDoctor(force_enabled=True)
+    assert doc.tick() is None
+    time.sleep(0.5)
+    # idle (no pending work): quiet time alone must not dump
+    assert doc.tick() is None
+    assert not glob.glob(f"{stall_conf}/postmortem_stall_*")
+
+
+def test_lock_stall_dumps_once_per_episode(stall_conf):
+    lk = named_lock("t_doc_stall")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(timeout=20)
+
+    def waiter():
+        lk.acquire(timeout=20)
+        lk.release()
+
+    th = threading.Thread(target=holder, name="doc-holder")
+    th.start()
+    held.wait()
+    tw = threading.Thread(target=waiter, name="doc-waiter")
+    tw.start()
+    doc = HangDoctor(force_enabled=True)
+    try:
+        time.sleep(0.1)
+        assert doc.tick() is None  # not stalled yet
+        time.sleep(0.5)
+        bdir = doc.tick()
+        assert bdir and os.path.isdir(bdir)
+        # same episode, no progress: no second bundle
+        assert doc.tick() is None
+        stacks = open(os.path.join(bdir, "stacks.txt")).read()
+        assert "doc-holder" in stacks and "doc-waiter" in stacks
+        wf = json.load(open(os.path.join(bdir, "waitfor.json")))
+        assert wf["kind"] == "lock_wait"
+        assert any(
+            e["lock"] == "t_doc_stall" and e["waiter"] == "doc-waiter"
+            for e in wf["edges"]
+        )
+        man = json.load(open(os.path.join(bdir, "manifest.json")))
+        assert man["reason"] == "stall"
+        assert set(man["attachments"]) >= {
+            "stacks.txt", "waitfor.json", "locks.json",
+        }
+        assert (
+            REGISTRY.get("hang_doctor_stalls_total").value(kind="lock_wait")
+            >= 1
+        )
+    finally:
+        release.set()
+        th.join()
+        tw.join()
+
+
+def test_no_progress_stall_requires_pending_work(stall_conf):
+    from spark_rapids_ml_tpu.telemetry.heartbeat import Heartbeat
+
+    doc = HangDoctor(force_enabled=True)
+    doc.tick()
+    time.sleep(0.5)
+    assert doc.tick() is None  # idle process: never a stall
+    # now leave a live solver gauge (a fit "in progress") and go quiet
+    hb = Heartbeat("t_doc_solver", interval=0)
+    try:
+        hb.beat(3, loss=1.0)
+        doc.tick()  # observes the beat as progress
+        time.sleep(0.5)
+        bdir = doc.tick()
+        assert bdir and os.path.isdir(bdir)
+        wf = json.load(open(os.path.join(bdir, "waitfor.json")))
+        assert wf["kind"] == "no_progress"
+        man = json.load(open(os.path.join(bdir, "manifest.json")))
+        assert man["reason"] == "stall"
+    finally:
+        hb.close()
+
+
+def test_progress_rearms_episode(stall_conf):
+    from spark_rapids_ml_tpu.telemetry.heartbeat import Heartbeat
+
+    doc = HangDoctor(force_enabled=True)
+    hb = Heartbeat("t_doc_solver2", interval=0)
+    try:
+        hb.beat(1)
+        doc.tick()
+        time.sleep(0.5)
+        assert doc.tick() is not None  # first episode
+        hb.beat(2)  # progress!
+        RECORDER.clear()  # bypass the cooldown for the second episode
+        doc.tick()
+        time.sleep(0.5)
+        assert doc.tick() is not None  # new episode after new progress
+    finally:
+        hb.close()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the seeded PR-14-class deadlock, diagnosed by the live
+# daemon within hang_doctor_stall_s
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_interleaved_dispatch_deadlock_diagnosed(stall_conf):
+    """Two threads mimic the PR-14 wedge: each 'dispatch pass' takes its
+    own device lock then needs the other's (the interleaved multi-device
+    dispatch shape `_device_step_lock` exists to serialize — bypassed
+    here, as the fixture seeds the deadlock on two per-pass locks).
+    The ALWAYS-ON daemon must fire within ~hang_doctor_stall_s, name
+    both threads and the lock cycle, and leave a parseable bundle."""
+    la = named_lock("t_dispatch_a")
+    lb = named_lock("t_dispatch_b")
+    barrier = threading.Barrier(2, timeout=10)
+    give_up = 12.0  # the fixture threads' own escape hatch
+
+    def pass_a():
+        with la:
+            barrier.wait()
+            if lb.acquire(timeout=give_up):  # deadlocked until timeout
+                lb.release()
+
+    def pass_b():
+        with lb:
+            barrier.wait()
+            if la.acquire(timeout=give_up):
+                la.release()
+
+    set_config(hang_doctor="on")  # the acceptance path IS the daemon
+    ta = threading.Thread(target=pass_a, name="describe-pass-a")
+    tb = threading.Thread(target=pass_b, name="describe-pass-b")
+    stall_s = 0.4
+    t_detect = None
+    from spark_rapids_ml_tpu.tracing import event
+
+    event("t_doctor_seed")  # make sure the daemon thread is spawned
+    assert DOCTOR._started
+    t0 = time.monotonic()
+    ta.start()
+    tb.start()
+    try:
+        deadline = time.monotonic() + 8
+        bundles = []
+        while time.monotonic() < deadline:
+            bundles = [
+                os.path.dirname(m) for m in glob.glob(
+                    f"{stall_conf}/postmortem_stall_*/manifest.json"
+                )
+            ]
+            if bundles:
+                t_detect = time.monotonic() - t0
+                break
+            time.sleep(0.05)
+        assert bundles, "hang doctor never diagnosed the deadlock"
+        # detection latency: the wait must reach stall_s before it IS a
+        # stall, plus a poll interval and the dump; well under the
+        # fixture's give-up horizon
+        assert t_detect < stall_s + 4.0, t_detect
+        b = bundles[0]
+        wf = json.load(open(os.path.join(b, "waitfor.json")))
+        assert wf["cycles"], wf
+        cyc = wf["cycles"][0]
+        assert set(cyc["locks"]) == {"t_dispatch_a", "t_dispatch_b"}
+        assert set(cyc["threads"]) == {
+            "describe-pass-a", "describe-pass-b",
+        }
+        assert "describe-pass-a" in cyc["description"]
+        man = json.load(open(os.path.join(b, "manifest.json")))
+        assert man["reason"] == "stall"
+        assert "deadlock" in man["detail"]
+        stacks = open(os.path.join(b, "stacks.txt")).read()
+        assert "describe-pass-a" in stacks and "describe-pass-b" in stacks
+        # the bundle's chrome trace parses (the "newest spans" evidence)
+        trace = json.load(open(os.path.join(b, "trace.json")))
+        assert "traceEvents" in trace
+        locks_json = json.load(open(os.path.join(b, "locks.json")))
+        assert any(r["name"] == "t_dispatch_a" for r in locks_json)
+        assert (
+            REGISTRY.get("postmortems_total").value(reason="stall") >= 1
+        )
+    finally:
+        ta.join()
+        tb.join()
+
+
+def test_doctor_off_never_ticks_into_a_dump(stall_conf):
+    set_config(hang_doctor="off")
+    lk = named_lock("t_doc_off")
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(timeout=10)
+
+    def waiter():
+        lk.acquire(timeout=10)
+        lk.release()
+
+    th = threading.Thread(target=holder)
+    th.start()
+    held.wait()
+    tw = threading.Thread(target=waiter)
+    tw.start()
+    doc = HangDoctor()
+    try:
+        time.sleep(0.6)
+        assert doc.tick() is None
+        assert not glob.glob(f"{stall_conf}/postmortem_stall_*")
+    finally:
+        release.set()
+        th.join()
+        tw.join()
+
+
+def test_wedge_guard_env_is_wired():
+    """The CI wedge guard (tests/conftest.py + ci/wedge/sitecustomize.py)
+    arms faulthandler from WEDGE_GUARD_S: verify the arming path works
+    in a subprocess — a parked child dumps its stacks and exits nonzero
+    at the deadline instead of hanging."""
+    import subprocess
+    import sys
+
+    code = (
+        "import threading; threading.Event().wait(timeout=30)"
+    )
+    env = dict(os.environ, WEDGE_GUARD_S="1",
+               PYTHONPATH="ci/wedge" + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=20, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode != 0
+    assert time.monotonic() - t0 < 15
+    assert "Timeout" in proc.stderr and "Thread" in proc.stderr

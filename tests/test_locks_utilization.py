@@ -1,0 +1,512 @@
+#
+# Named-lock contention profiling (telemetry/locks.py) and the
+# utilization timeline (telemetry/utilization.py): metric accuracy,
+# holder/waiter table, Condition flavor, registry publication, interval
+# math and gap attribution, and the new serving queue sensors.
+#
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_ml_tpu.telemetry import locks, utilization
+from spark_rapids_ml_tpu.telemetry.locks import (
+    LOCK_CATALOG,
+    lock_table,
+    named_lock,
+    publish_lock_metrics,
+)
+from spark_rapids_ml_tpu.telemetry.registry import REGISTRY
+
+
+def _core(name):
+    cores = [c for n, _k, c in locks._live_cores() if n == name]
+    assert cores, f"lock {name!r} not registered"
+    return cores[-1]
+
+
+def _row(name):
+    rows = [r for r in lock_table() if r["name"] == name]
+    assert rows, f"lock {name!r} not in table"
+    return rows[-1]
+
+
+# ---------------------------------------------------------------------------
+# accounting accuracy
+# ---------------------------------------------------------------------------
+
+
+def test_uncontended_acquire_counts_but_never_waits():
+    lk = named_lock("t_plain")
+    for _ in range(5):
+        with lk:
+            pass
+    core = _core("t_plain")
+    assert core.acquisitions == 5
+    assert core.contended == 0
+    assert core.wait_s == 0.0
+    assert core.hold_s >= 0.0
+
+
+def test_contended_wait_seconds_measured():
+    lk = named_lock("t_meter")
+    hold_s = 0.25
+    started = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            time.sleep(hold_s)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait()
+    time.sleep(0.02)  # make sure the holder is inside its sleep
+    t0 = time.perf_counter()
+    with lk:
+        waited = time.perf_counter() - t0
+    t.join()
+    core = _core("t_meter")
+    assert core.acquisitions == 2
+    assert core.contended == 1
+    # the recorded wait matches the measured wait (same clock, same
+    # window) and is in the ballpark of the holder's sleep
+    assert abs(core.wait_s - waited) < 0.05, (core.wait_s, waited)
+    assert 0.1 < core.wait_s < 2.0
+    assert core.hold_s >= hold_s * 0.8
+
+
+def test_hold_seconds_accumulate():
+    lk = named_lock("t_hold")
+    with lk:
+        time.sleep(0.05)
+    core = _core("t_hold")
+    assert 0.04 < core.hold_s < 1.0
+
+
+def test_rlock_reentrant_depth_and_single_hold_window():
+    rl = named_lock("t_rl", kind="rlock")
+    with rl:
+        with rl:
+            row = _row("t_rl")
+            assert row["holder"]["depth"] == 2
+        time.sleep(0.05)
+    core = _core("t_rl")
+    assert core.acquisitions == 2
+    # hold time spans the OUTERMOST acquire..release window only
+    assert core.hold_s >= 0.04
+    assert _row("t_rl").get("holder") is None
+
+
+def test_holder_and_waiter_table_live():
+    lk = named_lock("t_table")
+    in_hold = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            in_hold.set()
+            release.wait(timeout=5)
+
+    def waiter():
+        lk.acquire(timeout=5)
+        lk.release()
+
+    th = threading.Thread(target=holder, name="t-holder")
+    th.start()
+    in_hold.wait()
+    tw = threading.Thread(target=waiter, name="t-waiter")
+    tw.start()
+    deadline = time.time() + 2
+    row = None
+    while time.time() < deadline:
+        row = _row("t_table")
+        if row.get("waiters"):
+            break
+        time.sleep(0.01)
+    assert row is not None and row["holder"]["thread"] == "t-holder"
+    assert [w["thread"] for w in row["waiters"]] == ["t-waiter"]
+    release.set()
+    th.join()
+    tw.join()
+    row = _row("t_table")
+    assert row.get("holder") is None and not row.get("waiters")
+
+
+def test_condition_flavor_profiles_and_works():
+    cv = named_lock("t_cond", kind="condition")
+    assert isinstance(cv, threading.Condition)
+    got = []
+
+    def consumer():
+        with cv:
+            while not got:
+                if not cv.wait(timeout=5):
+                    return
+        got.append("woke")
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        got.append("notified")
+        cv.notify_all()
+    t.join()
+    assert got == ["notified", "woke"]
+    core = _core("t_cond")
+    assert core.acquisitions >= 3  # consumer enter + reacquire, notifier
+
+
+def test_publish_lock_metrics_monotone_registry_counters():
+    lk = named_lock("t_pub")
+    for _ in range(7):
+        with lk:
+            pass
+    publish_lock_metrics()
+    acq = REGISTRY.get("lock_acquisitions_total")
+    first = acq.value(lock="t_pub")
+    assert first >= 7
+    publish_lock_metrics()  # no new traffic: counters must not move
+    assert acq.value(lock="t_pub") == first
+    with lk:
+        pass
+    publish_lock_metrics()
+    assert acq.value(lock="t_pub") == first + 1
+
+
+def test_publish_lock_metrics_concurrent_callers_publish_exactly_once():
+    """publish_lock_metrics is called concurrently (doctor tick, scrape,
+    fit report): two racing publishers must not double-inc the counters
+    or overshoot the per-core ledger (review finding)."""
+    lk = named_lock("t_pub_race")
+    for _ in range(50):
+        with lk:
+            pass
+    barrier = threading.Barrier(2, timeout=5)
+
+    def pub():
+        barrier.wait()
+        publish_lock_metrics()
+
+    ts = [threading.Thread(target=pub) for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    acq = REGISTRY.get("lock_acquisitions_total")
+    assert acq.value(lock="t_pub_race") == 50
+    # the ledger did not overshoot: later real traffic still publishes
+    with lk:
+        pass
+    publish_lock_metrics()
+    assert acq.value(lock="t_pub_race") == 51
+
+
+def test_busy_gauge_clears_when_window_empties():
+    """An idle window must REMOVE the device_busy_fraction series, not
+    freeze the last burst's value forever (review finding)."""
+    utilization.clear()
+    now = time.perf_counter()
+    utilization.note_interval(
+        "device", now - 0.2, now - 0.1, cause="x", domain="serving"
+    )
+    s = utilization.summarize(window_s=60.0, scope="t_scope",
+                              domain="serving")
+    g = REGISTRY.get("device_busy_fraction")
+    assert s and g.value(scope="t_scope") == s["device_busy_fraction"]
+    utilization.clear()  # everything aged out / reset
+    assert utilization.summarize(
+        window_s=60.0, scope="t_scope", domain="serving"
+    ) == {}
+    sentinel = object()
+    assert g.value(default=sentinel, scope="t_scope") is sentinel
+
+
+def test_slow_wait_marker_lands_in_span_tree():
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.tracing import get_trace_events, run_context
+
+    lk = named_lock("t_slow")
+    # force a conf-cache refresh: the threshold memo refreshes on a
+    # timer, so push the memo's clock back before lowering the conf
+    set_config(lock_slow_wait_ms=10.0)
+    with locks._table_mu:
+        locks._slow_conf["t"] = 0.0
+    started = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            time.sleep(0.1)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait()
+    try:
+        with run_context("run-slowwait"):
+            with lk:
+                pass
+        evs = [
+            e for e in get_trace_events()
+            if e.name == "lock_slow_wait[t_slow]"
+        ]
+        assert evs, "expected a slow-wait instant marker"
+        assert evs[-1].run_id == "run-slowwait"
+        assert evs[-1].kind == "instant"
+    finally:
+        t.join()
+        reset_config()
+        with locks._table_mu:
+            locks._slow_conf["t"] = 0.0
+
+
+def test_slow_wait_on_trace_path_lock_does_not_self_deadlock():
+    """The flight recorder's lock sits INSIDE the trace-emission path:
+    a slow contended acquire of it emits a slow-wait event, whose tap
+    re-enters FlightRecorder.record() and re-acquires the SAME lock on
+    the same thread.  With a plain Lock that self-deadlocks the whole
+    trace-emission path; the recorder's lock is reentrant exactly for
+    this (review finding), pinned here."""
+    from spark_rapids_ml_tpu.config import reset_config, set_config
+    from spark_rapids_ml_tpu.telemetry.flight_recorder import RECORDER
+    from spark_rapids_ml_tpu.tracing import event
+
+    set_config(lock_slow_wait_ms=10.0)
+    with locks._table_mu:
+        locks._slow_conf["t"] = 0.0
+    held = threading.Event()
+    done = threading.Event()
+
+    def hog():
+        with RECORDER._lock:
+            held.set()
+            time.sleep(0.2)
+
+    def emitter():
+        # the tap contends on the recorder lock for ~0.2s (> threshold),
+        # then the slow-wait event re-enters record() on this thread
+        event("t_reentry_probe")
+        done.set()
+
+    th = threading.Thread(target=hog)
+    th.start()
+    held.wait()
+    te = threading.Thread(target=emitter)
+    te.start()
+    try:
+        assert done.wait(timeout=10), (
+            "trace emission deadlocked on the recorder's own lock"
+        )
+    finally:
+        th.join()
+        te.join(timeout=5)
+        reset_config()
+        with locks._table_mu:
+            locks._slow_conf["t"] = 0.0
+
+
+def test_serving_window_summary_excludes_fit_intervals():
+    """report()-style window summaries scope by domain: a concurrent
+    fit's device intervals must not count as serving device-busy time
+    (review finding)."""
+    utilization.clear()
+    now = time.perf_counter()
+    utilization.note_interval(
+        "device", now - 2.0, now - 1.0, cause="fit_kernel", domain="fit"
+    )
+    utilization.note_interval(
+        "device", now - 0.5, now - 0.4, cause="pca", domain="serving"
+    )
+    utilization.note_interval(
+        "lock_wait", now - 0.45, now - 0.42, cause="x", domain="any"
+    )
+    s = utilization.summarize(window_s=60.0, domain="serving")
+    # only the serving device interval (0.1s) and the shared lock wait
+    assert abs(s["device_busy_s"] - 0.1) < 0.02, s
+    assert all(
+        r["kind"] != "device" or r.get("cause") != "fit_kernel"
+        for r in s["gap_attribution"]
+    )
+    # window clipping: an interval straddling the cutoff is clipped, so
+    # the observed wall never stretches past the window
+    utilization.clear()
+    utilization.note_interval(
+        "device", now - 500.0, now, cause="long", domain="serving"
+    )
+    s = utilization.summarize(window_s=60.0, domain="serving")
+    assert s["wall_s"] <= 61.0, s
+
+
+def test_catalog_covers_every_package_lock():
+    # every cataloged name carries kind + declaring module, and the
+    # kinds are from the minted vocabulary
+    for name, spec in LOCK_CATALOG.items():
+        assert spec["kind"] in ("lock", "rlock", "condition"), name
+        assert spec["module"].startswith("spark_rapids_ml_tpu/"), name
+    # the shared device-step serializer (the PR-14 lock) is cataloged
+    assert LOCK_CATALOG["device_step"]["module"].endswith("stats/engine.py")
+
+
+# ---------------------------------------------------------------------------
+# utilization timeline
+# ---------------------------------------------------------------------------
+
+
+def test_interval_math_merge_overlap_complement():
+    merged = utilization.merge_intervals([(3, 4), (1, 2), (1.5, 3.2)])
+    assert merged == [(1, 4)]
+    assert utilization.interval_overlap_s([(0, 2)], [(1, 3)]) == 1
+    assert utilization.complement([(1, 2), (3, 4)], 0, 5) == [
+        (0, 1), (2, 3), (4, 5),
+    ]
+    assert utilization.complement([], 0, 2) == [(0, 2)]
+
+
+def test_summarize_busy_fraction_and_gap_attribution():
+    utilization.clear()
+    run = "run-util-t1"
+    utilization.note_interval("device", 0.0, 1.0, run_id=run)
+    utilization.note_interval("device", 2.0, 3.0, run_id=run)
+    utilization.note_interval(
+        "host_prep", 0.5, 2.5, cause="decode", run_id=run
+    )
+    utilization.note_interval(
+        "lock_wait", 1.2, 1.4, cause="device_step", run_id=run
+    )
+    s = utilization.summarize(run_id=run)
+    assert s["wall_s"] == 3.0
+    assert s["device_busy_s"] == 2.0
+    assert abs(s["device_busy_fraction"] - 2.0 / 3.0) < 1e-3
+    assert s["gap_s"] == 1.0
+    rows = {
+        (r["kind"], r.get("cause")): r["stolen_s"]
+        for r in s["gap_attribution"]
+    }
+    # the 1s gap [1,2] is fully covered by host_prep; the lock wait
+    # stole 0.2s of it (co-occurring causes may both claim a second)
+    assert abs(rows[("host_prep", "decode")] - 1.0) < 1e-9
+    assert abs(rows[("lock_wait", "device_step")] - 0.2) < 1e-9
+    # ranked by stolen seconds, worst first
+    assert s["gap_attribution"][0]["kind"] == "host_prep"
+    assert s["unattributed_s"] == 0.0
+
+
+def test_summarize_unattributed_residual():
+    utilization.clear()
+    run = "run-util-t2"
+    utilization.note_interval("device", 0.0, 1.0, run_id=run)
+    utilization.note_interval("device", 3.0, 4.0, run_id=run)
+    utilization.note_interval("host_prep", 1.0, 1.5, run_id=run)
+    s = utilization.summarize(run_id=run)
+    assert s["gap_s"] == 2.0
+    assert abs(s["unattributed_s"] - 1.5) < 1e-9
+
+
+def test_summarize_scope_sets_gauge_and_empty_is_empty():
+    utilization.clear()
+    assert utilization.summarize(run_id="nothing-recorded") == {}
+    utilization.note_interval("device", 0.0, 1.0, run_id="run-util-g")
+    utilization.summarize(run_id="run-util-g", scope="fit")
+    g = REGISTRY.get("device_busy_fraction")
+    assert g.value(scope="fit") == 1.0
+
+
+def test_fit_report_carries_utilization_section():
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.feature import PCA
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(4000, 8)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    m = PCA(k=2).setInputCol("features").setOutputCol("o").fit(df)
+    rep = m.fit_report()
+    util = rep.get("utilization")
+    assert util, rep.keys()
+    assert 0.0 <= util["device_busy_fraction"] <= 1.0
+    assert util["wall_s"] > 0
+    # the fit kernel's blocking window is recorded as device activity
+    assert util["device_busy_s"] > 0
+
+
+def test_contended_named_lock_feeds_lock_wait_interval():
+    from spark_rapids_ml_tpu.tracing import run_context
+
+    utilization.clear()
+    lk = named_lock("t_util_lock")
+    started = threading.Event()
+
+    def holder():
+        with lk:
+            started.set()
+            time.sleep(0.15)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    started.wait()
+    with run_context("run-util-lk"):
+        with lk:
+            pass
+    t.join()
+    evs = [
+        e for e in utilization.timeline(run_id="run-util-lk")
+        if e[1] == "lock_wait" and e[2] == "t_util_lock"
+    ]
+    assert evs, "contended acquire must record a lock_wait interval"
+    assert 0.05 < evs[0][4] - evs[0][3] < 2.0
+
+
+# ---------------------------------------------------------------------------
+# serving queue sensors
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serving_server():
+    from spark_rapids_ml_tpu.serving import ServingServer
+
+    server = ServingServer()
+    yield server
+    server.stop()
+    server.registry.clear()
+
+
+def test_serving_queue_depth_gauge_tracks(serving_server):
+    import pandas as pd
+
+    from spark_rapids_ml_tpu.feature import PCA
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    model = PCA(k=2).setInputCol("features").setOutputCol("o").fit(df)
+    serving_server.register("echo", model, n_features=4)
+    serving_server.start()
+    serving_server.pause()
+    depth = REGISTRY.get("serving_queue_depth")
+    futs = [
+        serving_server.submit(
+            "echo", rng.normal(size=(1, 4)).astype(np.float32)
+        )
+        for _ in range(5)
+    ]
+    assert depth.value(model="echo") == 5
+    serving_server.resume()
+    for f in futs:
+        f.result(timeout=60)
+    deadline = time.time() + 5
+    while time.time() < deadline and depth.value(model="echo") != 0:
+        time.sleep(0.02)
+    assert depth.value(model="echo") == 0
+    # the dispatcher's idle ticks record their wake overshoot
+    lag = REGISTRY.get("serving_dispatcher_lag_seconds")
+    deadline = time.time() + 3
+    while time.time() < deadline and lag.value(default=None) is None:
+        time.sleep(0.05)
+    assert lag.value(default=None) is not None
+    assert lag.value() >= 0.0
+    # utilization summary shows up in the server report once traffic ran
+    rep = serving_server.report()
+    util = rep["_totals"].get("utilization")
+    assert util and util["wall_s"] > 0

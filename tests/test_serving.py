@@ -567,7 +567,20 @@ def test_latency_exemplars_carry_request_ids(pca_model, rng):
         # still round-trips through the parser with them present
         page = dump_prometheus(exemplars=True)
         assert 'request_id="exemplar-probe"' in page
-        assert parse_prometheus(page) == parse_prometheus(dump_prometheus())
+
+        def _stable(parsed):
+            # the lock_* contention counters move between two dumps by
+            # design (each dump publishes the latest lock accounting,
+            # and the dispatcher keeps acquiring); the exemplar
+            # round-trip contract is about every OTHER family
+            return {
+                k: v for k, v in parsed.items()
+                if not k[0].startswith("spark_rapids_ml_tpu_lock_")
+            }
+
+        assert _stable(parse_prometheus(page)) == _stable(
+            parse_prometheus(dump_prometheus())
+        )
     finally:
         server.stop()
 
