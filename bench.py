@@ -47,7 +47,8 @@ WORKLOADS = [
     for w in os.environ.get(
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
-        "serving,drift,utilization,streaming,summarize,epoch_cache,"
+        "serving,serving_control,drift,utilization,streaming,summarize,"
+        "epoch_cache,"
         "refconfig,rf",
     ).split(",")
 ]
@@ -61,8 +62,8 @@ WORKLOADS = [
 if (
     WORKLOADS
     and all(
-        w in ("staging", "cv_cached", "fused_pca", "serving", "epoch_cache",
-              "utilization")
+        w in ("staging", "cv_cached", "fused_pca", "serving",
+              "serving_control", "epoch_cache", "utilization")
         for w in WORKLOADS
     )
     and os.environ.get("JAX_PLATFORMS", "") == "cpu"
@@ -1247,6 +1248,134 @@ def bench_serving(extra: dict):
         server.registry.clear()
 
 
+def bench_serving_control(extra: dict):
+    """Closed-loop serving control plane (serving/control.py): mixed
+    interactive/batch traffic through the priority-admission dispatcher,
+    then an engineered SLO-burn spike (an impossible per-model latency
+    target) that must walk the brownout machine — batch sheds FIRST and
+    every shed is counted, interactive requests must keep landing — and
+    finally a hands-off recovery once the target relaxes.  Headlines:
+    `serving_control_shed_fraction` (batch rejected during the spike,
+    lower-better: a controller shedding more than it must is throwing
+    away capacity) and `serving_control_recovery_s` (spike end ->
+    brownout phase back to `normal` with NO operator action,
+    lower-better).  `serving_control_interactive_drops` must stay 0 —
+    the whole point of priority admission."""
+    import numpy as np
+
+    from spark_rapids_ml_tpu.classification import LogisticRegression
+    from spark_rapids_ml_tpu.config import set_config
+    from spark_rapids_ml_tpu.serving import ServingServer
+    from spark_rapids_ml_tpu.serving.server import ServingOverload
+
+    n_req = int(os.environ.get("BENCH_SERVING_CONTROL_REQUESTS", 300))
+    d = 32
+    rng = _rng(31)
+    n_fit = min(N_ROWS, 20_000)
+    X = rng.standard_normal((n_fit, d)).astype(np.float32)
+    y = (X @ rng.standard_normal(d).astype(np.float32) > 0).astype(
+        np.float32
+    )
+    import pandas as pd
+
+    df = pd.DataFrame({"features": list(X), "label": y})
+    model = LogisticRegression(maxIter=10).fit(df)
+
+    set_config(
+        serving_max_wait_ms=5.0,
+        serving_max_queue=256,
+        serving_slo_targets="",
+        # fast reaction so the bench fits a CI window; the RATIOS
+        # (burn thresholds, batch share) stay at their defaults — the
+        # bench measures the control law, not the timer constants
+        serving_controller_interval_s=0.05,
+        serving_brownout_sustain_s=0.2,
+        serving_brownout_recover_s=0.2,
+    )
+    server = ServingServer()
+    server.register("ctl", model, n_features=d)
+    server.start()
+    try:
+        req = rng.standard_normal((1, d)).astype(np.float32)
+        seq_fn = model._transform_array
+        seq_fn(req)  # warm compiles out of both timings
+        server.transform("ctl", req, timeout=300)
+        # -- steady state: 4:1 interactive:batch mixed traffic ---------
+        n_seq = max(n_req // 4, 1)
+        t0 = time.perf_counter()
+        for _ in range(n_seq):
+            seq_fn(req)
+        seq_qps = n_seq / max(time.perf_counter() - t0, 1e-9)
+        t0 = time.perf_counter()
+        futs = [
+            server.submit(
+                "ctl", req,
+                priority="batch" if i % 5 == 4 else "interactive",
+            )
+            for i in range(n_req)
+        ]
+        for f in futs:
+            f.result(timeout=300)
+        qps = n_req / max(time.perf_counter() - t0, 1e-9)
+        extra["serving_control_qps"] = round(qps, 1)
+        extra["serving_control_qps_x_sequential"] = round(
+            qps / max(seq_qps, 1e-9), 2
+        )
+        extra["serving_control_p99_ms"] = server.report()["ctl"].get(
+            "p99_ms"
+        )
+
+        def _phase() -> str:
+            return server.report()["ctl"]["controller"]["brownout_phase"]
+
+        # -- spike: impossible SLO target -> burn >1 -> brownout -------
+        set_config(serving_slo_targets="ctl=0.0001")
+        batch_total = batch_shed = inter_drops = 0
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            pend = []
+            for i in range(8):
+                pr = "batch" if i % 2 else "interactive"
+                try:
+                    pend.append(server.submit("ctl", req, priority=pr))
+                    batch_total += pr == "batch"
+                except ServingOverload:
+                    if pr == "batch":
+                        batch_total += 1
+                        batch_shed += 1
+                    else:
+                        inter_drops += 1
+            for f in pend:
+                try:
+                    f.result(timeout=60)
+                except Exception:
+                    pass
+            if _phase() != "normal" and batch_shed:
+                break
+        extra["serving_control_shed_fraction"] = round(
+            batch_shed / max(batch_total, 1), 3
+        )
+        extra["serving_control_interactive_drops"] = inter_drops
+        # -- recovery: relax the target, touch nothing else ------------
+        set_config(serving_slo_targets="ctl=60000")
+        t0 = time.perf_counter()
+        recovery_s = -1.0  # sentinel: never recovered inside the window
+        while time.perf_counter() - t0 < 30.0:
+            try:
+                server.transform("ctl", req, timeout=60)
+            except ServingOverload:
+                pass
+            if _phase() == "normal":
+                recovery_s = round(time.perf_counter() - t0, 2)
+                break
+            time.sleep(0.05)
+        extra["serving_control_recovery_s"] = recovery_s
+    finally:
+        server.stop()
+        server.registry.clear()
+        set_config(serving_slo_targets="")
+
+
 def bench_drift(extra: dict):
     """Drift monitor (spark_rapids_ml_tpu/monitor/): serving-side fold
     overhead in us/row (the host-tier cost every served batch pays once
@@ -1990,8 +2119,8 @@ def _cpu_shrink() -> None:
         N_ROWS = min(N_ROWS, 200_000)
     if "BENCH_WORKLOADS" not in os.environ:
         WORKLOADS[:] = [
-            "pca", "fused_pca", "staging", "serving", "streaming",
-            "summarize", "epoch_cache",
+            "pca", "fused_pca", "staging", "serving", "serving_control",
+            "streaming", "summarize", "epoch_cache",
         ]
 
 
@@ -2134,6 +2263,7 @@ def main() -> None:
         "staging": bench_staging,
         "cv_cached": bench_cv_cached,
         "serving": bench_serving,
+        "serving_control": bench_serving_control,
         "drift": bench_drift,
         "utilization": bench_utilization,
         "streaming": bench_streaming,

@@ -58,6 +58,13 @@ _LOWER_BETTER = (
     "_us_per_acquire",
     "_acquire_us",
     "_tick_us",
+    # serving control plane (bench.py `serving_control` section): the
+    # fraction of batch traffic shed during the engineered SLO spike —
+    # a controller shedding more than it must is discarding capacity
+    "_shed_fraction",
+    # ...and the hands-off time from target-relaxed to brownout phase
+    # back at `normal`; slower re-admission = capacity held back longer
+    "_recovery_s",
 )
 _HIGHER_BETTER = (
     "_per_sec",

@@ -46,6 +46,11 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("pca_", "pca"),
     ("rf_", "rf"),
     ("refconfig_", "refconfig"),
+    # closed-loop serving control plane (bench.py `serving_control`
+    # section): mixed-priority QPS, spike shed fraction, and hands-off
+    # brownout recovery time.  MUST precede the broader `serving_`
+    # prefix — first startswith match wins
+    ("serving_control_", "serving_control"),
     ("serving_", "serving"),
     ("staging_", "staging"),
     ("streaming_", "streaming"),

@@ -131,7 +131,8 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_benchmark.py tests/test_connect_plugin.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
-    tests/test_serving.py tests/test_drift_monitor.py \
+    tests/test_serving.py tests/test_serving_control.py \
+    tests/test_drift_monitor.py \
     tests/test_flight_recorder.py tests/test_aggregate.py \
     tests/test_locks_utilization.py tests/test_hang_doctor.py \
     tests/test_bench_history.py tests/test_analysis.py \
@@ -400,6 +401,110 @@ for fam, labels in (
 assert not any(k[0] == pre + "serving_rejections_total" for k in parsed)
 server.stop()
 print("serving smoke OK: zero rejections, families scrapeable")
+EOF
+
+echo "== control-plane smoke: SLO spike sheds batch, recovers hands-off =="
+# tier-1 marker-safe: logreg pinned on the 8-dev CPU mesh under mixed
+# interactive/batch traffic, then an engineered SLO spike (impossible
+# per-model p99 target) must (a) push slo_burn_rate past 1.0, (b) walk
+# the brownout machine — batch requests shed with reason="shed" while
+# EVERY interactive request keeps landing (zero drops), (c) leave
+# exactly ONE reason="brownout" post-mortem bundle that parses (the
+# recorder's per-reason cooldown absorbs the escalation storm), and
+# (d) once the target relaxes, return burn below 1.0 and the phase to
+# `normal` with NO operator action — batch traffic re-admitted.
+# tests/test_serving_control.py covers the AIMD/priority/padding
+# matrix; this step keeps the closed-loop gate runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import glob
+import json
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.serving import ServingServer
+from spark_rapids_ml_tpu.serving.server import ServingOverload
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(4000, 16)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+df = pd.DataFrame({"features": list(X), "label": y})
+model = LogisticRegression(maxIter=10).fit(df)
+
+with tempfile.TemporaryDirectory() as td:
+    set_config(
+        flight_recorder_dir=td, serving_max_wait_ms=2.0,
+        serving_max_queue=256, serving_controller_interval_s=0.05,
+        serving_brownout_sustain_s=0.2, serving_brownout_recover_s=0.2,
+        serving_slo_targets="",
+    )
+    server = ServingServer()
+    server.register("ctl", model, n_features=16)
+    server.start()
+    try:
+        req = rng.normal(size=(1, 16)).astype(np.float32)
+        server.transform("ctl", req, timeout=300)  # warm the program
+
+        def phase():
+            return server.report()["ctl"]["controller"]["brownout_phase"]
+
+        # -- spike: impossible target, mixed traffic ------------------
+        set_config(serving_slo_targets="ctl=0.0001")
+        shed = inter_drops = inter_ok = 0
+        peak_burn = 0.0
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            pend = []
+            for i in range(8):
+                pr = "batch" if i % 2 else "interactive"
+                try:
+                    pend.append(server.submit("ctl", req, priority=pr))
+                except ServingOverload as e:
+                    if pr == "interactive":
+                        inter_drops += 1
+                    elif e.reason == "shed":
+                        shed += 1
+            for f in pend:
+                f.result(timeout=120)
+            inter_ok += sum(1 for i in range(8) if not i % 2)
+            rep = server.report()["ctl"]
+            peak_burn = max(peak_burn, rep.get("slo_burn_1m", 0.0))
+            if phase() != "normal" and shed:
+                break
+        assert peak_burn > 1.0, f"spike never drove burn past 1.0: {peak_burn}"
+        assert shed > 0, "brownout never shed batch traffic"
+        assert inter_drops == 0, f"{inter_drops} interactive drops"
+        assert inter_ok > 0
+
+        # -- exactly one parsed brownout black box --------------------
+        bundles = glob.glob(f"{td}/postmortem_brownout_*")
+        assert len(bundles) == 1, bundles
+        man = json.load(open(bundles[0] + "/manifest.json"))
+        assert man["reason"] == "brownout", man
+        assert "normal->shed_batch" in man.get("detail", ""), man
+
+        # -- recovery: relax the target, touch nothing else -----------
+        set_config(serving_slo_targets="ctl=60000")
+        deadline = time.time() + 60
+        while time.time() < deadline and phase() != "normal":
+            server.transform("ctl", req, timeout=120)
+            time.sleep(0.05)
+        assert phase() == "normal", f"never recovered: phase={phase()}"
+        rep = server.report()["ctl"]
+        burn = rep.get("slo_burn_1m", 0.0)
+        assert burn < 1.0, f"burn still {burn} after recovery"
+        server.submit("ctl", req, priority="batch").result(timeout=120)
+        print(f"control-plane smoke OK: burn peaked {peak_burn:.1f}, "
+              f"{shed} batch shed / 0 interactive drops, one brownout "
+              f"bundle, recovered to burn {burn:.2f} hands-off")
+    finally:
+        server.stop()
+        server.registry.clear()
 EOF
 
 echo "== drift smoke: shifted serving traffic trips the monitor =="

@@ -373,6 +373,62 @@ _DEFAULTS: Dict[str, Any] = {
     # (e.g. "logreg=5,pca=20").  Models not listed fall back to
     # `serving_slo_p99_ms`.  Empty = no per-model overrides.
     "serving_slo_targets": "",
+    # Closed-loop serving controller (serving/control.py): "on" ticks a
+    # per-model AIMD feedback loop from the dispatcher that scales the
+    # coalescing cap and max-wait against the measured `slo_burn_rate`,
+    # enforces priority-class admission, and runs the brownout phase
+    # machine.  "off" restores static knobs: the configured cap/wait
+    # apply unscaled and every request admits against the global queue
+    # bound only.
+    "serving_controller": "on",
+    # Seconds between controller feedback steps per model.  Shorter
+    # reacts faster but amplifies sampling noise in the burn gauge
+    # (which itself refreshes at ~1 Hz); longer smooths at the cost of
+    # SLO budget burned while waiting.
+    "serving_controller_interval_s": 1.0,
+    # AIMD high water: a 1m burn rate at or above this halves the
+    # model's effective coalescing cap and max-wait (smaller batches,
+    # earlier dispatch — the tail-latency actuators).  1.0 = act the
+    # moment the error budget burns faster than it accrues.
+    "serving_controller_burn_high": 1.0,
+    # AIMD low water: burn at or below this regrows the actuators
+    # additively (1/8 of full scale per step) back toward the
+    # configured values.  The gap between the waters is the hysteresis
+    # band where the controller HOLDS — set low == high to disable it.
+    "serving_controller_burn_low": 0.5,
+    # Batch-class queue/dispatch share: batch-priority requests admit
+    # into at most this fraction of `serving_max_queue`, and when both
+    # classes have a due head the dispatcher grants batch this much
+    # credit per interactive win (0.25 = one batch round per four
+    # contested rounds).  0 starves batch entirely under contention;
+    # values clamp to [0, 1].
+    "serving_batch_share": 0.25,
+    # Admission class for requests that name no priority AND whose
+    # model registered no default: "interactive" (latency-sensitive,
+    # full queue) or "batch" (background scoring, bounded share, shed
+    # first under brownout).
+    "serving_priority_default": "interactive",
+    # Brownout trigger: a 1m burn rate at or above this, sustained for
+    # `serving_brownout_sustain_s`, escalates the model one brownout
+    # phase (normal -> shed_batch -> shed_interactive).  Set above the
+    # AIMD high water — brownout is what happens when shrinking batches
+    # was not enough.
+    "serving_brownout_burn": 2.0,
+    # Seconds the burn must hold at/above `serving_brownout_burn`
+    # before each brownout escalation (re-armed per phase, so a flap
+    # cannot ratchet straight to shed_interactive).
+    "serving_brownout_sustain_s": 5.0,
+    # Seconds the burn must hold at/below the AIMD low water before
+    # each brownout de-escalation re-admits the shed class.
+    "serving_brownout_recover_s": 5.0,
+    # Shape-bucketed serving padding classes (serving/control.py): on,
+    # coalesced micro-batches pad to the {1, 1.5} x 2^k row-bucket grid
+    # (parallel/mesh.py bucket_rows) REGARDLESS of the global
+    # `shape_bucketing` conf, so churning request sizes reuse one
+    # compiled transform program per bucket instead of recompiling per
+    # distinct row count.  Off stages exact shapes (the pre-controller
+    # behavior).
+    "serving_padding_buckets": True,
     # Failure flight recorder (telemetry/flight_recorder.py): "on" keeps
     # an always-on bounded ring of recent trace events, rate-limited
     # metric deltas and heartbeats (O(1) memory), and the typed failure

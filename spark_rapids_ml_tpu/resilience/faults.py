@@ -53,6 +53,12 @@ KNOWN_SITES = frozenset({
     # every resident model on the shrunken mesh — no queued request is
     # lost either way
     "serving_dispatch",
+    # the serving admission gate (serving/server.py submit): fires
+    # BEFORE the request touches a queue, so injection drills can drive
+    # the admission/shed/brownout paths deterministically — the fault
+    # propagates to the submitting caller, never into the dispatcher,
+    # and no half-admitted request leaks into the class deques
+    "serving_admission",
     # the chunk cache's spill-to-host compression step
     # (parallel/device_cache.py ChunkCache._spill_chunk_locked): fires
     # while an epoch iteration is inserting/evicting chunks mid-stream.

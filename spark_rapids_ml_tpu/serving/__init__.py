@@ -3,6 +3,15 @@
 # from device-resident models (ROADMAP item 1, "millions of users" means
 # serving, not just fits).  Four pieces:
 #
+#   control.py    the closed-loop control plane (ROADMAP item 2's
+#                 actuator half): per-model AIMD feedback scales the
+#                 coalescing cap and max-wait against the measured
+#                 `slo_burn_rate`, priority classes (`interactive` |
+#                 `batch`) gate admission and weight dispatch, sustained
+#                 burn walks a brownout phase machine (shed batch ->
+#                 tighten interactive -> recover), and shape-bucketed
+#                 padding classes keep compiled transform programs
+#                 reused across churning request sizes.
 #   registry.py   model residency: a registered model's weight arrays
 #                 replicate onto the serving mesh ONCE (budget-accounted
 #                 through parallel/device_cache.py's external-reservation
@@ -34,6 +43,7 @@
 #   client = ServingClient(server)
 #   projected = client.transform("pca", rows)
 #
+from .control import ServingController  # noqa: F401
 from .registry import ModelRegistry, PinnedModel  # noqa: F401
 from .server import (  # noqa: F401
     ServingClient,
@@ -45,6 +55,7 @@ __all__ = [
     "ModelRegistry",
     "PinnedModel",
     "ServingClient",
+    "ServingController",
     "ServingOverload",
     "ServingServer",
 ]

@@ -6,7 +6,11 @@
 #   POST /v1/models/<name>:transform   {"instances": [[f, ...], ...]}
 #       -> 200 {"model": name, "rows": n, "outputs": {col: [...]}}
 #       -> 404 unknown model, 400 malformed input, 429 ServingOverload
-#          (admission control — the caller sheds load or retries)
+#          (admission control — the caller sheds load or retries).
+#          An `X-Priority: interactive|batch` header picks the request's
+#          admission class (default: the model's registered class, then
+#          `serving_priority_default`); batch-class requests are bounded
+#          to a queue share and shed first under brownout
 #   GET  /v1/models                    registered + pinned model names
 #   GET  /v1/models/<name>             per-model detail: pin status and
 #                                      accounted bytes, p50/p99, SLO
@@ -138,9 +142,16 @@ def start_serving_http(server, port: int, host: str = "127.0.0.1"):
                 (self.headers.get("X-Request-Id") or "").strip()
                 or mint_run_id("req")
             )
+            # priority class crosses the boundary the same way: the
+            # header names one, else the model/conf defaults apply in
+            # submit (an unknown class 400s via its ValueError)
+            priority = (
+                (self.headers.get("X-Priority") or "").strip().lower()
+                or None
+            )
             try:
                 outs = server.submit(
-                    name, X, request_id=req_id
+                    name, X, request_id=req_id, priority=priority
                 ).result(timeout=REQUEST_TIMEOUT_S)
             except ServingOverload as e:
                 # the rejected requests are the ones an operator most

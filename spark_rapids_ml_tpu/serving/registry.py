@@ -114,6 +114,7 @@ class ModelRegistry:
         dtype: Any = np.float32,
         n_features: Optional[int] = None,
         transform: Any = None,
+        priority: Optional[str] = None,
     ) -> PinnedModel:
         """Register `model` under `name` and pin it.  Models with a
         device transform (`_transform_device`) pin device-resident;
@@ -122,8 +123,18 @@ class ModelRegistry:
         residency accounting does not.  `transform` overrides the
         host-path dispatch callable (`(X) -> {col: array}`; default
         `model._transform_array`) — the kNN hook, whose query surface is
-        `kneighbors`, not transform."""
+        `kneighbors`, not transform.  `priority` sets the model's
+        DEFAULT admission class (`interactive` | `batch`) for requests
+        that do not name one — a background scoring model registers as
+        `batch` once instead of tagging every request."""
         from ..core import _TpuModel
+        from .control import PRIORITY_CLASSES
+
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"unknown priority class {priority!r}; expected one of "
+                f"{'|'.join(PRIORITY_CLASSES)}"
+            )
 
         if not isinstance(model, _TpuModel):
             raise TypeError(
@@ -149,6 +160,7 @@ class ModelRegistry:
                 "dtype": np.dtype(dtype),
                 "n_features": n_features,
                 "transform": transform,
+                "priority": priority,
             }
         # drift monitor (monitor/): a model carrying a fit-time baseline
         # fingerprint registers it WITH the pin — serving traffic for

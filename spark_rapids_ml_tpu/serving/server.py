@@ -22,6 +22,15 @@
 # shrunken mesh, transients back off — queued requests survive all
 # three, bounded by the retry policy's attempt budget.
 #
+# Above the queue sits the closed-loop control plane (serving/
+# control.py, ROADMAP item 2's actuator half): requests carry a
+# priority class (`interactive` | `batch`) with per-class admission and
+# weighted dispatch, the dispatcher ticks a per-model AIMD controller
+# that scales the coalescing cap and max-wait against the measured
+# `slo_burn_rate`, and sustained burn walks a brownout phase machine
+# that sheds batch-class load first, then tightens interactive
+# admission, re-admitting on recovery.
+#
 from __future__ import annotations
 
 import collections
@@ -44,6 +53,7 @@ from ..tracing import (
     trace,
 )
 from ..utils import get_logger
+from .control import PRIORITY_CLASSES, ServingController, resolve_priority
 from .registry import ModelRegistry, PinnedModel
 
 logger = get_logger("spark_rapids_ml_tpu.serving")
@@ -141,13 +151,19 @@ class ServingOverload(RuntimeError):
 class _Request:
     __slots__ = (
         "model", "X", "rows", "t_enqueue", "future", "attempts", "req_id",
+        "priority",
     )
 
     def __init__(
-        self, model: str, X: np.ndarray, request_id: Optional[str] = None
+        self, model: str, X: np.ndarray, request_id: Optional[str] = None,
+        priority: str = "interactive",
     ) -> None:
         self.model = model
         self.X = X
+        # admission/dispatch class (resolved BEFORE construction):
+        # decides which per-class deque the request queues on, which
+        # admission bound applies, and whether a brownout sheds it
+        self.priority = priority
         self.rows = int(X.shape[0])
         self.t_enqueue = time.perf_counter()
         self.future: Future = Future()
@@ -202,8 +218,20 @@ class ServingServer:
     def __init__(self, registry: Optional[ModelRegistry] = None) -> None:
         self.registry = registry or ModelRegistry()
         self._cv = named_lock("serving_dispatch", kind="condition")
-        self._queues: Dict[str, Deque[_Request]] = {}
+        # two-level queues: model -> priority class -> deque.  The take
+        # drains interactive heads first; admission bounds each class
+        # separately (controller.admit), so _queued_cls tracks the
+        # per-class share of the global _queued count
+        self._queues: Dict[str, Dict[str, Deque[_Request]]] = {}
         self._queued = 0
+        self._queued_cls: Dict[str, int] = {
+            c: 0 for c in PRIORITY_CLASSES
+        }
+        # the feedback controller (serving/control.py): AIMD actuator
+        # scales, the brownout phase machine, and the weighted-credit
+        # class scheduler — ticked from the dispatcher loop
+        self._controller = ServingController()
+        self._ctl_last = 0.0
         self._running = False
         self._paused = False
         self._thread: Optional[threading.Thread] = None
@@ -226,6 +254,9 @@ class ServingServer:
         # a fresh server must not report a predecessor's history
         self._req_counts: Dict[str, int] = {}
         self._rej_counts: Dict[str, int] = {}
+        # per-instance brownout sheds by model -> class (the registry's
+        # serving_shed_total counter is process-global)
+        self._shed_counts: Dict[str, Dict[str, int]] = {}
         self._lock = named_lock("serving_report")  # report/latency state
         # request-scoped tracing + SLO sensing state:
         #   _lat_ts     per-model (monotonic_t, total_s) samples feeding
@@ -249,9 +280,11 @@ class ServingServer:
 
     def register(self, name: str, model: Any, dtype: Any = np.float32,
                  n_features: Optional[int] = None,
-                 transform: Any = None) -> None:
+                 transform: Any = None,
+                 priority: Optional[str] = None) -> None:
         self.registry.register(name, model, dtype=dtype,
-                               n_features=n_features, transform=transform)
+                               n_features=n_features, transform=transform,
+                               priority=priority)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -302,11 +335,16 @@ class ServingServer:
                 return
             self._running = False
             if not drain:
-                doomed = [r for q in self._queues.values() for r in q]
-                for name, q in self._queues.items():
-                    q.clear()
+                doomed = [
+                    r for by_cls in self._queues.values()
+                    for q in by_cls.values() for r in q
+                ]
+                for name, by_cls in self._queues.items():
+                    for q in by_cls.values():
+                        q.clear()
                     QUEUE_DEPTH.set(0, model=name)
                 self._queued = 0
+                self._queued_cls = {c: 0 for c in PRIORITY_CLASSES}
             else:
                 doomed = []
             self._cv.notify_all()
@@ -345,19 +383,36 @@ class ServingServer:
     # -- submission ----------------------------------------------------------
 
     def submit(
-        self, name: str, X: Any, request_id: Optional[str] = None
+        self, name: str, X: Any, request_id: Optional[str] = None,
+        priority: Optional[str] = None,
     ) -> Future:
         """Enqueue one transform request; returns a Future resolving to
         `{output_col: np.ndarray}` with one row per input row.  Raises
         `ServingOverload` at the admission gate (never enqueued) and
-        KeyError/ValueError for unknown models / wrong feature width.
+        KeyError/ValueError for unknown models / wrong feature width /
+        unknown priority classes.
+
+        `priority` (`interactive` | `batch`) picks the admission class;
+        unset it falls back to the model's registered default, then the
+        `serving_priority_default` conf.  Batch-class admission is
+        bounded to a `serving_batch_share` slice of the queue and is the
+        first load a brownout sheds — background scoring can never
+        starve the interactive path.
 
         Every admitted request gets a REQUEST ID (minted here, or
         `request_id` when the caller/HTTP ingress supplies one):
         exposed as `.request_id` on the returned Future, carried through
         the batch's dispatch spans, and attached to the latency
         observations as an exemplar."""
+        from ..resilience import maybe_inject
+
         info = self.registry.info(name)  # KeyError for unknown models
+        cls = resolve_priority(priority, info.get("priority"))
+        # deterministic fault hook for the admission path itself
+        # (docs/resilience.md `serving_admission`): raises BEFORE the
+        # request touches a queue, so injection drills never leak a
+        # half-admitted request
+        maybe_inject("serving_admission")
         X = np.asarray(X)
         if X.ndim == 1:
             X = X[None, :]
@@ -376,30 +431,45 @@ class ServingServer:
             raise ValueError(
                 f"model {name!r} expects {want} features, got {X.shape[1]}"
             )
-        req = _Request(name, X, request_id=request_id)
+        req = _Request(name, X, request_id=request_id, priority=cls)
         req.future.request_id = req.req_id
         overload_detail = ""
         with self._cv:
             if not self._running:
                 REJECTIONS.inc(model=name, reason="stopped")
                 raise ServingOverload(name, "stopped", "server not running")
-            admitted = self._queued < self._max_queue()
+            admitted, reason, detail = self._controller.admit(
+                name, cls, self._queued, self._queued_cls[cls],
+                self._max_queue(),
+            )
             if not admitted:
-                REJECTIONS.inc(model=name, reason="queue_full")
+                REJECTIONS.inc(model=name, reason=reason)
                 with self._lock:
-                    self._rej_counts[name] = (
-                        self._rej_counts.get(name, 0) + 1
-                    )
-                overload_detail = self._note_overload_locked(name)
-                queued = self._queued
+                    if reason == "shed":
+                        by_cls = self._shed_counts.setdefault(name, {})
+                        by_cls[cls] = by_cls.get(cls, 0) + 1
+                    else:
+                        self._rej_counts[name] = (
+                            self._rej_counts.get(name, 0) + 1
+                        )
+                if reason == "queue_full":
+                    overload_detail = self._note_overload_locked(name)
             else:
-                q = self._queues.setdefault(name, collections.deque())
-                q.append(req)
+                by_cls = self._queues.setdefault(
+                    name, {c: collections.deque() for c in PRIORITY_CLASSES}
+                )
+                by_cls[cls].append(req)
                 self._queued += 1
-                QUEUE_DEPTH.set(len(q), model=name)
+                self._queued_cls[cls] += 1
+                QUEUE_DEPTH.set(self._depth_locked(name), model=name)
                 self._cv.notify_all()
         if not admitted:
-            if overload_detail:
+            if reason == "shed":
+                # brownout policy rejection: counted per class (the
+                # controller's shed counter), never the overload dump —
+                # shedding IS the controller working, not a failure
+                self._controller.note_shed(name, cls)
+            elif overload_detail:
                 # the dump runs OUTSIDE the cv (it writes files); the
                 # recorder's per-reason cooldown absorbs the rest of the
                 # storm racing here
@@ -408,11 +478,7 @@ class ServingServer:
                 note_failure(
                     "serving_overload", detail=overload_detail, log=logger
                 )
-            raise ServingOverload(
-                name, "queue_full",
-                f"{queued} requests queued "
-                f"(serving_max_queue={self._max_queue()})",
-            )
+            raise ServingOverload(name, reason, detail)
         REQUESTS.inc(model=name)
         with self._lock:
             self._req_counts[name] = self._req_counts.get(name, 0) + 1
@@ -441,10 +507,11 @@ class ServingServer:
 
     def transform(self, name: str, X: Any,
                   timeout: Optional[float] = None,
-                  request_id: Optional[str] = None) -> Dict[str, np.ndarray]:
+                  request_id: Optional[str] = None,
+                  priority: Optional[str] = None) -> Dict[str, np.ndarray]:
         """Blocking convenience over `submit`."""
         return self.submit(
-            name, X, request_id=request_id
+            name, X, request_id=request_id, priority=priority
         ).result(timeout=timeout)
 
     # -- report --------------------------------------------------------------
@@ -456,6 +523,7 @@ class ServingServer:
             lat = list(self._lat.get(name, ()))
             requests = self._req_counts.get(name, 0)
             rejections = self._rej_counts.get(name, 0)
+            shed = dict(self._shed_counts.get(name, ()))
         entry: Dict[str, Any] = {
             # per-instance counts: the prometheus families are
             # process-global, a fresh server must not report a
@@ -494,6 +562,18 @@ class ServingServer:
         drift = MONITOR.summary(name)
         if drift is not None:
             entry["drift"] = drift
+        # the control plane's actuator state for THIS model: the
+        # effective (scaled) cap and max-wait the dispatcher uses right
+        # now, the brownout phase, per-class shed counts, and the
+        # padding classes compiled programs are reused across
+        st = self._controller.model_state(name)
+        entry["controller"] = {
+            "cap": self._batch_cap(name, self._safe_info(name)),
+            "max_wait_ms": round(self._max_wait_s(name) * 1e3, 3),
+            "brownout_phase": st["brownout_phase"],
+            "shed": shed,
+            "padding_classes": st["padding_classes"],
+        }
         return entry
 
     def report(self) -> Dict[str, Any]:
@@ -506,11 +586,29 @@ class ServingServer:
             out[name] = self._model_entry(name, pinned_names)
         with self._lock:
             n_slow = len(self._slow)
+            shed_total = {
+                cls: sum(
+                    by_cls.get(cls, 0)
+                    for by_cls in self._shed_counts.values()
+                )
+                for cls in PRIORITY_CLASSES
+            }
+        ctl = self._controller
+        share = ctl.batch_share()
         out["_totals"] = {
             "batches": self._batches,
             "queued": self._queued,
             "pinned_bytes": self.registry.pinned_bytes(),
             "slow_traces": n_slow,
+            "controller": {
+                "enabled": ctl.enabled(),
+                # contested dispatch rounds split credit-weighted:
+                # interactive always holds a full share, batch accrues
+                # `serving_batch_share` credit per interactive win
+                "priority_shares": {"interactive": 1.0, "batch": share},
+                "shed": shed_total,
+                "brownout": ctl.brownout_summary(),
+            },
         }
         # the serving utilization view (telemetry/utilization.py): how
         # busy the device was over the recent window and what the idle
@@ -540,8 +638,19 @@ class ServingServer:
     def _max_queue(self) -> int:
         return max(1, int(get_config("serving_max_queue")))
 
-    def _max_wait_s(self) -> float:
-        return max(0.0, float(get_config("serving_max_wait_ms"))) / 1e3
+    def _max_wait_s(self, name: Optional[str] = None) -> float:
+        """Coalescing max-wait in seconds; with `name`, scaled by the
+        controller's AIMD wait actuator (burn shrinks it so batches
+        dispatch earlier and smaller)."""
+        wait = max(0.0, float(get_config("serving_max_wait_ms"))) / 1e3
+        if name is not None:
+            wait *= self._controller.wait_scale(name)
+        return wait
+
+    def _depth_locked(self, name: str) -> int:
+        """Queued requests for `name` across both priority classes
+        (called under the cv; feeds `serving_queue_depth`)."""
+        return sum(len(q) for q in self._queues.get(name, {}).values())
 
     def _safe_info(self, name: str) -> Optional[Dict[str, Any]]:
         """Registration facts, or None for a model unregistered while
@@ -553,11 +662,13 @@ class ServingServer:
         except KeyError:
             return None
 
-    def _batch_cap(self, info: Optional[Dict[str, Any]]) -> int:
-        """Rows one coalesced dispatch may carry: the configured cap,
-        bounded by the byte model every staged transfer is sized by
-        (`host_batch_bytes` / row bytes), then by the OOM-degraded
-        shrink cap."""
+    def _base_cap(self, info: Optional[Dict[str, Any]]) -> int:
+        """Rows one coalesced dispatch may carry BEFORE SLO control:
+        the configured cap, bounded by the byte model every staged
+        transfer is sized by (`host_batch_bytes` / row bytes), then by
+        the OOM-degraded shrink cap.  The OOM shrink stays here — it is
+        the emergency memory actuator the AIMD scale layers on top of,
+        never replaces."""
         from ..streaming import chunk_rows_for
 
         cap = max(1, int(get_config("serving_max_batch_rows")))
@@ -571,6 +682,17 @@ class ServingServer:
             cap = min(cap, self._shrunk_cap)
         return max(1, cap)
 
+    def _batch_cap(
+        self, name: str, info: Optional[Dict[str, Any]]
+    ) -> int:
+        """The effective coalescing cap: the base cap scaled by the
+        controller's AIMD cap actuator for this model."""
+        cap = self._base_cap(info)
+        scale = self._controller.cap_scale(name)
+        if scale < 1.0:
+            cap = max(1, int(cap * scale))
+        return cap
+
     def _oom_floor(self) -> int:
         """Smallest useful coalescing cap: one row per active device
         (the same floor the transform chunk loop shrinks to)."""
@@ -581,71 +703,118 @@ class ServingServer:
     # -- dispatcher ----------------------------------------------------------
 
     def _ready_name_locked(self, now: float, draining: bool) -> Optional[str]:
-        """The queued model whose head request is due: past the max-wait
-        SLO, a full batch already queued, or the server draining.  Oldest
-        head wins, so no model starves behind a hot one."""
-        wait = self._max_wait_s()
-        best = None
-        best_t = None
-        for name, q in self._queues.items():
-            if not q:
+        """The queued model whose head request is due: past the (AIMD-
+        scaled, per-model) max-wait SLO, a full batch already queued, or
+        the server draining.  Per priority class the oldest due head
+        wins, so no model starves behind a hot one; when BOTH classes
+        hold a due head the controller's weighted credit picks the
+        class — batch gets `serving_batch_share` credit per interactive
+        win, so neither class starves the other."""
+        due: Dict[str, tuple] = {}  # class -> (t_enqueue, name)
+        for name, by_cls in self._queues.items():
+            if not any(by_cls.values()):
                 continue
-            head = q[0]
             info = self._safe_info(name)
-            cap = self._batch_cap(info)
+            cap = self._batch_cap(name, info)
+            wait = self._max_wait_s(name)
             rows = 0
-            for r in q:
-                rows += r.rows
-                if rows >= cap:
+            full = False
+            for cls in PRIORITY_CLASSES:
+                for r in by_cls[cls]:
+                    rows += r.rows
+                    if rows >= cap:
+                        full = True
+                        break
+                if full:
                     break
-            due = (
-                draining
-                or info is None  # unregistered: dispatch fails it NOW
-                or (now - head.t_enqueue) >= wait
-                or rows >= cap
-            )
-            if due and (best_t is None or head.t_enqueue < best_t):
-                best, best_t = name, head.t_enqueue
-        return best
+            for cls in PRIORITY_CLASSES:
+                q = by_cls[cls]
+                if not q:
+                    continue
+                head = q[0]
+                ready = (
+                    draining
+                    or info is None  # unregistered: dispatch fails it NOW
+                    or (now - head.t_enqueue) >= wait
+                    or full
+                )
+                if ready:
+                    best = due.get(cls)
+                    if best is None or head.t_enqueue < best[0]:
+                        due[cls] = (head.t_enqueue, name)
+        if not due:
+            return None
+        if len(due) == 1:
+            return next(iter(due.values()))[1]
+        if not self._controller.enabled():
+            return min(due.values())[1]  # plain oldest-head-first
+        return due[self._controller.pick_class()][1]
 
     def _take_batch_locked(self, name: str) -> List[_Request]:
-        q = self._queues[name]
-        cap = self._batch_cap(self._safe_info(name))
+        by_cls = self._queues[name]
+        cap = self._batch_cap(name, self._safe_info(name))
         reqs: List[_Request] = []
         rows = 0
-        while q and (not reqs or rows + q[0].rows <= cap):
-            r = q.popleft()
-            self._queued -= 1
-            if r.future.cancelled():
-                continue  # the caller gave up while it queued
-            reqs.append(r)
-            rows += r.rows
-        QUEUE_DEPTH.set(len(q), model=name)
+        # interactive heads coalesce first; batch-class rows fill the
+        # remaining cap, so a shared dispatch never displaces the
+        # latency-sensitive work that triggered it
+        for cls in PRIORITY_CLASSES:
+            q = by_cls[cls]
+            while q and (not reqs or rows + q[0].rows <= cap):
+                r = q.popleft()
+                self._queued -= 1
+                self._queued_cls[cls] -= 1
+                if r.future.cancelled():
+                    continue  # the caller gave up while it queued
+                reqs.append(r)
+                rows += r.rows
+        QUEUE_DEPTH.set(self._depth_locked(name), model=name)
         return reqs
 
     def _requeue_front(self, reqs: List[_Request]) -> None:
         with self._cv:
             for r in reversed(reqs):
-                self._queues.setdefault(
-                    r.model, collections.deque()
-                ).appendleft(r)
+                by_cls = self._queues.setdefault(
+                    r.model,
+                    {c: collections.deque() for c in PRIORITY_CLASSES},
+                )
+                by_cls[r.priority].appendleft(r)
                 self._queued += 1
+                self._queued_cls[r.priority] += 1
             for name in {r.model for r in reqs}:
-                QUEUE_DEPTH.set(len(self._queues[name]), model=name)
+                QUEUE_DEPTH.set(self._depth_locked(name), model=name)
             self._cv.notify_all()
 
     def _next_deadline_locked(self, now: float) -> float:
         if self._paused and self._running:
             return 0.5  # resume() notifies; no deadline to honor
-        wait = self._max_wait_s()
         deadline = None
-        for q in self._queues.values():
-            if q:
-                due = q[0].t_enqueue + wait
-                deadline = due if deadline is None else min(deadline, due)
+        for name, by_cls in self._queues.items():
+            wait = self._max_wait_s(name)
+            for q in by_cls.values():
+                if q:
+                    due = q[0].t_enqueue + wait
+                    deadline = (
+                        due if deadline is None else min(deadline, due)
+                    )
         if deadline is None:
             return 0.5
         return max(1e-4, min(deadline - now, 0.5))
+
+    def _lag_locked(self, name: str, now: float) -> float:
+        """How far past its intended dispatch deadline the loop is for
+        `name`'s oldest head — published on EVERY dispatch round, so the
+        gauge stays live under a saturated queue instead of freezing at
+        the last idle wake's overshoot."""
+        heads = [
+            q[0].t_enqueue
+            for q in self._queues.get(name, {}).values() if q
+        ]
+        if not heads:
+            return 0.0
+        return round(
+            max(0.0, now - (min(heads) + self._max_wait_s(name))), 6
+        )
 
     def _loop(self) -> None:
         pending: Optional[_InFlight] = None
@@ -660,6 +829,12 @@ class ServingServer:
                         else self._ready_name_locked(now, draining)
                     )
                     if name is not None:
+                        # loop-lag publishes on EVERY dispatch round
+                        # (not only the timed-out idle wake below): a
+                        # saturated dispatcher never idles, and a gauge
+                        # frozen at the last idle overshoot would hide
+                        # exactly the lag the controller acts on
+                        DISPATCH_LAG.set(self._lag_locked(name, now))
                         # `or None`: a queue of nothing-but-cancelled
                         # requests yields an empty take — loop back
                         batch = self._take_batch_locked(name) or None
@@ -696,6 +871,7 @@ class ServingServer:
                         self._loop_done = True
                         return
                 self._refresh_slo_all()
+                self._controller_tick()
                 continue
             # phase-separated failure attribution: a dispatch error
             # belongs to THIS batch only — the pending batch of a
@@ -723,6 +899,11 @@ class ServingServer:
                         recover.extend(current.reqs)
                     pending = None
                 self._recover_guarded(e, recover)
+            # feedback step AFTER the round's dispatch/collect: the
+            # busy path must tick too — an overloaded dispatcher never
+            # reaches the idle branch, and that is exactly when control
+            # matters (rate-limited inside, so the hot loop pays ~0)
+            self._controller_tick()
 
     # -- dispatch / collect --------------------------------------------------
 
@@ -799,8 +980,18 @@ class ServingServer:
                 # census, dataset_stagings bump, byte prediction) is fit-
                 # scale bookkeeping a request-rate micro-batch must not pay
                 with trace("serving_stage", logger):
+                    # padding classes: force the {1,1.5}x2^k bucket grid
+                    # (regardless of the global shape_bucketing conf) so
+                    # churning coalesced sizes reuse ONE compiled
+                    # transform program per bucket — the jit-audit
+                    # zero-recompile guarantee extended to serving
+                    bucketing = None
+                    if self._controller.padding_enabled():
+                        self._controller.note_bucket(name, rows)
+                        bucketing = True
                     st = RowStager.for_replicated(
-                        rows, pinned.mesh, telemetry=False
+                        rows, pinned.mesh, bucketing=bucketing,
+                        telemetry=False,
                     )
                     Xs = st.stage(np.ascontiguousarray(X), pinned.dtype)
                 with trace("serving_compute", logger):
@@ -1043,6 +1234,47 @@ class ServingServer:
         except Exception:  # gauge upkeep must never wedge the loop
             pass
 
+    def _controller_tick(self) -> None:
+        """One feedback pass from the dispatcher loop: per served model
+        feed the 1m burn gauge and the live p99 into the controller's
+        AIMD/brownout step.  Server-side rate limit keeps the hot loop
+        from even walking the model list every round; the per-model
+        interval inside `tick` does the real pacing.  Control must
+        never wedge the dispatcher — any failure is logged and the loop
+        moves on."""
+        ctl = self._controller
+        if not ctl.enabled():
+            return
+        now = time.monotonic()
+        if now - self._ctl_last < min(0.25, ctl.interval_s()):
+            return
+        self._ctl_last = now
+        try:
+            base_wait_ms = max(
+                0.0, float(get_config("serving_max_wait_ms"))
+            )
+            for name in self.registry.names():
+                with self._lock:
+                    lat = list(self._lat.get(name, ()))
+                if not lat:
+                    continue  # never served: nothing to control yet
+                srt = sorted(lat)
+                p99_ms = round(
+                    srt[min(len(srt) - 1, int(round(0.99 * (len(srt) - 1))))]
+                    * 1e3,
+                    3,
+                )
+                burn = SLO_BURN.value(
+                    default=None, model=name, window="1m"
+                )
+                ctl.tick(
+                    name, burn, p99_ms,
+                    self._base_cap(self._safe_info(name)),
+                    base_wait_ms, now=now,
+                )
+        except Exception as e:
+            logger.warning(f"serving controller tick failed ({e})")
+
     # -- degradation ---------------------------------------------------------
 
     def _recover_guarded(self, e: Exception, reqs: List[_Request]) -> None:
@@ -1193,20 +1425,27 @@ class ServingClient:
         self._server = server
 
     def submit(self, model: str, X: Any,
-               request_id: Optional[str] = None) -> Future:
+               request_id: Optional[str] = None,
+               priority: Optional[str] = None) -> Future:
         """Enqueue; the returned Future carries `.request_id` (minted
         here unless the caller supplies one) — the id the latency
-        exemplars and dispatch spans carry."""
-        return self._server.submit(model, X, request_id=request_id)
+        exemplars and dispatch spans carry.  `priority` picks the
+        admission class (`interactive` | `batch`; default: the model's
+        registered class, then `serving_priority_default`)."""
+        return self._server.submit(
+            model, X, request_id=request_id, priority=priority
+        )
 
     def transform(self, model: str, X: Any,
                   timeout: Optional[float] = None,
-                  request_id: Optional[str] = None) -> Any:
+                  request_id: Optional[str] = None,
+                  priority: Optional[str] = None) -> Any:
         """Transform rows; a single-output model returns the bare array
         (matching `Model.transform`'s array-input contract), multi-output
         models return `{col: array}`."""
         outs = self._server.transform(
-            model, X, timeout=timeout, request_id=request_id
+            model, X, timeout=timeout, request_id=request_id,
+            priority=priority,
         )
         if len(outs) == 1:
             return next(iter(outs.values()))

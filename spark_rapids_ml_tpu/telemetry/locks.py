@@ -78,6 +78,12 @@ LOCK_CATALOG: Dict[str, Dict[str, Any]] = {
     "serving_registry": {
         "kind": "rlock", "module": "spark_rapids_ml_tpu/serving/registry.py",
     },
+    # the feedback controller's actuator/phase state; leaf lock — the
+    # dispatcher condition may be held when entering it, never the
+    # reverse
+    "serving_control": {
+        "kind": "lock", "module": "spark_rapids_ml_tpu/serving/control.py",
+    },
     # stats/: the shared one-pass statistics locks — `device_step` is
     # the serializer the PR-14 deadlock taught us to hold across
     # dispatch-to-sync of every mesh-sharded accumulator step

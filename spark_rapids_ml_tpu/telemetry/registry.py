@@ -130,7 +130,7 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     # failure flight recorder (telemetry/flight_recorder.py): one bump
     # per post-mortem bundle written, labeled by the typed failure path
     # that triggered the dump (retry_exhausted / dispatch_timeout /
-    # device_lost / serving_overload / drift / manual)
+    # device_lost / serving_overload / brownout / drift / manual)
     "postmortems_total": {
         "kind": "counter", "labels": ("reason",), "cardinality": 16,
     },
@@ -233,6 +233,28 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     },
     "serving_dispatcher_lag_seconds": {
         "kind": "gauge", "labels": (), "cardinality": 1,
+    },
+    # serving control plane (serving/control.py, ROADMAP item 2's
+    # actuator half): the AIMD controller's live actuator values per
+    # model (the EFFECTIVE coalescing cap / max-wait after scaling),
+    # its adjustment counter by direction (increase | decrease), the
+    # brownout phase index (0 normal, 1 shed_batch, 2 shed_interactive),
+    # and brownout sheds by priority class (interactive | batch)
+    "serving_controller_cap": {
+        "kind": "gauge", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_controller_max_wait_ms": {
+        "kind": "gauge", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_controller_adjustments_total": {
+        "kind": "counter", "labels": ("model", "direction"),
+        "cardinality": 64,
+    },
+    "serving_controller_brownout_phase": {
+        "kind": "gauge", "labels": ("model",), "cardinality": 32,
+    },
+    "serving_shed_total": {
+        "kind": "counter", "labels": ("model", "class"), "cardinality": 64,
     },
 }
 

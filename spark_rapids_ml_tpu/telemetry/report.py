@@ -396,6 +396,24 @@ class FitTelemetry:
                 })
         except Exception:
             pass
+        # serving padding-class decision (serving/control.py): which
+        # {1,1.5}x2^k bucket the last coalesced micro-batch padded to —
+        # same last-run-state discipline, prefixed so the serving keys
+        # never collide with the solver/reader keys above
+        try:
+            from ..serving.control import LAST_BUCKET_DECISION
+
+            if (
+                not self._overlapped
+                and LAST_BUCKET_DECISION.get("stamp", 0) >= self._t0
+            ):
+                solver_decision.update({
+                    f"serving_{k}": LAST_BUCKET_DECISION[k]
+                    for k in ("model", "rows", "bucket")
+                    if LAST_BUCKET_DECISION.get(k) is not None
+                })
+        except Exception:
+            pass
 
         report: Dict[str, Any] = {
             "run_id": self.run_id,
