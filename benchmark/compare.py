@@ -84,6 +84,10 @@ _HIGHER_BETTER = (
     # would gate the absolute overlap seconds backwards
     "_overlap_fraction",
     "_overlap_sec",
+    # multi-host data path (bench.py `multiproc` section): aggregate
+    # 2-process over 1-process ingest throughput — the pod-scaling
+    # headline; a drop means the row-group sharding stopped paying
+    "_scaling_x",
 )
 _HIGHER_CONTAINS = ("_recall_at_",)
 

@@ -59,6 +59,10 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     # `_sec`/`_per_sec`/`_speedup_x`/`_overlap_fraction` suffixes pick
     # up the standard compare.py direction rules
     ("summarize_", "summarize"),
+    # multi-host data path (bench.py `multiproc` section): 1p vs 2p
+    # sharded-ingest throughput, the `_scaling_x` ratio (higher-better
+    # in compare.py), and the priced pass_complete wire reduction
+    ("multiproc_", "multiproc"),
     ("ingest_", "streaming"),
     ("umap_", "umap"),
     # progress observatory (bench.py `utilization` section): named-lock

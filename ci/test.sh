@@ -138,7 +138,8 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_bench_history.py tests/test_analysis.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
-    tests/test_slow_scale.py tests/test_multiprocess.py "$@"
+    tests/test_slow_scale.py tests/test_multiprocess.py \
+    tests/test_multihost_datapath.py "$@"
 # guard against a new test file silently missing from the batches: only
 # run_batch lines count as "listed" (not the --fast tier or comments),
 # and discovery recurses like `pytest tests/` did
@@ -926,6 +927,124 @@ rm -rf "$UTIL_DIR"
 echo "== pod benchmark smoke (2-process jax.distributed) =="
 python benchmark/pod/launch.py --num_processes 2 --devices_per_process 2 \
     -- kmeans --num_rows 20000 --num_cols 16 --mode tpu --max_iter 10
+
+echo "== multi-host data path smoke: sharded ingest, wire reduce, dead rank =="
+# three contracts in one 2-process run (wire reduce backend, so it holds
+# on CPU builds with no cross-process XLA collectives): (1) parallel
+# ingest covers every row exactly once with every rank decoding >0 rows,
+# (2) the 2-process fused linreg fit is BYTE-identical to 1-process, and
+# (3) a rank whose telemetry endpoint died is named in
+# `ScrapeResult.absent` by the aggregator — never zero-filled.
+MH_DIR=$(mktemp -d)
+python - "$MH_DIR" << 'EOF'
+import json, os, socket, subprocess, sys, textwrap
+import numpy as np
+import pandas as pd
+
+outdir = sys.argv[1]
+rng = np.random.default_rng(7)
+X = rng.integers(0, 16, size=(400, 5)).astype(np.float64)
+y = X @ np.array([2.0, -1.0, 0.0, 1.0, 3.0])
+ppath = os.path.join(outdir, "smoke.parquet")
+pd.DataFrame({"features": list(X), "label": y}).to_parquet(
+    ppath, row_group_size=50
+)
+
+WORKER = textwrap.dedent('''
+    import json, os, sys
+    pid, nproc, port, outdir, ppath = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4],
+        sys.argv[5],
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={4 // nproc}"
+    )
+    import numpy as np
+    from spark_rapids_ml_tpu import init_distributed
+    from spark_rapids_ml_tpu.config import set_config
+    set_config(multiproc_reduce="wire", fused_parquet_readers=1)
+    if nproc > 1:
+        set_config(coordinator_address=f"127.0.0.1:{port}",
+                   num_processes=nproc, process_id=pid)
+        assert init_distributed()
+    from spark_rapids_ml_tpu.fused import (
+        fused_linreg_stats, iter_parquet_chunks,
+    )
+    rows = 0
+    for cX, cy, cw in iter_parquet_chunks(
+        ppath, "features", (), None, None, 128, np.float64
+    ):
+        # padded tail chunks carry a validity/weight vector
+        rows += int(cX.shape[0]) if cw is None else int((cw > 0).sum())
+    if nproc > 1:
+        from spark_rapids_ml_tpu.parallel.context import allgather_bytes
+        counts = [
+            int.from_bytes(b, "little")
+            for b in allgather_bytes("cov", rows.to_bytes(8, "little"))
+        ]
+        assert sum(counts) == 400, f"ingest coverage broken: {counts}"
+        assert all(c > 0 for c in counts), f"idle rank: {counts}"
+    else:
+        assert rows == 400, rows
+    def producer(n_dev):
+        prep = {"s": 0.0, "iv": []}
+        return (iter_parquet_chunks(
+            ppath, "features", (), "label", None, 128, np.float64,
+            prep=prep,
+        ), prep)
+    lin = fused_linreg_stats(producer, 5, np.float64)
+    if pid == 0:
+        out = {k: np.ascontiguousarray(
+            np.asarray(v, np.float64)).tobytes().hex()
+            for k, v in sorted(lin.items())}
+        with open(os.path.join(outdir, f"linreg_{nproc}.json"), "w") as f:
+            json.dump(out, f)
+        # only rank 0 publishes a metrics page: rank 1 plays the host
+        # that died after the fit, which the aggregator must REPORT,
+        # not zero-fill
+        from spark_rapids_ml_tpu.telemetry.exporters import dump_prometheus
+        with open(os.path.join(outdir, "rank0.prom"), "w") as f:
+            f.write(dump_prometheus())
+''')
+wpath = os.path.join(outdir, "worker.py")
+with open(wpath, "w") as f:
+    f.write(WORKER)
+
+def launch(nproc):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.getcwd()  # worker.py lives in the tmp dir
+    procs = [subprocess.Popen(
+        [sys.executable, wpath, str(i), str(nproc), str(port), outdir,
+         ppath], env=env, stderr=subprocess.PIPE, text=True)
+        for i in range(nproc)]
+    for p in procs:
+        _, err = p.communicate(timeout=600)
+        assert p.returncode == 0, err[-4000:]
+
+launch(1)
+single = json.load(open(os.path.join(outdir, "linreg_1.json")))
+launch(2)
+multi = json.load(open(os.path.join(outdir, "linreg_2.json")))
+assert multi == single, "2-process fused linreg diverged from 1-process"
+
+from spark_rapids_ml_tpu.telemetry.aggregate import scrape_endpoints
+res = scrape_endpoints({
+    "rank0": "file://" + os.path.join(outdir, "rank0.prom"),
+    "rank1": "file://" + os.path.join(outdir, "rank1.prom"),  # never wrote
+})
+assert "rank1" in res.absent, res
+assert "rank0" in res.pages and "rank0" not in res.absent, res
+assert any("multiproc_reduce" in fam for fam in res.merged), (
+    "surviving rank's page lost the reduce-seam metrics")
+print("multi-host smoke OK: 400/400 rows covered, fused linreg "
+      f"byte-identical across 1p/2p, dead rank named: {res!r}")
+EOF
+rm -rf "$MH_DIR"
 
 echo "== notebooks: execute on the CPU mesh =="
 for nb in notebooks/*.ipynb; do

@@ -61,6 +61,25 @@ _DEFAULTS: Dict[str, Any] = {
     "coordinator_address": None,
     "process_id": None,
     "num_processes": None,
+    # Cross-process reduction backend for the multi-host data path
+    # (parallel/context.py reduce_host_arrays): "psum" folds per-process
+    # accumulators with one jitted psum over the pod mesh; "wire"
+    # allgathers the versioned wire-format payloads through the
+    # jax.distributed coordination-service KV store and folds on host in
+    # rank order (deterministic); "auto" probes once per process and
+    # picks psum where the backend supports cross-process collectives,
+    # wire otherwise (CPU builds).
+    "multiproc_reduce": "auto",
+    # Seconds each rank waits for its peers' payloads at a cross-process
+    # reduction barrier before failing the pass (a dead rank must
+    # surface as a timeout, not a hang).
+    "multiproc_reduce_timeout_s": 120.0,
+    # Verify a content fingerprint (shapes/dtypes/keys of the reduced
+    # payload) agrees across ranks before merging; divergence raises
+    # RankDivergenceError instead of silently mis-merging statistics
+    # computed from different inputs.  Costs one extra small allgather
+    # per reduction.
+    "multiproc_agreement_check": True,
     # Spark-DataFrame exchange: datasets estimated above this many bytes
     # are written by the EXECUTORS to `spark_exchange_dir` as parquet and
     # fit through the streaming-ingest path instead of `toPandas()`
@@ -101,6 +120,13 @@ _DEFAULTS: Dict[str, Any] = {
     # chunk_codec.register_codec.  Every spilled blob is crc32-
     # checksummed regardless of codec.
     "chunk_cache_codec": "none",
+    # When set, spilled chunk blobs are written to files under this
+    # directory instead of held in host memory (the host-bytes ledger
+    # then counts only resident tiers).  Filenames embed the process
+    # index and the content-stamped stream key, so multiple ranks
+    # replaying the same parquet path through a SHARED directory cannot
+    # collide.  Empty -> in-memory spill blobs (the default).
+    "chunk_cache_spill_dir": "",
     # DuHL-style importance sampling of cached chunks for the
     # epoch-streaming solvers (streaming.py logreg/kmeans): "duhl" lets
     # an epoch revisit only the chunks whose contribution to the

@@ -721,9 +721,14 @@ class _TpuEstimator(Estimator, _TpuCaller):
         """Route an eligible fit through the fused stage-and-solve path
         (conf `fused_stage_solve`): sufficient statistics accumulate on
         the mesh as each chunk lands instead of staging everything and
-        then solving.  Returns model attrs, or None to keep the
-        two-phase path — sparse batches, multi-process, conf off/below
-        the auto threshold, and estimators without the capability all
+        then solving.  Multi-process pods fuse too: each rank decodes
+        only its row-group share (fused.process_row_group_shares), folds
+        on its local devices, and the partials meet in one cross-process
+        reduction at pass completion — the path degrades only when the
+        reduce seam has no transport (parallel/context.py
+        `cross_process_reduce_ready`).  Returns model attrs, or None to
+        keep the two-phase path — sparse batches, conf off/below the
+        auto threshold, and estimators without the capability all
         degrade.  `source` is a host `_ArrayBatch` or a parquet path.
 
         The dispatch runs under the retry policy with the accumulators

@@ -72,19 +72,24 @@ def fused_mode() -> str:
 
 
 def fused_enabled(est_bytes: float) -> bool:
-    """Whether the conf routes an ELIGIBLE fit (dense, single-process,
-    statistics-capable — the caller checks those) through the fused
-    engine: "on" always, "auto" once the staged-bytes estimate clears
-    `_AUTO_MIN_BYTES`, "off" never."""
+    """Whether the conf routes an ELIGIBLE fit (dense, statistics-capable
+    — the caller checks those) through the fused engine: "on" always,
+    "auto" once the staged-bytes estimate clears `_AUTO_MIN_BYTES`,
+    "off" never.  Multi-process fits run fused too: each rank folds its
+    ingest share on its LOCAL devices and the partials meet in one
+    reduction at pass_complete (parallel/context.py) — the gate only
+    drops to the two-phase paths when no reduce seam is available
+    (jax.distributed not initialized)."""
     mode = fused_mode()
     if mode == "off":
         return False
     import jax
 
     if jax.process_count() > 1:
-        # per-process chunk puts cannot assemble a global mesh array;
-        # multi-process keeps the two-phase / streamed-stats paths
-        return False
+        from .parallel.context import cross_process_reduce_ready
+
+        if not cross_process_reduce_ready():
+            return False
     if mode == "on":
         return True
     return float(est_bytes) >= _AUTO_MIN_BYTES
@@ -292,6 +297,48 @@ def _partition_row_groups(path: str, readers: int) -> Optional[list]:
     return shares if len(shares) > 1 else None
 
 
+def process_row_group_shares(path: str, n_proc: int) -> Optional[list]:
+    """Partition a parquet FILE's row groups into exactly `n_proc`
+    contiguous row-balanced shares — the per-PROCESS ingest split of the
+    fused producer (each host decodes only its share; the commutative
+    accumulators make arrival order irrelevant).  Deterministic: pure
+    arithmetic over the file metadata, identical on every rank.
+    Coverage-asserted: the shares concatenate to every row group exactly
+    once.  None when the path is a dataset directory or has fewer groups
+    than processes — the caller then falls back to the chunk-index
+    modulo split."""
+    import os
+
+    if n_proc <= 1 or os.path.isdir(path):
+        return None
+    import pyarrow.parquet as pq
+
+    md = pq.ParquetFile(path).metadata
+    sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+    if len(sizes) < n_proc:
+        return None
+    total = sum(sizes)
+    per = -(-total // n_proc)
+    shares, cur, acc = [], [], 0
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        if acc >= per and len(shares) < n_proc - 1:
+            shares.append(cur)
+            cur, acc = [], 0
+    if cur:
+        shares.append(cur)
+    while len(shares) < n_proc:
+        shares.append([])
+    flat = [g for sh in shares for g in sh]
+    if flat != list(range(len(sizes))):  # pragma: no cover - invariant
+        raise AssertionError(
+            f"process row-group shares do not cover {path} exactly once: "
+            f"{shares}"
+        )
+    return shares
+
+
 def _reader_batches(path: str, columns, chunk_rows: int, groups=None):
     """Arrow record batches for the fused producer: a row-group-pruned
     `ParquetFile` reader for single files (measurably leaner than the
@@ -431,11 +478,47 @@ def iter_parquet_chunks(
 
         return timed_iter(it, prep)
 
-    def _source():
-        return _parquet_reader_pool(
-            path, features_col, features_cols, label_col, weight_col,
-            chunk_rows, dtype, ldt, readers, _timed,
-        )
+    import jax
+
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        # multi-host ingest partition: this process decodes ONLY its
+        # deterministic row-group share (coverage-asserted); the
+        # commutative accumulators make the resulting arbitrary global
+        # chunk order irrelevant, and the per-rank chunk-stream key
+        # keeps each host's cache holding only its own slice
+        pid = jax.process_index()
+        shares = process_row_group_shares(path, n_proc)
+
+        def _source():
+            if shares is not None:
+                if not shares[pid]:
+                    return iter(())
+                return _timed(_range_chunks(
+                    path, features_col, features_cols, label_col,
+                    weight_col, chunk_rows, dtype, ldt, shares[pid],
+                ))
+
+            # no row groups to split (directory dataset / single
+            # group): every rank decodes the scan but FOLDS only
+            # chunks congruent to its rank — disjoint exact cover,
+            # no decode scaling
+            def _mod_filter():
+                for i, item in enumerate(_range_chunks(
+                    path, features_col, features_cols, label_col,
+                    weight_col, chunk_rows, dtype, ldt, None,
+                )):
+                    if i % n_proc == pid:
+                        yield item
+
+            return _timed(_mod_filter())
+
+    else:
+        def _source():
+            return _parquet_reader_pool(
+                path, features_col, features_cols, label_col, weight_col,
+                chunk_rows, dtype, ldt, readers, _timed,
+            )
 
     # NOTE: checked before iterating (benign race: a stream completed by
     # a concurrent fit in this window serves untimed; a mid-serve source
@@ -570,6 +653,17 @@ def accumulate_chunks(
     from .telemetry.compile import compile_label
     from .utils import prefetch_iter
 
+    if jax.process_count() > 1:
+        # multi-process: fold on the LOCAL devices only — chunks and the
+        # accumulator never leave this host, every collective in the
+        # jitted step stays intra-process, and the per-rank partials
+        # meet in ONE cross-process reduction at pass_complete below
+        # (psum on collective-capable backends, the coordination-service
+        # wire on CPU builds)
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.local_devices()), (DATA_AXIS,))
+
     mat_sh = NamedSharding(mesh, data_pspec(2))
     row_sh = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
     rep_sh = NamedSharding(mesh, PartitionSpec())
@@ -647,6 +741,14 @@ def accumulate_chunks(
             )
     _baseline.pass_complete()
     host = acc_to_host_f64(acc)
+    if jax.process_count() > 1:
+        # the pass_complete reduction: one global fold of the per-rank
+        # f64 partials (rank-agreement-checked); everything downstream —
+        # finalize, the solve — sees the same global statistics a
+        # single-process pass over the full data would produce
+        from .parallel.context import reduce_host_arrays
+
+        host = reduce_host_arrays(host, "fused_pass")
     wall = time.perf_counter() - t0
     prep_iv = _merge_intervals(prep["iv"]) if self_timed else prep["iv"]
     # feed the run's utilization timeline (telemetry/utilization.py):

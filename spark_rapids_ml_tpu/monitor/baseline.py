@@ -120,13 +120,44 @@ def pass_complete() -> None:
     """The pass finished cleanly: freeze the capture (later passes fold
     nothing).  A pass that folded zero host rows (fully device-served
     replay) leaves the collector open so a later host-served pass can
-    still capture."""
+    still capture.
+
+    Multi-process, each rank's builder folded only its ingest slice;
+    the builders merge here through their versioned wire format
+    (fingerprint.builder_to_bytes over the context.py allgather seam)
+    so every rank freezes the GLOBAL fingerprint — the drift monitor
+    then scores serving traffic against the whole dataset's baseline,
+    not one shard's.  The exchange is collective: the collector arming
+    is conf-driven and identical on every rank (SPMD), so all ranks
+    reach it together."""
     coll = _active()
     if coll is None or coll.done or not coll.in_pass:
         return
     coll.in_pass = False
+    import jax
+
+    if jax.process_count() > 1:
+        coll.builder = _merge_builders_across_processes(coll.builder)
     if coll.builder is not None and coll.builder.n > 0:
         coll.done = True
+
+
+def _merge_builders_across_processes(builder):
+    """Allgather every rank's builder state (empty payload for ranks
+    whose pass served fully device-resident) and merge in rank order;
+    None when no rank folded host rows."""
+    from ..parallel.context import reduce_blob_list
+    from .fingerprint import builder_from_bytes, builder_to_bytes
+
+    payload = b"" if builder is None else builder_to_bytes(builder)
+    blobs = reduce_blob_list("baseline_builder", payload)
+    builders = [builder_from_bytes(b) for b in blobs if b]
+    if not builders:
+        return None
+    out = builders[0]
+    for b in builders[1:]:
+        out = out.merge(b)
+    return out
 
 
 def fold_batch(X, w=None) -> None:

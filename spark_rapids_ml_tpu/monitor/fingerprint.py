@@ -50,6 +50,13 @@ from ..stats.sketches import (
 FINGERPRINT_MAGIC = b"SRFP"
 FINGERPRINT_VERSION = 1
 
+# builder-state wire (cross-process baseline reduction): the LIVE
+# mergeable state, as opposed to the finalized SRFP fingerprint — ranks
+# exchange builders so the merged result is exactly what one builder
+# folding all slices would hold
+BUILDER_MAGIC = b"SRBB"
+BUILDER_VERSION = 1
+
 # rows buffered before the sketches fold: per-row serving requests must
 # not pay a per-row np.unique per column — buffered folds amortize the
 # sketch cost to ~1-2 us/row (the bench `drift` section measures it)
@@ -398,4 +405,76 @@ class Fingerprint:
         )
 
 
-__all__ = ["BaselineBuilder", "Fingerprint", "PSI_QUANTILES"]
+def builder_to_bytes(b: BaselineBuilder) -> bytes:
+    """Versioned wire form of a builder's LIVE mergeable state — the
+    payload each rank ships at the cross-process baseline reduction
+    (parallel/context.py reduce_blob_list).  The three sketches travel
+    in their own versioned `sketch_to_bytes` wire (the existing
+    cross-version contract); moments and Misra-Gries control state ride
+    one compressed npz."""
+    import json
+
+    from ..stats.sketches import sketch_to_bytes
+
+    b._flush()
+    meta = {"d": b.d, "k": b.k, "cap": b.cap, "bits": b.bits, "n": b.n}
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        nan=b.nan, s1=b.s1, s2=b.s2, vmin=b.vmin, vmax=b.vmax,
+        q=np.frombuffer(sketch_to_bytes("quantile", b.q), np.uint8),
+        f=np.frombuffer(sketch_to_bytes("frequent", b.f), np.uint8),
+        h=np.frombuffer(sketch_to_bytes("hll", b.h), np.uint8),
+        mg_active=b._mg_active, mg_streak=b._mg_streak,
+    )
+    payload = buf.getvalue()
+    return BUILDER_MAGIC + struct.pack("<H", BUILDER_VERSION) + payload
+
+
+def builder_from_bytes(blob: bytes) -> BaselineBuilder:
+    """Inverse of `builder_to_bytes`; refuses unknown magic/version
+    loudly (a mixed-version pod must not silently mis-merge)."""
+    import json
+
+    from ..stats.sketches import sketch_from_bytes
+
+    if blob[:4] != BUILDER_MAGIC:
+        raise ValueError("not a baseline-builder wire blob (bad magic)")
+    (version,) = struct.unpack("<H", blob[4:6])
+    if version != BUILDER_VERSION:
+        raise ValueError(
+            f"baseline-builder wire version {version} unsupported (this "
+            f"build speaks {BUILDER_VERSION}); align library versions "
+            "across the pod"
+        )
+    with np.load(io.BytesIO(blob[6:]), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        b = BaselineBuilder.__new__(BaselineBuilder)
+        b.d = int(meta["d"])
+        b.k = int(meta["k"])
+        b.cap = int(meta["cap"])
+        b.bits = int(meta["bits"])
+        b.n = int(meta["n"])
+        b.nan = np.array(z["nan"])
+        b.s1 = np.array(z["s1"])
+        b.s2 = np.array(z["s2"])
+        b.vmin = np.array(z["vmin"])
+        b.vmax = np.array(z["vmax"])
+        for name, attr in (("q", "q"), ("f", "f"), ("h", "h")):
+            kind, state = sketch_from_bytes(bytes(z[name]))
+            setattr(b, attr, state)
+        b._pending = []
+        b._pending_rows = 0
+        b._mg_active = np.array(z["mg_active"])
+        b._mg_streak = np.array(z["mg_streak"])
+    return b
+
+
+__all__ = [
+    "BaselineBuilder",
+    "Fingerprint",
+    "PSI_QUANTILES",
+    "builder_from_bytes",
+    "builder_to_bytes",
+]

@@ -16,15 +16,43 @@
 #
 from __future__ import annotations
 
-from typing import Optional
+import base64
+import hashlib
+import io
+import time
+from typing import Dict, List, Optional
 
 import jax
+import numpy as np
 
 from ..config import get_config
+from ..telemetry.locks import named_lock
 from ..utils import get_logger
 from .mesh import get_mesh
 
 _distributed_initialized = False
+
+
+class RankDivergenceError(RuntimeError):
+    """The content fingerprints of a cross-process reduction disagree
+    across ranks: the processes are merging statistics computed from
+    DIFFERENT inputs (shapes, dtypes, or accumulator keys differ).
+    Raised before any merge happens — a silently mis-merged model is
+    strictly worse than a loud failure.  Carries the per-rank
+    fingerprints so the operator can see which rank diverged."""
+
+    def __init__(self, tag: str, fingerprints: List[str]) -> None:
+        self.tag = tag
+        self.fingerprints = list(fingerprints)
+        lines = ", ".join(
+            f"rank{r}={fp[:16]}" for r, fp in enumerate(self.fingerprints)
+        )
+        super().__init__(
+            f"cross-process reduction {tag!r}: content fingerprints "
+            f"diverge across ranks ({lines}) — the processes are not "
+            "reducing the same statistic layout; check that every rank "
+            "ingested the same dataset schema and program set"
+        )
 
 
 class DeviceLoss(RuntimeError):
@@ -91,8 +119,7 @@ def _runtime_initialized() -> bool:
     probe = getattr(jax.distributed, "is_initialized", None)
     if probe is not None:
         return bool(probe())
-    state = getattr(jax.distributed, "global_state", None)
-    return state is not None and getattr(state, "client", None) is not None
+    return _coordination_client() is not None
 
 
 def init_distributed(
@@ -190,13 +217,394 @@ def reinit_distributed(
     Returns True when distributed mode came (back) up, False in
     single-host mode.  The resilience layer's preemption hook
     (resilience/retry.py) calls this before re-dispatching; iterative
-    solvers then resume from their checkpoint."""
+    solvers then resume from their checkpoint.
+
+    The coordinator address is re-resolved from CONFIG at call time
+    (unless overridden by the explicit argument): a coordinator that
+    restarted elsewhere publishes its new address via
+    `set_config(coordinator_address=...)` / the env tier, and a reinit
+    that reused the first bootstrap's cached address would reconnect
+    every worker to a dead endpoint."""
     shutdown_distributed()
+    global _reduce_backend_resolved
+    _reduce_backend_resolved = None  # re-probe collectives on the new runtime
+    globals().pop("_psum_probe_result", None)
+    coord = coordinator_address or get_config("coordinator_address")
     return init_distributed(
-        coordinator_address=coordinator_address,
+        coordinator_address=coord,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+# ---------------------------------------------------------------------------
+# Cross-process broadcast/allgather seam — the analog of the reference's
+# NCCL-uid allGather bootstrap (cuml_context.py:96-102), generalized into
+# a small-payload exchange plane over the jax.distributed coordination
+# service's KV store.  Collective-capable builds (TPU pods, GPU) reduce
+# dense accumulators with one jitted psum over the pod mesh; builds whose
+# XLA backend cannot run cross-process collectives (CPU) fall back to
+# allgathering the versioned wire payloads here and folding on host in
+# rank order — deterministic, so integer-representable partial sums stay
+# byte-identical to the single-process fold.
+# ---------------------------------------------------------------------------
+
+_kv_lock = named_lock("multiproc_kv")
+# per-tag monotonic sequence numbers: every rank calls the same reduction
+# sites in the same order (the SPMD contract the psum path relies on
+# anyway), so the counters stay in lockstep and successive reductions on
+# one tag never collide in the shared KV namespace
+_kv_seq: Dict[str, int] = {}
+_reduce_backend_resolved: Optional[str] = None
+_psum_fns: Dict = {}
+
+
+def _coordination_client():
+    """The live coordination-service client, or None outside distributed
+    mode.  jax keeps it on the distributed module's `global_state` (the
+    same handle `multihost_utils` and cluster bootstrap use) — public on
+    `jax.distributed` in some releases, only on `jax._src.distributed`
+    in others (0.4.3x); the getattr chain tolerates both."""
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        try:
+            from jax._src import distributed as _dist
+
+            state = getattr(_dist, "global_state", None)
+        except Exception:
+            state = None
+    return getattr(state, "client", None)
+
+
+def _reduce_timeout_ms() -> int:
+    return max(1, int(float(get_config("multiproc_reduce_timeout_s")) * 1000))
+
+
+def _kv_put(client, key: str, payload: bytes) -> None:
+    # the KV store's string API is the one stable across the jaxlib
+    # versions we support; base64 keeps arbitrary wire bytes intact
+    # (symmetric with _kv_take — never mix with the *_bytes variants)
+    client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
+
+
+def _kv_take(client, key: str, timeout_ms: int) -> bytes:
+    return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+
+
+def allgather_bytes(
+    tag: str, payload: bytes, timeout_s: Optional[float] = None
+) -> List[bytes]:
+    """Exchange one opaque payload per process; returns every rank's
+    payload in rank order, on every rank.  Single-process: [payload].
+    Collective contract: every process calls the same `allgather_bytes`
+    sites in the same order (SPMD), or tags/sequence numbers desync.
+    A rank whose peers never show up fails with a timeout after
+    `multiproc_reduce_timeout_s` — a dead rank must surface loudly, not
+    hang the pass."""
+    if jax.process_count() == 1:
+        return [bytes(payload)]
+    client = _coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "allgather_bytes: jax.distributed is not initialized (no "
+            "coordination client); call init_distributed() first"
+        )
+    with _kv_lock:
+        seq = _kv_seq.get(tag, 0)
+        _kv_seq[tag] = seq + 1
+    rank, nranks = jax.process_index(), jax.process_count()
+    base = f"srmt/ag/{tag}/{seq}"
+    timeout_ms = (
+        int(timeout_s * 1000) if timeout_s is not None else _reduce_timeout_ms()
+    )
+    _kv_put(client, f"{base}/{rank}", payload)
+    out: List[bytes] = []
+    for peer in range(nranks):
+        try:
+            out.append(_kv_take(client, f"{base}/{peer}", timeout_ms))
+        except Exception as e:
+            raise RuntimeError(
+                f"allgather_bytes[{tag}#{seq}]: rank {rank} timed out "
+                f"waiting for rank {peer}'s payload after "
+                f"{timeout_ms} ms ({type(e).__name__}: {e}) — peer dead "
+                "or diverged"
+            ) from e
+    # cleanup: after everyone has read, each rank deletes its own key so
+    # a long-running process doesn't grow the coordination store without
+    # bound.  Barrier first — deleting before a slow peer's read would
+    # turn its read into a spurious timeout.  Both steps are
+    # best-effort: older clients lack the APIs, and leaked keys are
+    # harmless (seq numbers never reuse a name).
+    try:
+        barrier = getattr(client, "wait_at_barrier", None)
+        if barrier is not None:
+            barrier(f"srmt/agb/{tag}/{seq}", timeout_ms)
+            delete = getattr(client, "key_value_delete", None)
+            if delete is not None:
+                delete(f"{base}/{rank}")
+    except Exception:  # pragma: no cover - version/timing dependent
+        pass
+    return out
+
+
+def broadcast_bytes(
+    tag: str,
+    payload: Optional[bytes] = None,
+    root: int = 0,
+    timeout_s: Optional[float] = None,
+) -> bytes:
+    """One-to-all: rank `root` publishes `payload`; every rank returns
+    it.  The direct analog of the NCCL-uid broadcast (root creates the
+    uid, the barrier allGather hands it to everyone).  Non-root ranks
+    may pass payload=None."""
+    if jax.process_count() == 1:
+        return bytes(payload or b"")
+    client = _coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "broadcast_bytes: jax.distributed is not initialized (no "
+            "coordination client); call init_distributed() first"
+        )
+    with _kv_lock:
+        seq = _kv_seq.get(f"bc/{tag}", 0)
+        _kv_seq[f"bc/{tag}"] = seq + 1
+    key = f"srmt/bc/{tag}/{seq}"
+    timeout_ms = (
+        int(timeout_s * 1000) if timeout_s is not None else _reduce_timeout_ms()
+    )
+    if jax.process_index() == root:
+        if payload is None:
+            raise ValueError("broadcast_bytes: root rank needs a payload")
+        _kv_put(client, key, payload)
+        return bytes(payload)
+    return _kv_take(client, key, timeout_ms)
+
+
+def _observe_reduce(phase: str, seconds: float) -> None:
+    from ..telemetry.registry import histogram
+
+    histogram(
+        "multiproc_reduce_seconds",
+        "Cross-process reduction wall time by phase",
+    ).observe(seconds, phase=phase)
+
+
+def content_fingerprint(tag: str, arrays: Dict[str, np.ndarray]) -> str:
+    """Structural fingerprint of a reduction payload: the tag plus every
+    accumulator's (name, shape, dtype) in sorted order.  Content VALUES
+    are deliberately excluded — ranks legitimately hold different
+    partial sums; what must agree is the LAYOUT they claim to be
+    reducing."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(tag.encode())
+    for name in sorted(arrays):
+        a = np.asarray(arrays[name])
+        h.update(
+            f"|{name}:{a.dtype.str}:{tuple(a.shape)}".encode()
+        )
+    return h.hexdigest()
+
+
+def check_rank_agreement(tag: str, fingerprint: str) -> None:
+    """Allgather a small fingerprint and require every rank to present
+    the same one; divergence raises `RankDivergenceError` BEFORE any
+    merge.  No-op single-process or when `multiproc_agreement_check` is
+    off."""
+    if jax.process_count() == 1 or not get_config("multiproc_agreement_check"):
+        return
+    t0 = time.perf_counter()
+    fps = [
+        b.decode("ascii", "replace")
+        for b in allgather_bytes(f"agree/{tag}", fingerprint.encode("ascii"))
+    ]
+    _observe_reduce("agreement", time.perf_counter() - t0)
+    if any(fp != fps[0] for fp in fps):
+        raise RankDivergenceError(tag, fps)
+
+
+def psum_capable() -> bool:
+    """Whether this build's XLA backend can run cross-process
+    collectives (TPU/GPU yes; the CPU backend rejects them).  Probed
+    once per process with a tiny allgather; the probe is itself a
+    collective, so every rank must reach it (they do — it only runs
+    from reduction sites, which are SPMD).  Single-process: trivially
+    True."""
+    if jax.process_count() == 1:
+        return True
+    global _psum_probe_result
+    try:
+        return _psum_probe_result  # type: ignore[name-defined]
+    except NameError:
+        pass
+    try:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.process_allgather(np.zeros((1,), np.float32))
+        result = True
+    except Exception as e:
+        get_logger("spark_rapids_ml_tpu.multiproc").info(
+            "cross-process XLA collectives unavailable on this backend "
+            f"({type(e).__name__}); host-fold reductions go over the "
+            "coordination-service wire"
+        )
+        result = False
+    _psum_probe_result = result
+    return result
+
+
+def resolve_reduce_backend() -> str:
+    """'psum' or 'wire', honoring the `multiproc_reduce` conf ('auto'
+    probes the backend once).  Cached; `reinit_distributed` clears the
+    cache because a new runtime may have different capabilities."""
+    global _reduce_backend_resolved
+    if _reduce_backend_resolved is not None:
+        return _reduce_backend_resolved
+    conf = str(get_config("multiproc_reduce")).lower()
+    if conf not in ("auto", "psum", "wire"):
+        raise ValueError(
+            f"multiproc_reduce must be auto|psum|wire, got {conf!r}"
+        )
+    if conf == "auto":
+        backend = "psum" if psum_capable() else "wire"
+    else:
+        backend = conf
+    _reduce_backend_resolved = backend
+    return backend
+
+
+def cross_process_reduce_ready() -> bool:
+    """Whether cross-process reductions can run at all right now: true
+    single-process, and in distributed mode whenever the coordination
+    client is live (the wire path needs nothing else; psum capability
+    only picks WHICH backend)."""
+    if jax.process_count() == 1:
+        return True
+    return _coordination_client() is not None
+
+
+def _lead_device_mesh():
+    """1-D mesh with one device per process (each process's
+    lowest-indexed device) — the reduction axis for the jitted psum."""
+    from jax.sharding import Mesh
+
+    leads = {}
+    for d in jax.devices():
+        if d.process_index not in leads:
+            leads[d.process_index] = d
+    devs = np.array([leads[p] for p in sorted(leads)])
+    return Mesh(devs, ("proc",))
+
+
+def _psum_reduce_stacked(vec: np.ndarray) -> np.ndarray:
+    """Sum this process's flat f64 partial with its peers' via ONE jitted
+    cross-process reduction: each rank contributes row `rank` of a
+    global (nranks, n) array sharded over the lead-device mesh; a jitted
+    sum over the process axis lets GSPMD emit the all-reduce, and the
+    replicated output is read back on every host."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _lead_device_mesh()
+    nranks = jax.process_count()
+    lead = mesh.devices.flat[jax.process_index()]
+    local = jax.device_put(vec[None, :], lead)
+    garr = jax.make_array_from_single_device_arrays(
+        (nranks, vec.shape[0]),
+        NamedSharding(mesh, P("proc", None)),
+        [local],
+    )
+    key = (
+        tuple(int(d.id) for d in mesh.devices.flat),
+        vec.shape[0],
+        str(vec.dtype),
+    )
+    with _kv_lock:
+        fn = _psum_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda x: x.sum(axis=0),
+                out_shardings=NamedSharding(mesh, P()),
+            )
+            _psum_fns[key] = fn
+    return np.asarray(jax.device_get(fn(garr)))
+
+
+def reduce_host_arrays(
+    arrays: Dict[str, np.ndarray], tag: str
+) -> Dict[str, np.ndarray]:
+    """Sum a dict of per-process partial accumulators across every rank;
+    returns the global sums (same keys/shapes/dtypes) on every rank.
+    Single-process: the input, unchanged — so call sites need no gate.
+
+    This is the `pass_complete` reduction of the multi-host data path:
+    each process folds only its own ingest share locally, then ONE
+    reduction here replaces the replicated host folds.  Backend per
+    `multiproc_reduce`: 'psum' concatenates the accumulators into one
+    flat f64 vector and folds it with a single jitted collective;
+    'wire' allgathers the npz-serialized payloads over the coordination
+    service and folds on host in ascending rank order — deterministic,
+    so exactly-representable partials (integer-valued test data) reduce
+    byte-identically to the single-process fold.  The agreement check
+    (conf `multiproc_agreement_check`) runs first either way."""
+    if jax.process_count() == 1:
+        return arrays
+    from ..telemetry.registry import counter
+
+    arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    check_rank_agreement(tag, content_fingerprint(tag, arrays))
+    backend = resolve_reduce_backend()
+    t0 = time.perf_counter()
+    if backend == "psum":
+        names = sorted(arrays)
+        flat = np.concatenate(
+            [np.asarray(arrays[n], np.float64).ravel() for n in names]
+        )
+        total = _psum_reduce_stacked(flat)
+        out: Dict[str, np.ndarray] = {}
+        off = 0
+        for n in names:
+            a = arrays[n]
+            out[n] = (
+                total[off : off + a.size].reshape(a.shape).astype(a.dtype)
+                if a.dtype != np.float64
+                else total[off : off + a.size].reshape(a.shape)
+            )
+            off += a.size
+    else:
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        blobs = allgather_bytes(f"reduce/{tag}", buf.getvalue())
+        out = {
+            k: np.zeros_like(np.asarray(v, np.float64))
+            for k, v in arrays.items()
+        }
+        for blob in blobs:  # ascending rank order — deterministic
+            with np.load(io.BytesIO(blob)) as z:
+                for k in out:
+                    out[k] = out[k] + np.asarray(z[k], np.float64)
+        out = {
+            k: v.astype(arrays[k].dtype) if arrays[k].dtype != v.dtype else v
+            for k, v in out.items()
+        }
+    _observe_reduce(backend, time.perf_counter() - t0)
+    counter(
+        "multiproc_reductions_total",
+        "Cross-process reductions completed, by backend",
+    ).inc(backend=backend)
+    return out
+
+
+def reduce_blob_list(tag: str, payload: bytes) -> List[bytes]:
+    """Allgather one versioned wire blob per rank (sketch states via
+    `sketch_to_bytes`, fingerprint-builder states) in rank order, timed
+    under the `sketch` phase.  The caller merges with the format's own
+    associative merge — the wire format IS the cross-process contract,
+    exactly as the reference ships sketch bytes through NCCL."""
+    if jax.process_count() == 1:
+        return [bytes(payload)]
+    t0 = time.perf_counter()
+    blobs = allgather_bytes(f"blob/{tag}", payload)
+    _observe_reduce("sketch", time.perf_counter() - t0)
+    return blobs
 
 
 class TpuContext:

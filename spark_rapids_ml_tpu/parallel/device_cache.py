@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import itertools
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -89,14 +91,23 @@ def device_data_budget_bytes() -> float:
     shared with `_TpuCaller._over_device_budget` (core.py) so the cache
     can never believe in more memory than the staging decisions do.
     Counts ACTIVE devices only: after an elastic mesh shrink the lost
-    chips' HBM is gone with them."""
+    chips' HBM is gone with them.  Multi-process, each rank stages and
+    caches only its ADDRESSABLE shards (mesh.ShardedRowWriter), so the
+    budget counts this process's devices alone — a rank can never book
+    bytes against a remote host's HBM."""
+    import jax
+
     from ..config import get_config
     from .mesh import active_devices
 
+    devices = active_devices()
+    if jax.process_count() > 1:
+        pid = jax.process_index()
+        devices = [d for d in devices if d.process_index == pid]
     return (
         float(get_config("hbm_bytes"))
         * float(get_config("mem_ratio_for_data"))
-        * len(active_devices())
+        * len(devices)
     )
 
 
@@ -906,14 +917,43 @@ def _chunk_note(kind: str, amount: int = 1) -> None:
         CHUNK_METRICS.bump(kind, amount)
 
 
+_spill_seq = itertools.count()
+
+
+def _spill_file_path(spill_dir: str, crc: int) -> str:
+    """Collision-free spill filename under a SHARED spill dir: multiple
+    pod processes may point `chunk_cache_spill_dir` at one filesystem
+    (local emulation, NFS scratch), so the name embeds the process
+    index and pid alongside the per-process sequence and the content
+    crc — two ranks spilling the same content-stamped stream can never
+    clobber each other's blobs."""
+    import jax
+
+    os.makedirs(spill_dir, exist_ok=True)
+    fname = (
+        f"srmt-chunk-p{jax.process_index()}-{os.getpid()}-"
+        f"{next(_spill_seq)}-{crc & 0xFFFFFFFF:08x}.spill"
+    )
+    return os.path.join(spill_dir, fname)
+
+
 class _SpilledArray:
-    """One ndarray serialized into the spill tier."""
+    """One ndarray serialized into the spill tier: an in-memory
+    compressed blob by default, or a file under `chunk_cache_spill_dir`
+    (`blob is None`, `path` set) when the conf points at a directory —
+    the blob bytes then leave the host budget entirely."""
 
-    __slots__ = ("codec", "blob", "dtype_str", "shape", "crc", "raw_nbytes")
+    __slots__ = (
+        "codec", "blob", "path", "nbytes", "dtype_str", "shape", "crc",
+        "raw_nbytes",
+    )
 
-    def __init__(self, codec, blob, dtype_str, shape, crc, raw_nbytes):
+    def __init__(self, codec, blob, dtype_str, shape, crc, raw_nbytes,
+                 path=None, nbytes=None):
         self.codec = codec
         self.blob = blob
+        self.path = path
+        self.nbytes = len(blob) if blob is not None else int(nbytes)
         self.dtype_str = dtype_str
         self.shape = shape
         self.crc = crc
@@ -940,7 +980,7 @@ class _ChunkArray:
         return int(self.host.nbytes) if self.host is not None else 0
 
     def spill_nbytes(self) -> int:
-        return len(self.spill.blob) if self.spill is not None else 0
+        return self.spill.nbytes if self.spill is not None else 0
 
     def dev_nbytes(self) -> int:
         return int(self.dev.nbytes) if self.dev is not None else 0
@@ -986,29 +1026,35 @@ class ChunkCache:
         self._streams: Dict[Any, _ChunkStream] = {}
         self._clock = 0
         self._host_b = 0  # host-resident array bytes
-        self._spill_b = 0  # compressed spill blob bytes
+        self._spill_b = 0  # compressed spill blob bytes (in-memory)
+        self._spill_disk_b = 0  # file-backed spill bytes (spill dir)
         self._dev_total = 0  # bytes booked under _CHUNK_TAG
 
     # -- accounting ----------------------------------------------------------
 
     @property
     def _host_total(self) -> int:
-        """Bytes counted against `chunk_cache_host_bytes` (host + spill)."""
+        """Bytes counted against `chunk_cache_host_bytes` (host arrays
+        plus IN-MEMORY spill blobs).  File-backed spills
+        (`chunk_cache_spill_dir`) live on disk and leave the host
+        budget entirely — that is the point of configuring a dir."""
         return self._host_b + self._spill_b
 
     def _touch_locked(self, chunk: CachedChunk) -> None:
         self._clock += 1
         chunk.last_used = self._clock
 
-    def _account_locked(self, host_delta: int = 0, spill_delta: int = 0) -> None:
+    def _account_locked(self, host_delta: int = 0, spill_delta: int = 0,
+                        disk_delta: int = 0) -> None:
         self._host_b = max(0, self._host_b + int(host_delta))
         self._spill_b = max(0, self._spill_b + int(spill_delta))
+        self._spill_disk_b = max(0, self._spill_disk_b + int(disk_delta))
         self._sync_bytes_locked()
 
     def _sync_bytes_locked(self) -> None:
         with _lock:
             CHUNK_METRICS["host_bytes"] = self._host_b
-            CHUNK_METRICS["spilled_bytes"] = self._spill_b
+            CHUNK_METRICS["spilled_bytes"] = self._spill_b + self._spill_disk_b
             CHUNK_METRICS["device_bytes"] = self._dev_total
 
     def _book_dev_locked(self, delta: int) -> bool:
@@ -1042,20 +1088,33 @@ class ChunkCache:
 
         maybe_inject("chunk_cache_spill")
         name, compress, _ = resolve_codec(get_config("chunk_cache_codec"))
+        spill_dir = str(get_config("chunk_cache_spill_dir") or "")
         freed_dev = 0
         host_delta = 0
         spill_delta = 0
+        disk_delta = 0
         for a in chunk.arrays():
             if a.spill is not None:
                 continue
             arr = a.host if a.host is not None else np.asarray(a.dev)
             arr = np.ascontiguousarray(arr)
             raw = arr.tobytes()
-            a.spill = _SpilledArray(
-                name, compress(raw), arr.dtype.str, arr.shape,
-                checksum(raw), len(raw),
-            )
-            spill_delta += len(a.spill.blob)
+            blob = compress(raw)
+            crc = checksum(raw)
+            if spill_dir:
+                path = _spill_file_path(spill_dir, crc)
+                with open(path, "wb") as f:
+                    f.write(blob)
+                a.spill = _SpilledArray(
+                    name, None, arr.dtype.str, arr.shape, crc, len(raw),
+                    path=path, nbytes=len(blob),
+                )
+                disk_delta += a.spill.nbytes
+            else:
+                a.spill = _SpilledArray(
+                    name, blob, arr.dtype.str, arr.shape, crc, len(raw),
+                )
+                spill_delta += a.spill.nbytes
             if a.dev is not None:
                 freed_dev += a.dev_nbytes()
                 a.dev = None
@@ -1063,11 +1122,14 @@ class ChunkCache:
             a.host = None
         if freed_dev:
             self._book_dev_locked(-freed_dev)
-        self._account_locked(host_delta, spill_delta)
+        self._account_locked(host_delta, spill_delta, disk_delta)
         _chunk_note("spills")
         from ..tracing import event
 
-        event("chunk_cache_spill", detail=f"codec={name}")
+        event(
+            "chunk_cache_spill",
+            detail=f"codec={name}" + (" tier=disk" if spill_dir else ""),
+        )
 
     def _restore_array_locked(self, a: _ChunkArray) -> np.ndarray:
         """Spill blob -> read-only ndarray, crc-verified.  The restored
@@ -1078,8 +1140,20 @@ class ChunkCache:
 
         sp = a.spill
         _, _, decompress = resolve_codec(sp.codec)
+        blob = sp.blob
+        if blob is None:
+            try:
+                with open(sp.path, "rb") as f:
+                    blob = f.read()
+            except OSError as e:
+                # a vanished/unreadable spill file is an integrity loss,
+                # same verdict as a torn in-memory blob
+                _chunk_note("checksum_failures")
+                raise ChunkIntegrityError(
+                    f"spill file unreadable ({sp.path}): {e}"
+                ) from e
         try:
-            raw = decompress(sp.blob)
+            raw = decompress(blob)
         except Exception as e:
             # a torn blob can fail the codec before the crc ever runs —
             # same integrity verdict either way
@@ -1103,17 +1177,24 @@ class ChunkCache:
         if st.dropped:
             return
         st.dropped = True
-        freed_dev = host_delta = spill_delta = 0
+        freed_dev = host_delta = spill_delta = disk_delta = 0
         for c in st.chunks:
             for a in c.arrays():
                 freed_dev += a.dev_nbytes()
                 host_delta -= a.host_nbytes()
-                spill_delta -= a.spill_nbytes()
+                if a.spill is not None and a.spill.path is not None:
+                    disk_delta -= a.spill.nbytes
+                    try:
+                        os.unlink(a.spill.path)
+                    except OSError:
+                        pass  # best-effort: orphans are rank-distinct files
+                else:
+                    spill_delta -= a.spill_nbytes()
         st.chunks = []
         self._streams.pop(st.key, None)
         if freed_dev:
             self._book_dev_locked(-freed_dev)
-        self._account_locked(host_delta, spill_delta)
+        self._account_locked(host_delta, spill_delta, disk_delta)
         _chunk_note("evictions")
         from ..tracing import event
 

@@ -353,12 +353,19 @@ def _staging_depth() -> int:
 
 def _writer_devices(sharding, shape) -> Optional[list]:
     """Device list, ordered by owned row range, for a target the
-    per-device writer can assemble: a single-process, row-sharded (or
-    unsharded) placement whose equal shards tile axis 0.  None means the
-    caller must use the serial path."""
-    if jax.process_count() != 1 or not shape or shape[0] <= 0:
+    per-device writer can assemble: a row-sharded (or unsharded)
+    placement whose equal shards tile axis 0.  Multi-process the list is
+    GLOBAL — it names every shard's owner in row order, and
+    `ShardedRowWriter` materializes buffers only for the addressable
+    ones (each host assembles its own slice of the one global array).
+    None means the caller must use the serial path."""
+    if not shape or shape[0] <= 0:
         return None
     if sharding is None:
+        # an unsharded (default-device) target has no meaningful
+        # multi-process assembly — that caller holds the full array
+        if jax.process_count() != 1:
+            return None
         return [jax.devices()[0]]
     try:
         imap = sharding.devices_indices_map(tuple(shape))
@@ -405,8 +412,16 @@ class ShardedRowWriter:
     host pieces via donated single-device dynamic_update_slice programs;
     `finish` assembles the global array with
     `jax.make_array_from_single_device_arrays`.  Rows the caller never
-    writes stay zero (padding is not transferred).  Single-process only
-    (`_writer_devices` decides eligibility)."""
+    writes stay zero (padding is not transferred).
+
+    Multi-process: the shard map stays GLOBAL (shard index = global row
+    range), but buffers exist only for this process's ADDRESSABLE
+    devices — each host writes its own slice, `finish` passes the local
+    shard arrays, and jax assembles the ONE global array from every
+    process's pieces.  `write` silently skips spans owned by remote
+    hosts (a decode chunk straddling a process boundary writes only its
+    local part; `rows_skipped_remote` counts the rest), while the
+    explicit `write_shard` refuses remote shards loudly."""
 
     def __init__(self, shape, dtype, sharding=None) -> None:
         self.shape = tuple(int(x) for x in shape)
@@ -416,20 +431,30 @@ class ShardedRowWriter:
         devices = _writer_devices(sharding, self.shape)
         if devices is None:
             raise ValueError(
-                "ShardedRowWriter requires a single-process row-sharded "
-                f"(or unsharded) target; got {sharding} for {self.shape}"
+                "ShardedRowWriter requires a row-sharded (or single-"
+                f"process unsharded) target; got {sharding} for {self.shape}"
             )
         self._devices = devices
         self._n_dev = len(devices)
         self._s = self.shape[0] // self._n_dev
         shard_shape = (self._s,) + self.shape[1:]
-        self._bufs = []
-        for dev in devices:
+        pid = jax.process_index()
+        # shard index -> live buffer, addressable shards only
+        self._bufs = {}
+        for d, dev in enumerate(devices):
+            if getattr(dev, "process_index", pid) != pid:
+                continue
             mk, _ = _shard_update_fns(shard_shape, self.dtype.str, dev)
-            self._bufs.append(mk())
+            self._bufs[d] = mk()
+        if not self._bufs:
+            raise ValueError(
+                "ShardedRowWriter: this process owns none of the target's "
+                "shards (mesh/process mismatch)"
+            )
         self.bytes_written = 0
         self.put_seconds = 0.0  # dispatch-side time (transfers are async)
         self.pieces = 0
+        self.rows_skipped_remote = 0
         # the parallel parquet range readers (streaming.stage_parquet)
         # call write() from their own threads at disjoint row offsets;
         # the lock protects the per-device buffer swap + metrics — the
@@ -448,14 +473,20 @@ class ShardedRowWriter:
     def write(self, lo: int, rows: np.ndarray) -> None:
         """Write host `rows` at GLOBAL row offset `lo`, splitting at
         device-shard boundaries (each split lands on exactly one
-        device)."""
+        device).  Spans owned by a remote process's devices are skipped
+        (and counted) — multi-process callers write their whole decode
+        chunk and only the addressable part transfers."""
         n = int(rows.shape[0])
         pos = 0
         while pos < n:
             g = lo + pos
             d = g // self._s
             take = min(n - pos, (d + 1) * self._s - g)
-            self.write_shard(d, g - d * self._s, rows[pos : pos + take])
+            if d in self._bufs:
+                self.write_shard(d, g - d * self._s, rows[pos : pos + take])
+            else:
+                with self._mu:
+                    self.rows_skipped_remote += int(take)
             pos += take
 
     def write_shard(self, d: int, lo: int, rows: np.ndarray) -> None:
@@ -464,6 +495,13 @@ class ShardedRowWriter:
         serialize only the (fast) update dispatch."""
         import jax.numpy as jnp
 
+        if d not in self._bufs:
+            dev = self._devices[d]
+            raise ValueError(
+                f"shard {d} is owned by process "
+                f"{getattr(dev, 'process_index', '?')}; rank "
+                f"{jax.process_index()} writes only its addressable shards"
+            )
         dev = self._devices[d]
         t0 = time.perf_counter()
         piece = np.ascontiguousarray(rows, dtype=self.dtype)
@@ -488,10 +526,14 @@ class ShardedRowWriter:
         if self.sharding is None:
             out = self._bufs[0]
         else:
+            # addressable shards only, in shard order: multi-process,
+            # every process passes ITS pieces and jax stitches the one
+            # global array (remote shards come from their own hosts)
             out = jax.make_array_from_single_device_arrays(
-                self.shape, self.sharding, list(self._bufs)
+                self.shape, self.sharding,
+                [self._bufs[d] for d in sorted(self._bufs)],
             )
-        self._bufs = []  # the writer must not pin the shard buffers
+        self._bufs = {}  # the writer must not pin the shard buffers
         return out
 
 
@@ -676,6 +718,30 @@ def _chunked_device_put(arr: np.ndarray, sharding=None) -> "jax.Array":
                                 out_shardings=sharding)
 
 
+def _allgather_i64(value: int, tag: str = "i64") -> np.ndarray:
+    """Every process's int64 scalar, in rank order — the XLA collective
+    where the backend supports cross-process collectives, the
+    coordination-service wire where it doesn't (CPU builds).  The tiny
+    exchange every multi-process layout negotiation starts from."""
+    if jax.process_count() == 1:
+        return np.asarray([int(value)], np.int64)
+    from .context import allgather_bytes, psum_capable
+
+    if not psum_capable():
+        blobs = allgather_bytes(
+            f"i64/{tag}", int(value).to_bytes(8, "little", signed=True)
+        )
+        return np.asarray(
+            [int.from_bytes(b, "little", signed=True) for b in blobs],
+            np.int64,
+        )
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(int(value), np.int64))
+    ).reshape(-1)
+
+
 class RowStager:
     """Stages host arrays onto the mesh with one consistent padded row
     layout, so X / y / weights / masks / row-ids always line up.
@@ -742,13 +808,7 @@ class RowStager:
                 ) >= n_dev
             self._interleave = n_dev > 1 and interleave
         else:
-            from jax.experimental import multihost_utils
-
-            counts = np.asarray(
-                multihost_utils.process_allgather(
-                    np.asarray(int(n_local_rows), np.int64)
-                )
-            ).reshape(-1)
+            counts = _allgather_i64(int(n_local_rows), "stager_counts")
             self._init_layout(counts, mesh)
 
     def _init_layout(self, counts: np.ndarray, mesh: Mesh) -> None:
@@ -810,14 +870,10 @@ class RowStager:
             return cls(n_rows, mesh, bucketing=bucketing,
                        interleave=interleave, telemetry=telemetry)
         pid, n_proc = jax.process_index(), jax.process_count()
-        from jax.experimental import multihost_utils
-
         # one scalar allgather VALIDATES the replication contract — a caller
         # passing process-local rows here (fit-style input) would otherwise
         # stage mismatched global shapes and deadlock in the next collective
-        seen = np.asarray(
-            multihost_utils.process_allgather(np.asarray(int(n_rows), np.int64))
-        ).reshape(-1)
+        seen = _allgather_i64(int(n_rows), "replicated_rows")
         if not (seen == seen[0]).all():
             raise ValueError(
                 "RowStager.for_replicated requires the SAME row count on "
@@ -893,6 +949,12 @@ class RowStager:
                             arr, dtype, sharding, devices
                         )
                 return self._stage_serial(arr, dtype)
+            if (
+                _FORCE_PIPELINED or arr.nbytes >= _PIPELINED_MIN_BYTES
+            ) and _writer_devices(
+                sharding, (self.n_padded,) + arr.shape[1:]
+            ) is not None:
+                return self._stage_pipelined_multi(arr, dtype, sharding)
             padded = self._pad_host(arr, dtype)
             return jax.make_array_from_process_local_data(
                 sharding, padded, (self.n_padded,) + padded.shape[1:]
@@ -1015,6 +1077,40 @@ class RowStager:
                     yield d_i, lo, piece
 
         return run_staging_pipeline(writer, producer(), label="stage")
+
+    def _stage_pipelined_multi(
+        self, arr: np.ndarray, dtype: np.dtype, sharding
+    ) -> jax.Array:
+        """Multi-process per-device staging: a writer over the GLOBAL
+        padded shape whose buffers exist only for this process's
+        addressable shards; local rows stream in at this process's
+        global block offset and `finish` assembles the one global array
+        from every host's pieces.  Byte-identical placement to the
+        `make_array_from_process_local_data` path (contiguous process
+        blocks, zero padding at each block tail) without materializing
+        the padded host copy."""
+        writer = ShardedRowWriter(
+            (self.n_padded,) + arr.shape[1:], dtype, sharding
+        )
+        block_lo = int(self.block_sizes[: jax.process_index()].sum())
+        row_bytes = (
+            int(np.prod(arr.shape[1:], dtype=np.int64))
+            * np.dtype(dtype).itemsize
+            if arr.ndim > 1
+            else np.dtype(dtype).itemsize
+        )
+        chunk = _staging_chunk_rows(row_bytes)
+        n_local = self.n_local
+
+        def producer() -> Iterator:
+            # multi-process blocks are contiguous (never interleaved), so
+            # pieces are plain slices; the writer routes each to its shard
+            for lo in range(0, n_local, chunk):
+                cnt = min(chunk, n_local - lo)
+                piece = np.ascontiguousarray(arr[lo : lo + cnt], dtype=dtype)
+                yield None, block_lo + lo, piece
+
+        return run_staging_pipeline(writer, producer(), label="stage_mp")
 
     def stage_sparse(
         self,
@@ -1218,6 +1314,23 @@ def allgather_host_rows(arr: np.ndarray) -> np.ndarray:
     _ensure_distributed()
     if jax.process_count() == 1:
         return arr
+    from .context import psum_capable
+
+    if not psum_capable():
+        # CPU builds can't run the XLA collective: ship the blocks over
+        # the coordination-service wire instead (same process-major
+        # concatenation order)
+        import io
+
+        from .context import allgather_bytes
+
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+        blobs = allgather_bytes("host_rows", buf.getvalue())
+        return np.concatenate(
+            [np.load(io.BytesIO(b), allow_pickle=False) for b in blobs],
+            axis=0,
+        )
     from jax.experimental import multihost_utils
 
     counts = np.asarray(

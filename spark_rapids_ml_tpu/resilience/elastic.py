@@ -81,6 +81,7 @@ RECOVERY_METRICS = _dict_view(
         "meshes_rebuilt": 0,
         "iterations_salvaged": 0,
         "full_retry_fallbacks": 0,
+        "remote_host_losses": 0,
     },
 )
 
@@ -233,6 +234,34 @@ def recover_from_device_loss(logger_=None) -> bool:
         detail=f"lost={[d.id for d in lost]} n_dev={len(devices)}",
         log=lg,
     )
+    # classify the loss: a lost LOCAL chip is recoverable by shrinking
+    # this host's meshes, but a lost device on a REMOTE host means a
+    # peer PROCESS is gone — the pod's cross-process reduction seam
+    # (parallel/context.py) would dead-peer-timeout at the next
+    # pass_complete, so the only sound answer is the full re-bootstrap
+    # of jax.distributed (which re-reads `coordinator_address` from the
+    # live conf, picking up a restarted coordinator)
+    import jax
+
+    pid = jax.process_index()
+    remote = [d for d in lost if getattr(d, "process_index", pid) != pid]
+    if remote:
+        with _lock:
+            RECOVERY_METRICS["remote_host_losses"] += 1
+        detail = (
+            f"lost_remote={[(d.id, d.process_index) for d in remote]} "
+            f"local_rank={pid}"
+        )
+        event("elastic_recovery[remote_host_loss]", detail=detail, log=lg)
+        lg.warning(
+            f"Device loss includes remote-host device(s) "
+            f"{[int(d.id) for d in remote]} (peer process gone); elastic "
+            "local shrink cannot recover a dead rank — re-bootstrapping "
+            "the distributed runtime instead"
+        )
+        _fallback_full_retry(lg)
+        return False
+
     lost_id_set = {int(d.id) for d in lost}
     survivors = [d for d in devices if int(d.id) not in lost_id_set]
     if not elastic_enabled() or len(survivors) < elastic_min_devices():

@@ -319,6 +319,15 @@ def _one_pass(
     device_progs = [p for p in progs if p.kind == "device"]
     host_progs = [p for p in progs if p.kind == "host"]
     mesh = get_mesh()
+    if jax.process_count() > 1:
+        # multi-process: fold on the LOCAL devices only — chunks and the
+        # accumulators never leave this host; the per-rank partials meet
+        # in ONE cross-process reduction after the chunk loop (psum on
+        # collective-capable backends, the coordination-service wire on
+        # CPU builds) — see _reduce_pass_across_processes
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.local_devices()), (DATA_AXIS,))
     n_dev = mesh.devices.size
 
     popts = {p.name: resolve_opts(p, opts.get(p.name)) for p in progs}
@@ -450,6 +459,10 @@ def _one_pass(
         for p in device_progs:
             folded[p.name] = acc_to_host_f64(dev_acc[p.name])
         folded.update(host_acc)
+        if jax.process_count() > 1:
+            folded, offset = _reduce_pass_across_processes(
+                progs, popts, d, folded, offset
+            )
         wall = time.perf_counter() - t0
 
         ctx = {"d": d, "rows": offset, "quantiles": tuple(quantiles or ())}
@@ -503,6 +516,87 @@ def _one_pass(
         ),
     )
     return results
+
+
+def _reduce_pass_across_processes(progs, popts, d, folded, rows):
+    """Cross-process reduction at pass completion: every rank folded
+    only its ingest share (streaming.process_ingest_ranges /
+    fused.process_row_group_shares), so the per-rank partials combine
+    here into the GLOBAL accumulators every rank then finalizes
+    identically.
+
+    Pure-sum device fields — plus the pass row count — collapse through
+    ONE reduce_host_arrays call (a single jitted psum when the backend
+    supports cross-process collectives, the deterministic rank-ordered
+    wire fold otherwise).  min/max device fields and the host sketch
+    programs (KLL quantiles, Misra-Gries, k-means sample) travel as one
+    wire blob per rank and merge with each program's own merge
+    (stats.programs.merge_accs) in ascending rank order, so every rank
+    computes byte-identical results — the 2-process parity suite
+    asserts describe() equality against a single-process run.
+
+    Note: host-step `ctx["offset"]` stays rank-local under sharded
+    ingest, so offset-addressed slot programs (kmeans_sample) merge
+    deterministically but sample per-rank strides rather than the
+    single-process global stride."""
+    import io
+
+    from ..parallel.context import reduce_blob_list, reduce_host_arrays
+
+    sums: Dict[str, Any] = {"__rows__": np.asarray(float(rows))}
+    wire: Dict[str, Any] = {}
+    modes: Dict[str, str] = {}
+    for p in progs:
+        if p.kind == "host":
+            for f, v in folded[p.name].items():
+                wire[f"{p.name}:{f}"] = np.asarray(v)
+            continue
+        declared = p.shapes(d, popts[p.name])
+        for f, v in folded[p.name].items():
+            if declared[f].merge == "sum":
+                sums[f"{p.name}:{f}"] = np.asarray(v)
+            else:
+                wire[f"{p.name}:{f}"] = np.asarray(v)
+                modes[f"{p.name}:{f}"] = declared[f].merge
+
+    summed = reduce_host_arrays(sums, "stat_pass")
+    rows_global = int(round(float(summed.pop("__rows__"))))
+    for key, v in summed.items():
+        name, f = key.split(":", 1)
+        folded[name][f] = v
+
+    if wire:
+        from .programs import merge_accs
+
+        buf = io.BytesIO()
+        np.savez(buf, **wire)
+        blobs = reduce_blob_list("stat_sketches", buf.getvalue())
+        states = []
+        for blob in blobs:
+            with np.load(io.BytesIO(blob)) as z:
+                states.append({k: np.array(z[k]) for k in z.files})
+        for key, mode in modes.items():
+            out = states[0][key]
+            for s in states[1:]:
+                out = (
+                    np.minimum(out, s[key]) if mode == "min"
+                    else np.maximum(out, s[key])
+                )
+            name, f = key.split(":", 1)
+            folded[name][f] = out
+        for p in progs:
+            if p.kind != "host":
+                continue
+            fields = list(folded[p.name])
+            acc = {f: states[0][f"{p.name}:{f}"] for f in fields}
+            for s in states[1:]:
+                acc = merge_accs(
+                    p, acc,
+                    {f: s[f"{p.name}:{f}"] for f in fields},
+                    popts[p.name],
+                )
+            folded[p.name] = acc
+    return folded, rows_global
 
 
 def iter_chunk_accs(

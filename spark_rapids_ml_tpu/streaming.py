@@ -261,14 +261,20 @@ def _chunk_stream_key(
     """Chunk-cache stream key: the path's content stamp plus every scan
     parameter that shapes the yielded chunks.  None (cache bypass) when
     the path cannot be stat'd — a remote dataset rewritten in place must
-    never replay stale chunks."""
+    never replay stale chunks.  The key also carries `process_index`:
+    each host caches (and spills) only its own slice's chunks, and two
+    ranks replaying the SAME parquet path through a shared
+    `chunk_cache_spill_dir` must never collide on a spill filename —
+    without the rank component their content stamps are identical."""
+    import jax
+
     stamp = _path_stamp(path)
     if stamp is None:
         return None
     return (
-        tag, path, stamp, features_col, tuple(features_cols or ()),
-        label_col, weight_col, int(chunk_rows), np.dtype(dtype).str,
-        row_range,
+        tag, path, stamp, int(jax.process_index()), features_col,
+        tuple(features_cols or ()), label_col, weight_col,
+        int(chunk_rows), np.dtype(dtype).str, row_range,
     )
 
 
@@ -549,19 +555,23 @@ def stage_parquet(
         chunk_rows = chunk_rows_for(d, dtype.itemsize)
 
     if jax.process_count() > 1:
-        # per-partition read: this process materializes ONLY its slice
-        # (host memory = dataset / n_processes), then the standard
-        # RowStager layout assembles the global sharded arrays
+        # per-partition read: every host decodes ONLY its contiguous row
+        # share (host memory = dataset / n_processes, decode throughput
+        # scales with host count), then the standard RowStager layout —
+        # whose large-array path now runs the per-device writer over the
+        # addressable shards — assembles the ONE global sharded array.
+        # The share partition is pure arithmetic on (n_total, rank):
+        # deterministic on every rank, and coverage-asserted to tile
+        # [0, n_total) exactly, so no row is decoded twice or dropped.
         n_proc, pid = jax.process_count(), jax.process_index()
-        base, rem = divmod(n_total, n_proc)
-        lo = pid * base + min(pid, rem)
-        hi = lo + base + (1 if pid < rem else 0)
+        ranges = process_ingest_ranges(n_total, n_proc)
+        lo, hi = ranges[pid]
         n_local = hi - lo
         X = np.zeros((n_local, d), dtype)
         y = np.zeros((n_local,), np.float64) if label_col else None
         w = np.zeros((n_local,), np.float64) if weight_col else None
         at = 0
-        for cX, cy, cw, n_c in iter_chunks(
+        for cX, cy, cw, n_c in iter_chunks_prefetch(
             path, features_col, features_cols, label_col, weight_col,
             chunk_rows, dtype, row_range=(lo, hi), cache_ok=False,
         ):
@@ -571,6 +581,11 @@ def stage_parquet(
             if w is not None:
                 w[at : at + n_c] = cw[:n_c]
             at += n_c
+        if at != n_local:
+            raise RuntimeError(
+                f"parallel ingest coverage: rank {pid} decoded {at} rows "
+                f"of its share [{lo}, {hi}) — expected {n_local}"
+            )
         return DeviceDataset.from_host(
             X, y=y, weight=w, num_workers=num_workers, dtype=dtype,
             label_dtype=label_dtype,
@@ -792,32 +807,54 @@ def stage_parquet(
 # ---------------------------------------------------------------------------
 
 
+def process_ingest_ranges(n_total: int, n_proc: int) -> list:
+    """The deterministic per-process ingest partition: contiguous
+    `[lo, hi)` row ranges, one per rank, balanced to within one row.
+    Pure arithmetic on the inputs (every rank computes the identical
+    table with no exchange) and coverage-asserted: the ranges tile
+    `[0, n_total)` exactly — the contract that makes 'each host decodes
+    only its slice' safe to reduce over."""
+    base, rem = divmod(int(n_total), int(n_proc))
+    ranges = []
+    lo = 0
+    for p in range(int(n_proc)):
+        hi = lo + base + (1 if p < rem else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    # coverage assertion (cheap, and the failure mode — double-decoded
+    # or dropped rows silently skewing the reduced statistics — is the
+    # worst kind): ranges must tile [0, n_total) with no gaps/overlaps
+    if ranges[0][0] != 0 or ranges[-1][1] != int(n_total) or any(
+        a[1] != b[0] for a, b in zip(ranges, ranges[1:])
+    ):  # pragma: no cover - arithmetic invariant
+        raise AssertionError(
+            f"process ingest ranges do not tile [0, {n_total}): {ranges}"
+        )
+    return ranges
+
+
 def _process_row_range(n_total: int) -> Tuple[int, int]:
     import jax
 
     n_proc, pid = jax.process_count(), jax.process_index()
     if n_proc == 1:
         return 0, n_total
-    base, rem = divmod(n_total, n_proc)
-    lo = pid * base + min(pid, rem)
-    return lo, lo + base + (1 if pid < rem else 0)
+    return process_ingest_ranges(n_total, n_proc)[pid]
 
 
 def _sum_across_processes(host_stats: dict) -> dict:
-    """Sum per-process partial statistics (host side)."""
+    """Sum per-process partial statistics (host side) through the
+    cross-process reduce seam (parallel/context.py): one jitted psum on
+    collective-capable backends, the coordination-service wire fold on
+    CPU builds — with the rank-agreement check either way."""
     import jax
 
     if jax.process_count() == 1:
         return host_stats
-    from jax.experimental import multihost_utils
+    from .parallel.context import reduce_host_arrays
 
-    out = {}
-    for k, v in host_stats.items():
-        gathered = np.asarray(
-            multihost_utils.process_allgather(np.asarray(v))
-        )
-        out[k] = gathered.sum(axis=0)
-    return out
+    arrays = {k: np.asarray(v) for k, v in host_stats.items()}
+    return reduce_host_arrays(arrays, "streaming_stats")
 
 
 def _linreg_acc(d: int, dtype):

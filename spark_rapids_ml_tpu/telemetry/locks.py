@@ -68,6 +68,10 @@ LOCK_CATALOG: Dict[str, Dict[str, Any]] = {
     "chunk_codec": {
         "kind": "lock", "module": "spark_rapids_ml_tpu/parallel/chunk_codec.py",
     },
+    # cross-process reduce seam: KV sequence counters + cached psum jits
+    "multiproc_kv": {
+        "kind": "lock", "module": "spark_rapids_ml_tpu/parallel/context.py",
+    },
     # serving/: the dispatcher condition + report state + model registry
     "serving_dispatch": {
         "kind": "condition", "module": "spark_rapids_ml_tpu/serving/server.py",

@@ -256,6 +256,21 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "serving_shed_total": {
         "kind": "counter", "labels": ("model", "class"), "cardinality": 64,
     },
+    # multi-host data path (parallel/context.py): wall time of each
+    # cross-process reduction step by phase — `agreement` (the content-
+    # fingerprint check), `psum` (jitted collective fold), `wire`
+    # (coordination-service allgather + rank-order host fold), `sketch`
+    # (host-tier sketch wire merges), `fingerprint` (drift-baseline
+    # builder merges)
+    "multiproc_reduce_seconds": {
+        "kind": "histogram", "labels": ("phase",), "cardinality": 8,
+    },
+    # ...and the reductions that completed, by backend actually used
+    # (psum | wire) — the observable for "did auto pick the collective
+    # path on this build"
+    "multiproc_reductions_total": {
+        "kind": "counter", "labels": ("backend",), "cardinality": 4,
+    },
 }
 
 _DEFAULT_BUCKETS = (

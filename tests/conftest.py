@@ -138,6 +138,84 @@ def _multiprocess_cpu_supported() -> bool:
     return ok
 
 
+_COORD_CPU_SUPPORT = None
+
+
+def _coordination_cpu_supported() -> bool:
+    """Whether 2-rank `jax.distributed.initialize` + coordination-service
+    key-value exchange works here.  STRICTLY WEAKER than
+    `_multiprocess_cpu_supported`: the wire reduce seam
+    (parallel/context.py allgather_bytes) and the 2-process parity suite
+    stand only on the coordination service, which 0.4.x CPU wheels DO
+    ship even when cross-process XLA collectives are not compiled in.
+    Probed once per session with a tiny 2-rank KV handshake."""
+    global _COORD_CPU_SUPPORT
+    if _COORD_CPU_SUPPORT is not None:
+        return _COORD_CPU_SUPPORT
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = (
+        "import os, sys;"
+        "os.environ['JAX_PLATFORMS'] = 'cpu';"
+        "import jax;"
+        f"jax.distributed.initialize('127.0.0.1:{port}', num_processes=2,"
+        " process_id=int(sys.argv[1]));"
+        "gs = getattr(jax.distributed, 'global_state', None);"
+        "gs = gs or __import__('jax._src.distributed',"
+        " fromlist=['global_state']).global_state;"
+        "c = gs.client;"
+        "c.key_value_set('probe/' + sys.argv[1], 'ok');"
+        "peer = '1' if sys.argv[1] == '0' else '0';"
+        "assert c.blocking_key_value_get('probe/' + peer, 30000) == 'ok'"
+    )
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    ok = True
+    try:
+        ranks = [
+            subprocess.Popen(
+                [sys.executable, "-c", code, str(r)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                env=env,
+            )
+            for r in (0, 1)
+        ]
+        for p in ranks:
+            try:
+                p.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.communicate(timeout=10)
+                except Exception:
+                    pass
+                ok = False
+                continue
+            if p.returncode != 0:
+                ok = False
+    except OSError:
+        ok = False
+    _COORD_CPU_SUPPORT = ok
+    return ok
+
+
+@pytest.fixture
+def require_coordination_cpu():
+    """Skip (fast, cached) when even coordination-only 2-rank
+    jax.distributed is unavailable — the floor the wire-reduce parity
+    tests need.  Builds that fail the stronger collective probe
+    (`require_multiprocess_cpu`) usually still pass this one."""
+    if _platform == "cpu" and not _coordination_cpu_supported():
+        pytest.skip(
+            "2-rank jax.distributed coordination service unavailable "
+            "(initialize/KV handshake failed); wire-reduce parity tests "
+            "cannot run here"
+        )
+
+
 @pytest.fixture
 def require_multiprocess_cpu():
     """Skip (fast, cached) when the jaxlib build cannot run 2-process
