@@ -65,6 +65,10 @@ _LOWER_BETTER = (
     # ...and the hands-off time from target-relaxed to brownout phase
     # back at `normal`; slower re-admission = capacity held back longer
     "_recovery_s",
+    # interactive requests dropped at admission (bench.py
+    # `serving_scale` / `serving_control` sections): priority admission
+    # exists so this stays 0 — any climb is a control-plane regression
+    "_interactive_drops",
 )
 _HIGHER_BETTER = (
     "_per_sec",

@@ -51,6 +51,11 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     # brownout recovery time.  MUST precede the broader `serving_`
     # prefix — first startswith match wins
     ("serving_control_", "serving_control"),
+    # hundreds-of-models scale bench (bench.py `serving_scale` section):
+    # mixed-priority QPS across >=200 pinned models with a background
+    # fused fit, worst-model p99, interactive drops, pipelined-vs-
+    # serialized speedup.  Same MUST-precede rule as serving_control_
+    ("serving_scale_", "serving_scale"),
     ("serving_", "serving"),
     ("staging_", "staging"),
     ("streaming_", "streaming"),

@@ -132,6 +132,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_jvm_protocol.py tests/test_native.py tests/test_tracing.py \
     tests/test_resilience.py tests/test_elastic.py tests/test_telemetry.py \
     tests/test_serving.py tests/test_serving_control.py \
+    tests/test_serving_pipeline.py \
     tests/test_drift_monitor.py \
     tests/test_flight_recorder.py tests/test_aggregate.py \
     tests/test_locks_utilization.py tests/test_hang_doctor.py \
@@ -402,6 +403,81 @@ for fam, labels in (
 assert not any(k[0] == pre + "serving_rejections_total" for k in parsed)
 server.stop()
 print("serving smoke OK: zero rejections, families scrapeable")
+EOF
+
+echo "== serving-pipeline smoke: staged overlap beats depth-1, parity =="
+# tier-1 marker-safe: ONE pinned PCA model on the 8-dev CPU mesh, the
+# SAME 240-request traffic replayed at serving_pipeline_depth=1 (fully
+# serialized — the byte-parity baseline) and depth=4 (staged overlap:
+# collect worker drains batch N while N+1..N+3 stage/compute).  Gates:
+# (a) outputs BYTE-identical between the two depths and vs the direct
+# transform — overlap must never change a bit, (b) the pipelined run
+# beats depth-1 on QPS and on device_busy_fraction{scope=serving}
+# (the PR-15 idle-gap instrument proving the overlap is real, not a
+# timer artifact).  The A/B retries on a shared/noisy host — a single
+# run's scheduler jitter must not fail the gate, but a pipeline that
+# NEVER wins is a regression.  tests/test_serving_pipeline.py covers
+# ordering/fault/controller composition; this keeps the overlap gate
+# runnable in isolation.
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python - << 'EOF'
+import time
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_ml_tpu.config import set_config
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.serving import ServingServer
+from spark_rapids_ml_tpu.telemetry import utilization
+
+rng = np.random.default_rng(5)
+X = rng.normal(size=(3000, 32)).astype(np.float32)
+df = pd.DataFrame({"features": list(X)})
+model = PCA(k=8).setInputCol("features").setOutputCol("proj").fit(df)
+n = 240
+rows = [rng.normal(size=(1, 32)).astype(np.float32) for _ in range(n)]
+refs = [model._transform_array(r)["proj"] for r in rows]
+set_config(serving_max_wait_ms=5.0, serving_max_batch_rows=8,
+           serving_max_queue=1024)
+
+def run(depth):
+    set_config(serving_pipeline_depth=depth)
+    server = ServingServer()
+    server.register("pca", model)
+    server.start()
+    try:
+        server.transform("pca", rows[0], timeout=300)  # warm
+        utilization.clear()
+        t0 = time.perf_counter()
+        server.pause()
+        futs = [server.submit("pca", r) for r in rows]
+        server.resume()
+        outs = [f.result(timeout=300)["proj"] for f in futs]
+        qps = n / (time.perf_counter() - t0)
+        busy = utilization.summarize(domain="serving").get(
+            "device_busy_fraction", 0.0)
+    finally:
+        server.stop()
+        server.registry.clear()
+    return outs, qps, busy
+
+for attempt in range(4):
+    outs1, qps1, busy1 = run(depth=1)
+    outs4, qps4, busy4 = run(depth=4)
+    for o1, o4, ref in zip(outs1, outs4, refs):
+        assert np.array_equal(o1, ref) and np.array_equal(o4, ref)
+        assert o1.tobytes() == o4.tobytes()
+    print(f"serving-pipeline attempt {attempt}: depth4 {qps4:.0f} qps "
+          f"busy {busy4:.3f} vs depth1 {qps1:.0f} qps busy {busy1:.3f}")
+    if qps4 > qps1 and busy4 > busy1:
+        break
+else:
+    raise SystemExit(
+        "serving-pipeline smoke: pipelined never beat depth-1 "
+        f"(last: qps {qps4:.0f} vs {qps1:.0f}, busy {busy4:.3f} "
+        f"vs {busy1:.3f})")
+print("serving-pipeline smoke OK: byte parity + overlap beats depth-1")
 EOF
 
 echo "== control-plane smoke: SLO spike sheds batch, recovers hands-off =="

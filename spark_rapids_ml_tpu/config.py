@@ -455,6 +455,28 @@ _DEFAULTS: Dict[str, Any] = {
     # distinct row count.  Off stages exact shapes (the pre-controller
     # behavior).
     "serving_padding_buckets": True,
+    # Staged dispatch pipeline depth (serving/server.py): how many
+    # coalesced batches may be in flight at once across the
+    # stage -> compute -> collect/scatter stages.  1 fully serializes
+    # (dispatch N+1 only after N's outputs scattered — the byte-parity
+    # baseline); 2 matches the legacy overlap (collect N while
+    # dispatching N+1); 3+ lets batch N+2 stage while N+1 computes and
+    # N scatters.  0 (default) = auto: resolved from the serving
+    # idle-gap profile (telemetry/utilization.py) — depth grows while
+    # host-side phases are measurably stealing device-idle seconds,
+    # bounded by `serving_pipeline_max_depth`.
+    "serving_pipeline_depth": 0,
+    # Upper bound for the AUTO depth resolution (explicit
+    # `serving_pipeline_depth` values bypass it, clamped to 8).  Deeper
+    # pipelines hold more staged batches in device memory and lengthen
+    # the requeue window a mid-flight failure must drain.
+    "serving_pipeline_max_depth": 4,
+    # Per-model round-robin interleave (serving/server.py): when several
+    # models in the SAME priority class have due batches, rotate which
+    # model dispatches each round instead of draining the oldest queue
+    # first.  FIFO within each model's class is preserved either way;
+    # off restores strict oldest-head order across models.
+    "serving_pipeline_interleave": True,
     # Failure flight recorder (telemetry/flight_recorder.py): "on" keeps
     # an always-on bounded ring of recent trace events, rate-limited
     # metric deltas and heartbeats (O(1) memory), and the typed failure

@@ -1234,6 +1234,14 @@ class _TpuModel(Model, _TpuCaller):
         `_transform_device` program over them."""
         import jax
 
+        # one compute sync for ALL columns before the per-column fetch:
+        # fetching column-by-column would serialize each column's
+        # compute wait behind the previous column's transfer — on the
+        # serving collect path that wait bills to the collect worker's
+        # window instead of overlapping with later columns' compute
+        dev_arrays = [v for v in dev.values() if isinstance(v, jax.Array)]
+        if dev_arrays:
+            jax.block_until_ready(dev_arrays)
         return {
             col: (
                 st.fetch(v)

@@ -339,6 +339,20 @@ def process_row_group_shares(path: str, n_proc: int) -> Optional[list]:
     return shares
 
 
+def _share_row_starts(path: str, shares: list) -> list:
+    """Global first-row offset of each contiguous row-group share (the
+    `_partition_row_groups` / `process_row_group_shares` output): prefix
+    sums over the file's row-group sizes — pure metadata arithmetic,
+    identical on every rank, same determinism contract as the split
+    itself.  Empty shares get 0 (they yield no chunks anyway)."""
+    import pyarrow.parquet as pq
+
+    md = pq.ParquetFile(path).metadata
+    sizes = [md.row_group(i).num_rows for i in range(md.num_row_groups)]
+    starts = np.concatenate(([0], np.cumsum(sizes)))
+    return [int(starts[sh[0]]) if sh else 0 for sh in shares]
+
+
 def _reader_batches(path: str, columns, chunk_rows: int, groups=None):
     """Arrow record batches for the fused producer: a row-group-pruned
     `ParquetFile` reader for single files (measurably leaner than the
@@ -373,13 +387,21 @@ def _range_chunks(
     dtype: np.dtype,
     ldt: np.dtype,
     groups,
+    base_offset: Optional[int] = None,
 ) -> Iterable[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
     """One reader's share of the fused parquet producer: decode + prepare
     `(X, y, w)` chunks of its row-group share
     (`streaming.chunks_from_batches` — the exact iter_chunks decode and
     fixed-shape chunking).  `w` is None for full unweighted chunks (the
     engine's fast step) and the zero-weighted padding vector on the
-    share's tail chunk."""
+    share's tail chunk.
+
+    With `base_offset` (the GLOBAL row index of this share's first row),
+    chunks yield as 4-tuples `(X, y, w, global_offset)` — the exact
+    first-row offset of each chunk in the whole FILE, tracked through
+    valid-row counts so a partial tail chunk cannot skew later offsets.
+    Offset-addressed accumulators (the kmeans_sample reservoir) need
+    this to place rows identically no matter which rank decodes them."""
     from .streaming import _scan_columns, _weights_host, chunks_from_batches
 
     columns = _scan_columns(features_col, features_cols, label_col, weight_col)
@@ -388,6 +410,7 @@ def _range_chunks(
         features_col, features_cols, label_col, weight_col,
         chunk_rows, np.dtype(dtype),
     ))
+    off = None if base_offset is None else int(base_offset)
     decode_s = 0.0
     rows = 0
     nbytes = 0
@@ -408,7 +431,11 @@ def _range_chunks(
         if cy is not None:
             cy_out = np.zeros((chunk_rows,), ldt)
             cy_out[:n_c] = np.asarray(cy[:n_c]).reshape(-1)
-        yield cX, cy_out, w_host
+        if off is None:
+            yield cX, cy_out, w_host
+        else:
+            yield cX, cy_out, w_host, off
+            off += int(n_c)
     # single-reader decode rate feeds resolve_parquet_readers("auto");
     # too-short passes are scheduler noise, not a measurement
     if groups is None and decode_s > 0.02 and rows:
@@ -428,6 +455,7 @@ def iter_parquet_chunks(
     label_dtype: Optional[np.dtype] = None,
     readers: Optional[int] = None,
     prep: Optional[Dict[str, Any]] = None,
+    with_offsets: bool = False,
 ) -> Iterable[Tuple[np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]]:
     """Parquet producer for the fused engine: the chunk decode (the
     dominant host cost of the refconfig fits) runs through a row-group-
@@ -455,7 +483,15 @@ def iter_parquet_chunks(
     one fit is the headline consumer — replays them without touching
     disk or the reader pool.  Replayed feature blocks may arrive
     device-resident (the engine's `device_put` reshards them in place);
-    on a replayed pass the serve time is what lands in `prep`."""
+    on a replayed pass the serve time is what lands in `prep`.
+
+    `with_offsets=True` yields 4-tuples `(X, y, w, global_offset)`:
+    each chunk carries the GLOBAL first-row index of its rows in the
+    file, exact under every split mode (row-group shares, chunk-modulo
+    fallback, parallel range readers) — what lets offset-addressed
+    accumulators (the kmeans_sample reservoir) place rows identically
+    at any process count.  The offset variant keys a DISTINCT cache
+    stream: its cached tuples have four parts."""
     ldt = np.dtype(label_dtype) if label_dtype is not None else np.dtype(dtype)
     if readers is None:
         readers = resolve_parquet_readers(path)
@@ -466,9 +502,10 @@ def iter_parquet_chunks(
     )
     from .streaming import _chunk_stream_key
 
+    tag = ("fused+goff:" if with_offsets else "fused:") + ldt.str
     key = _chunk_stream_key(
         path, features_col, features_cols, label_col, weight_col,
-        chunk_rows, dtype, None, tag=f"fused:{ldt.str}",
+        chunk_rows, dtype, None, tag=tag,
     )
 
     def _timed(it):
@@ -494,19 +531,29 @@ def iter_parquet_chunks(
             if shares is not None:
                 if not shares[pid]:
                     return iter(())
+                # global offset of the share's first row: prefix sum of
+                # the row-group sizes ahead of it — every rank's chunks
+                # land at the same indices a single-process scan gives
+                base = (
+                    _share_row_starts(path, shares)[pid]
+                    if with_offsets else None
+                )
                 return _timed(_range_chunks(
                     path, features_col, features_cols, label_col,
                     weight_col, chunk_rows, dtype, ldt, shares[pid],
+                    base_offset=base,
                 ))
 
             # no row groups to split (directory dataset / single
             # group): every rank decodes the scan but FOLDS only
             # chunks congruent to its rank — disjoint exact cover,
-            # no decode scaling
+            # no decode scaling.  The serial scan's own offset
+            # tracking (base 0) is already global here.
             def _mod_filter():
                 for i, item in enumerate(_range_chunks(
                     path, features_col, features_cols, label_col,
                     weight_col, chunk_rows, dtype, ldt, None,
+                    base_offset=0 if with_offsets else None,
                 )):
                     if i % n_proc == pid:
                         yield item
@@ -518,6 +565,7 @@ def iter_parquet_chunks(
             return _parquet_reader_pool(
                 path, features_col, features_cols, label_col, weight_col,
                 chunk_rows, dtype, ldt, readers, _timed,
+                with_offsets=with_offsets,
             )
 
     # NOTE: checked before iterating (benign race: a stream completed by
@@ -539,16 +587,20 @@ def iter_parquet_chunks(
 def _parquet_reader_pool(
     path, features_col, features_cols, label_col, weight_col,
     chunk_rows, dtype, ldt, readers, _timed,
+    with_offsets: bool = False,
 ):
     """The live (non-cached) fused producer: one in-order pruned reader,
     or `readers` parallel range-reader threads merged through a bounded
-    queue."""
+    queue.  With `with_offsets`, every reader carries its share's global
+    first-row base, so the merged (arbitrary-order) stream still labels
+    each chunk with its exact position in the file."""
     shares = _partition_row_groups(path, readers)
     if shares is None:
         yield from _timed(
             _range_chunks(
                 path, features_col, features_cols, label_col, weight_col,
                 chunk_rows, dtype, ldt, None,
+                base_offset=0 if with_offsets else None,
             )
         )
         return
@@ -570,7 +622,7 @@ def _parquet_reader_pool(
                 continue
         return False
 
-    def _run(groups) -> None:
+    def _run(groups, base) -> None:
         try:
             # per-reader interval tracking shares the one `prep` dict:
             # "s" additions race benignly under the GIL (a lost update
@@ -580,6 +632,7 @@ def _parquet_reader_pool(
                 _range_chunks(
                     path, features_col, features_cols, label_col,
                     weight_col, chunk_rows, dtype, ldt, groups,
+                    base_offset=base,
                 )
             ):
                 if not _put(item):
@@ -588,9 +641,13 @@ def _parquet_reader_pool(
         except BaseException as e:  # surface reader errors on the consumer
             _put(e)
 
+    starts = (
+        _share_row_starts(path, shares) if with_offsets
+        else [None] * len(shares)
+    )
     threads = [
-        threading.Thread(target=_run, args=(g,), daemon=True)
-        for g in shares
+        threading.Thread(target=_run, args=(g, b), daemon=True)
+        for g, b in zip(shares, starts)
     ]
     for t in threads:
         t.start()

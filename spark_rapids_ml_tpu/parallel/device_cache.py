@@ -616,6 +616,40 @@ class DeviceDatasetCache:
             _note("external_releases", detail=f"tag={tag} bytes={freed}")
         return freed
 
+    def release_external_many(self, tags) -> int:
+        """Drop a BATCH of external claims under ONE lock acquisition
+        and emit ONE ledger note; returns total bytes freed.  The
+        serving registry's batched LRU eviction uses this: under pin
+        churn at hundreds of models, per-victim `release_external`
+        calls pay a lock round-trip and a tracing event each, and the
+        ledger lock is shared with every staging reserve."""
+        dropped = 0
+        freed = 0
+        with self._mu:
+            for tag in tags:
+                b = self._external.pop(tag, 0)
+                if b:
+                    dropped += 1
+                    freed += b
+        if freed:
+            _note(
+                "external_releases",
+                detail=f"tags={dropped} bytes={freed}",
+            )
+        return freed
+
+    def external_shortfall(self, tag: str, need_bytes: int) -> int:
+        """Bytes that must be freed elsewhere before
+        `reserve_external(tag, need_bytes)` can succeed with the cache
+        as it stands (0 = it already fits).  Pure read: the caller
+        (serving registry) sizes ONE batched eviction pass instead of
+        probing reserve/evict per victim."""
+        budget = cache_budget_bytes()
+        with self._mu:
+            old = self._external.get(tag, 0)
+            extra = int(need_bytes) - old
+            return max(0, self.claimed_bytes() + extra - budget)
+
     def external_bytes(self) -> int:
         with self._mu:
             return sum(self._external.values())
@@ -676,6 +710,16 @@ def release_external(tag: str) -> int:
     if _global_cache is None:
         return 0
     return _global_cache.release_external(tag)
+
+
+def release_external_many(tags) -> int:
+    if _global_cache is None:
+        return 0
+    return _global_cache.release_external_many(tags)
+
+
+def external_shortfall(tag: str, need_bytes: int) -> int:
+    return get_device_cache().external_shortfall(tag, need_bytes)
 
 
 def cache_resident_bytes() -> int:
@@ -1569,7 +1613,9 @@ __all__ = [
     "get_chunk_cache",
     "get_device_cache",
     "get_or_stage",
+    "external_shortfall",
     "invalidate_for_devices",
     "release_external",
+    "release_external_many",
     "reserve_external",
 ]

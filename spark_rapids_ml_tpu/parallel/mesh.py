@@ -1010,11 +1010,13 @@ class RowStager:
         rows slice straight out of the caller's array (the interleave
         permutation fused into a strided basic slice, the cast fused
         into the assignment), land in one small zero-padded shard
-        buffer, and `jax.device_put` moves each buffer to exactly its
-        device — no jitted update programs, no GSPMD, no full-array
-        copy.  Byte-identical to `_stage_serial` for every layout
-        (asserted by tests/test_staging_pipeline.py); gated by the
-        `staging_small_direct` conf."""
+        buffer, and ONE batched `jax.device_put` moves every buffer to
+        exactly its device (the runtime overlaps the per-device
+        transfers; per-device calls would serialize n_dev round trips
+        on the serving dispatch path) — no jitted update programs, no
+        GSPMD, no full-array copy.  Byte-identical to `_stage_serial`
+        for every layout (asserted by tests/test_staging_pipeline.py);
+        gated by the `staging_small_direct` conf."""
         n_dev = len(devices)
         s = self.local_padded // n_dev
         shard_shape = (s,) + arr.shape[1:]
@@ -1031,9 +1033,10 @@ class RowStager:
             piece = np.zeros(shard_shape, dtype)
             if cnt:
                 piece[:cnt] = arr[start : start + cnt * step : step]
-            pieces.append(jax.device_put(piece, devices[d_i]))
+            pieces.append(piece)
+        shards = jax.device_put(pieces, list(devices))
         return jax.make_array_from_single_device_arrays(
-            (self.local_padded,) + arr.shape[1:], sharding, pieces
+            (self.local_padded,) + arr.shape[1:], sharding, shards
         )
 
     def _stage_pipelined(

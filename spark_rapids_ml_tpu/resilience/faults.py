@@ -59,6 +59,13 @@ KNOWN_SITES = frozenset({
     # propagates to the submitting caller, never into the dispatcher,
     # and no half-admitted request leaks into the class deques
     "serving_admission",
+    # the staged pipeline's collect/scatter phase (serving/server.py
+    # collect worker): fires AFTER the batch dispatched, while earlier
+    # batches may still be in flight behind it — the drill for
+    # mid-pipeline failure.  Recovery requeues every in-flight batch's
+    # requests in dispatch order (per-model, per-class FIFO preserved)
+    # and the dispatcher re-coalesces; no request is lost or reordered
+    "serving_collect",
     # the chunk cache's spill-to-host compression step
     # (parallel/device_cache.py ChunkCache._spill_chunk_locked): fires
     # while an epoch iteration is inserting/evicting chunks mid-stream.

@@ -220,6 +220,21 @@ class ServingController:
             st = self._models.get(name)
             return st.wait_scale if st is not None else 1.0
 
+    def scales(self, name: str) -> Tuple[float, float]:
+        """(cap_scale, wait_scale) under ONE lock acquisition — the
+        dispatcher's coalesce path reads both per queued model per
+        round, and at hundreds of pinned models the two separate locked
+        reads above double the hot-path lock traffic.  Also gives the
+        caller one CONSISTENT snapshot: a controller tick between
+        separate reads could pair an old cap with a new wait."""
+        if not self.enabled():
+            return 1.0, 1.0
+        with self._mu:
+            st = self._models.get(name)
+            if st is None:
+                return 1.0, 1.0
+            return st.cap_scale, st.wait_scale
+
     def phase(self, name: str) -> int:
         if not self.enabled():
             return 0

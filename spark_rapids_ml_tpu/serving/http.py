@@ -18,6 +18,12 @@
 #                                      (404 for unknown names)
 #   GET  /v1/report                    the per-model latency report
 #                                      (p50/p99 ms, request counts)
+#   GET  /v1/pipeline                  staged-pipeline state: resolved
+#                                      depth, live slot occupancy,
+#                                      interleave flag, and the serving
+#                                      utilization window (busy
+#                                      fraction + idle-gap table) — the
+#                                      operator's depth-tuning view
 #
 # Binds LOOPBACK by default, the same posture as the `telemetry_port`
 # /metrics endpoint: model names and latency shapes must not leak to
@@ -98,6 +104,8 @@ def start_serving_http(server, port: int, host: str = "127.0.0.1"):
                 })
             elif path == "/v1/report":
                 self._reply(200, server.report())
+            elif path == "/v1/pipeline":
+                self._reply(200, server.pipeline_info())
             elif (
                 path.startswith("/v1/models/")
                 and not path.endswith(":transform")

@@ -104,6 +104,12 @@ class ModelRegistry:
         self._mu = named_lock("serving_registry", kind="rlock")
         self._host: Dict[str, Dict[str, Any]] = {}  # name -> registration
         self._pinned: Dict[str, PinnedModel] = {}
+        # incremental sum of pinned nbytes, maintained by _publish_locked
+        # and _drop: pinned_bytes()/_sync_gauges() are polled per report
+        # and per pin/drop, and a full-table scan there is O(pins) work
+        # under the registry lock every time — at hundreds of pinned
+        # models that scan IS the report path's cost
+        self._pinned_total_bytes = 0
 
     # -- registration --------------------------------------------------------
 
@@ -215,6 +221,14 @@ class ModelRegistry:
         with self._mu:
             return sorted(self._pinned)
 
+    def is_pinned(self, name: str) -> bool:
+        """O(1) pin probe for the per-model report paths: building the
+        sorted `pinned_names()` list just to test membership is an
+        O(n log n) sort per poll, paid once per model row at hundreds
+        of pinned models."""
+        with self._mu:
+            return name in self._pinned
+
     # -- resolution ----------------------------------------------------------
 
     def resolve(self, name: str) -> PinnedModel:
@@ -249,7 +263,7 @@ class ModelRegistry:
                 transform_fn=reg.get("transform") or model._transform_array,
             )
             with self._mu:
-                self._pinned[name] = entry
+                self._publish_locked(name, entry)
             PINS.inc(model=name, event=event)
             self._sync_gauges()
             return entry
@@ -257,20 +271,29 @@ class ModelRegistry:
         pinned_model, nbytes = self._replicate_arrays(model, mesh)
         # book the residency BEFORE publishing: under pressure, evict our
         # own LRU pins (never the one being pinned) until it fits — the
-        # dataset-cache side of the ledger LRU-evicts its entries first
-        while not reserve_external(_external_tag(name), nbytes):
-            if not self._evict_lru(exclude=name):
-                raise RuntimeError(
-                    f"serving model {name!r} (~{nbytes/2**20:.1f} MiB "
-                    "replicated) does not fit the device budget even "
-                    "with every other pin evicted"
-                )
+        # dataset-cache side of the ledger LRU-evicts its entries first.
+        # Eviction is BATCHED: one shortfall read sizes a single sorted
+        # LRU pass and one ledger round-trip frees every victim, instead
+        # of a reserve/evict probe per victim (each a ledger lock
+        # acquisition shared with staging).  The per-victim loop stays
+        # as a fallback for the race where another pinner claims the
+        # freed headroom between our release and retry.
+        if not reserve_external(_external_tag(name), nbytes):
+            self._evict_batch(exclude=name, shortfall=self._shortfall(
+                name, nbytes))
+            while not reserve_external(_external_tag(name), nbytes):
+                if not self._evict_lru(exclude=name):
+                    raise RuntimeError(
+                        f"serving model {name!r} (~{nbytes/2**20:.1f} MiB "
+                        "replicated) does not fit the device budget even "
+                        "with every other pin evicted"
+                    )
         entry = PinnedModel(
             name, pinned_model, device=True, mesh=mesh,
             dtype=reg["dtype"], n_features=reg["n_features"], nbytes=nbytes,
         )
         with self._mu:
-            self._pinned[name] = entry
+            self._publish_locked(name, entry)
         PINS.inc(model=name, event=event)
         from ..tracing import event as trace_event
 
@@ -313,7 +336,61 @@ class ModelRegistry:
         pinned._model_attributes = attrs
         return pinned, replica_bytes * int(mesh.devices.size)
 
+    def _publish_locked(self, name: str, entry: PinnedModel) -> None:
+        """Install `entry` in the pin table keeping the incremental byte
+        counter exact — a re-register overwrites an existing pin, whose
+        bytes must leave the sum (its ledger claim was already replaced
+        by the same-tag `reserve_external`)."""
+        old = self._pinned.get(name)
+        if old is not None:
+            self._pinned_total_bytes -= old.nbytes
+        self._pinned[name] = entry
+        self._pinned_total_bytes += entry.nbytes
+
+    def _shortfall(self, name: str, nbytes: int) -> int:
+        from ..parallel.device_cache import external_shortfall
+
+        return external_shortfall(_external_tag(name), nbytes)
+
     # -- eviction ------------------------------------------------------------
+
+    def _evict_batch(self, exclude: Optional[str], shortfall: int) -> int:
+        """Evict LRU pins covering `shortfall` bytes in ONE sorted pass,
+        releasing their ledger claims through ONE batched round-trip
+        (`release_external_many`).  Returns the number of victims; 0
+        when nothing is evictable (the caller's per-victim fallback
+        then raises the does-not-fit error)."""
+        from ..parallel.device_cache import release_external_many
+
+        if shortfall <= 0:
+            return 0
+        with self._mu:
+            candidates = sorted(
+                (e for e in self._pinned.values()
+                 if e.device and e.name != exclude),
+                key=lambda e: e.last_used,
+            )
+            victims: List[PinnedModel] = []
+            freed = 0
+            for e in candidates:
+                if freed >= shortfall:
+                    break
+                victims.append(e)
+                freed += e.nbytes
+            for e in victims:
+                self._pinned.pop(e.name, None)
+                self._pinned_total_bytes -= e.nbytes
+        if not victims:
+            return 0
+        release_external_many([_external_tag(e.name) for e in victims])
+        for e in victims:
+            PINS.inc(model=e.name, event="evict")
+        self._sync_gauges()
+        logger.info(
+            f"serving: batch-evicted {len(victims)} pin(s) "
+            f"({freed/2**20:.1f} MiB) to fit a new pin"
+        )
+        return len(victims)
 
     def _evict_lru(self, exclude: Optional[str] = None) -> bool:
         with self._mu:
@@ -332,6 +409,8 @@ class ModelRegistry:
 
         with self._mu:
             entry = self._pinned.pop(name, None)
+            if entry is not None:
+                self._pinned_total_bytes -= entry.nbytes
         if entry is None:
             return
         if entry.device:
@@ -344,15 +423,29 @@ class ModelRegistry:
         active mesh — the dispatcher's device-loss hook: arrays
         replicated over a lost chip are unreadable, and the re-pin lands
         every model on the survivors (resilience/elastic.py shrank the
-        mesh before this runs)."""
+        mesh before this runs).  The drop phase is BATCHED: one pin-
+        table pass plus one ledger round-trip frees every claim at
+        once, so the mesh-shrink stall does not scale with pin count
+        before the first re-pin can even start."""
+        from ..parallel.device_cache import release_external_many
+
         with self._mu:
-            names = [e.name for e in self._pinned.values() if e.device]
+            dropped = [e for e in self._pinned.values() if e.device]
+            for e in dropped:
+                self._pinned.pop(e.name, None)
+                self._pinned_total_bytes -= e.nbytes
+        names = [e.name for e in dropped]
         logger.warning(
             f"serving: re-pinning {len(names)} model(s) on the current "
             f"mesh ({reason})"
         )
+        if not names:
+            return
+        release_external_many([_external_tag(n) for n in names])
         for name in names:
-            self._drop(name, event="evict")
+            PINS.inc(model=name, event="evict")
+        self._sync_gauges()
+        for name in names:
             self._pin(name, event="repin")
 
     def pin_info(self, name: str) -> Dict[str, Any]:
@@ -385,13 +478,15 @@ class ModelRegistry:
             self._host.clear()
 
     def pinned_bytes(self) -> int:
+        # incremental counter, NOT a table scan: this is polled per
+        # report/admission check and must stay O(1) at hundreds of pins
         with self._mu:
-            return sum(e.nbytes for e in self._pinned.values())
+            return self._pinned_total_bytes
 
     def _sync_gauges(self) -> None:
         with self._mu:
             PINNED_MODELS.set(len(self._pinned))
-            PINNED_BYTES.set(sum(e.nbytes for e in self._pinned.values()))
+            PINNED_BYTES.set(self._pinned_total_bytes)
 
 
 __all__ = ["ModelRegistry", "PinnedModel"]

@@ -10,17 +10,28 @@
 # pinned model's `_transform_device` over the mesh, and scatters the
 # per-request row slices back to each caller's future.
 #
-# The dispatcher is ASYNC with a bounded in-flight depth of two batches:
-# batch N+1's host prep + device transfer ride the wire while batch N
-# computes and fetches (the same one-deep pipeline `_transform_mesh`
-# uses), so the sync point is always a fetch of finished work.
+# The dispatcher is a STAGED PIPELINE with a bounded in-flight depth
+# (`serving_pipeline_depth`; default auto from the measured idle-gap
+# profile): the dispatcher thread coalesces, stages and launches device
+# programs while a dedicated collect worker drains finished flights —
+# at depth 3, batch N+2 stages while N+1 computes while N's outputs
+# scatter.  Within a priority class a round-robin interleave rotates
+# which model's due batch dispatches each round
+# (`serving_pipeline_interleave`), so hundreds of pinned models share
+# the mesh instead of serializing whole dispatch rounds; FIFO within
+# each model's class is preserved.  Depth 1 fully serializes — the
+# byte-parity baseline the CI overlap gate compares against.
 # Admission control bounds the queue (`serving_max_queue` -> typed
 # `ServingOverload`), and every failure degrades instead of dropping
 # requests: an OOM halves the coalescing cap (floor: one row per
 # device), a device loss routes through elastic recovery
 # (resilience/elastic.py) and re-pins every resident model on the
 # shrunken mesh, transients back off — queued requests survive all
-# three, bounded by the retry policy's attempt budget.
+# three, bounded by the retry policy's attempt budget.  A failure
+# mid-pipeline hands back EXACTLY the affected flights' requests (the
+# collect worker drains every in-flight batch into one fault, the
+# dispatcher requeues them in dispatch order), so deeper pipelines
+# never widen the blast radius past the batches actually in flight.
 #
 # Above the queue sits the closed-loop control plane (serving/
 # control.py, ROADMAP item 2's actuator half): requests carry a
@@ -102,6 +113,17 @@ DISPATCH_LAG = gauge(
     "serving_dispatcher_lag_seconds",
     "Dispatcher wake overshoot past its intended deadline",
 )
+# staged-pipeline sensors: the resolved depth (conf or auto) and the
+# live slot occupancy — occupancy pinned at depth means the pipeline is
+# full and depth is the throughput limiter
+PIPELINE_DEPTH = gauge(
+    "serving_pipeline_depth",
+    "Resolved in-flight batch depth of the staged dispatch pipeline",
+)
+PIPELINE_INFLIGHT = gauge(
+    "serving_pipeline_inflight",
+    "Dispatched batches currently occupying pipeline slots",
+)
 
 # window the report()'s serving utilization summary covers
 _UTILIZATION_WINDOW_S = 60.0
@@ -114,6 +136,15 @@ _REPORT_SAMPLES = 4096
 # clean batches between each doubling of an OOM-shrunk coalescing cap
 # back toward the configured value
 _CAP_REGROW_BATCHES = 32
+
+# hard ceiling on explicit `serving_pipeline_depth` values: past the
+# pipeline's own stage count, extra depth only holds more staged
+# batches resident in device memory and lengthens the requeue window a
+# mid-flight failure must drain
+_MAX_PIPELINE_DEPTH = 8
+# the auto depth re-resolves from the serving idle-gap profile at most
+# this often (the summarize() fold walks the interval deque)
+_DEPTH_REFRESH_S = 1.0
 
 # SLO burn-rate windows the sensor gauges report over (label value ->
 # seconds); the budget is the 1% a p99 target implies
@@ -248,6 +279,23 @@ class ServingServer:
         self._shrunk_cap: Optional[int] = None
         self._clean_batches = 0
         self._batches = 0
+        # staged-pipeline state (all under the dispatch cv): dispatched
+        # flights awaiting collect in DISPATCH ORDER (the collect worker
+        # drains the left end), whether the worker is mid-collect (that
+        # flight still occupies a pipeline slot until its scatter
+        # finishes), the fault-handback slot the worker fills for the
+        # dispatcher's recovery path, and the worker's stop flag
+        self._inflight: Deque[_InFlight] = collections.deque()
+        self._collecting = False
+        self._pipe_fault: Optional[tuple] = None
+        self._collect_stop = False
+        # per-class round-robin cursor for the model interleave: the
+        # last model name dispatched per priority class
+        self._rr_last: Dict[str, str] = {}
+        # auto-depth memo (monotonic ts, resolved depth), refreshed at
+        # most once per _DEPTH_REFRESH_S; _depth_last de-dups the gauge
+        self._auto_memo: tuple = (0.0, 2)
+        self._depth_last = 0
         self._lat: Dict[str, Deque[float]] = {}
         # per-INSTANCE request/rejection counts for report(): the
         # registry counters are process-global by Prometheus design, and
@@ -516,7 +564,7 @@ class ServingServer:
 
     # -- report --------------------------------------------------------------
 
-    def _model_entry(self, name: str, pinned_names) -> Dict[str, Any]:
+    def _model_entry(self, name: str) -> Dict[str, Any]:
         """One model's report entry (the shared body of `report()` and
         `model_detail`)."""
         with self._lock:
@@ -530,7 +578,9 @@ class ServingServer:
             # predecessor's history
             "requests": requests,
             "rejections_queue_full": rejections,
-            "pinned": name in pinned_names,
+            # O(1) membership probe — the sorted pinned_names() list
+            # costs O(n log n) per poll at hundreds of pinned models
+            "pinned": self.registry.is_pinned(name),
         }
         if lat:
             srt = sorted(lat)
@@ -581,9 +631,8 @@ class ServingServer:
         rows, and exact p50/p99 latency over the last `_REPORT_SAMPLES`
         requests — the operator-facing SLO view (docs/serving.md)."""
         out: Dict[str, Any] = {}
-        pinned_names = self.registry.pinned_names()
         for name in self.registry.names():
-            out[name] = self._model_entry(name, pinned_names)
+            out[name] = self._model_entry(name)
         with self._lock:
             n_slow = len(self._slow)
             shed_total = {
@@ -595,11 +644,21 @@ class ServingServer:
             }
         ctl = self._controller
         share = ctl.batch_share()
+        with self._cv:
+            pipeline = {
+                "depth": self._pipeline_depth(),
+                "inflight": len(self._inflight)
+                + (1 if self._collecting else 0),
+                "interleave": bool(
+                    get_config("serving_pipeline_interleave")
+                ),
+            }
         out["_totals"] = {
             "batches": self._batches,
             "queued": self._queued,
             "pinned_bytes": self.registry.pinned_bytes(),
             "slow_traces": n_slow,
+            "pipeline": pipeline,
             "controller": {
                 "enabled": ctl.enabled(),
                 # contested dispatch rounds split credit-weighted:
@@ -623,6 +682,34 @@ class ServingServer:
             out["_totals"]["utilization"] = util
         return out
 
+    def pipeline_info(self) -> Dict[str, Any]:
+        """The staged pipeline's operator view (`GET /v1/pipeline`):
+        resolved depth (explicit conf or auto), the conf value it came
+        from, live slot occupancy, the interleave flag, and the serving
+        utilization window — busy fraction plus the idle-gap table the
+        depth-tuning guidance in docs/serving.md keys off."""
+        with self._cv:
+            out: Dict[str, Any] = {
+                "depth": self._pipeline_depth(),
+                "depth_conf": int(
+                    get_config("serving_pipeline_depth") or 0
+                ),
+                "inflight": len(self._inflight)
+                + (1 if self._collecting else 0),
+                "interleave": bool(
+                    get_config("serving_pipeline_interleave")
+                ),
+                "batches": self._batches,
+            }
+        from ..telemetry import utilization
+
+        util = utilization.summarize(
+            window_s=_UTILIZATION_WINDOW_S, domain="serving"
+        )
+        if util:
+            out["utilization"] = util
+        return out
+
     def model_detail(self, name: str) -> Dict[str, Any]:
         """Everything about ONE served model — pin status and accounted
         bytes, the latency/SLO report entry, and the drift summary (the
@@ -630,7 +717,7 @@ class ServingServer:
         (a dashboard polling every model must not pay a full all-model
         report per request).  KeyError for unknown names."""
         info = self.registry.pin_info(name)  # KeyError gate
-        entry = self._model_entry(name, self.registry.pinned_names())
+        entry = self._model_entry(name)
         return {"model": name, **info, **entry}
 
     # -- sizing --------------------------------------------------------------
@@ -693,6 +780,24 @@ class ServingServer:
             cap = max(1, int(cap * scale))
         return cap
 
+    def _cap_wait(
+        self, name: str, info: Optional[Dict[str, Any]]
+    ) -> tuple:
+        """Effective (cap, max_wait_s) for one model in ONE controller
+        lock acquisition (`controller.scales`).  The coalesce scan reads
+        both per queued model per round — at hundreds of pinned models
+        the separate `cap_scale`/`wait_scale` reads would double the
+        hot-path lock traffic, and a controller tick landing between
+        them could pair an old cap with a new wait.  Scale changes
+        therefore apply at the NEXT coalesce, atomically, never to a
+        batch mid-flight."""
+        cap_scale, wait_scale = self._controller.scales(name)
+        cap = self._base_cap(info)
+        if cap_scale < 1.0:
+            cap = max(1, int(cap * cap_scale))
+        wait = max(0.0, float(get_config("serving_max_wait_ms"))) / 1e3
+        return cap, wait * wait_scale
+
     def _oom_floor(self) -> int:
         """Smallest useful coalescing cap: one row per active device
         (the same floor the transform chunk loop shrinks to)."""
@@ -700,23 +805,85 @@ class ServingServer:
 
         return max(1, len(active_devices()))
 
+    # -- pipeline depth ------------------------------------------------------
+
+    def _pipeline_depth(self) -> int:
+        """How many dispatched batches may occupy pipeline slots at
+        once.  Explicit `serving_pipeline_depth` values clamp to
+        [1, _MAX_PIPELINE_DEPTH] (1 = fully serialized, the byte-parity
+        baseline); 0 resolves automatically from the serving idle-gap
+        profile.  Called under the cv (the memo/gauge state rides the
+        dispatcher)."""
+        raw = int(get_config("serving_pipeline_depth") or 0)
+        if raw >= 1:
+            depth = min(raw, _MAX_PIPELINE_DEPTH)
+        else:
+            depth = self._auto_depth()
+        if depth != self._depth_last:
+            self._depth_last = depth
+            PIPELINE_DEPTH.set(depth)
+        return depth
+
+    def _auto_depth(self) -> int:
+        """Auto depth from the utilization timeline: start at 2 (the
+        classic collect-N-while-dispatching-N+1 overlap) and deepen
+        while the gap table says host-side serving phases are stealing
+        device-idle seconds — >10% of the observed wall buys one extra
+        slot, >25% a second, bounded by `serving_pipeline_max_depth`.
+        Rate-limited by `_DEPTH_REFRESH_S`; never raises (the profile
+        is advice, not a dependency)."""
+        now = time.monotonic()
+        ts, memo = self._auto_memo
+        if now - ts < _DEPTH_REFRESH_S:
+            return memo
+        depth = 2
+        try:
+            from ..telemetry import utilization
+
+            util = utilization.summarize(
+                window_s=_UTILIZATION_WINDOW_S, domain="serving"
+            )
+            wall = float(util.get("wall_s", 0.0)) if util else 0.0
+            if wall > 0:
+                host_stolen = sum(
+                    float(row.get("stolen_s", 0.0))
+                    for row in util.get("gap_attribution", ())
+                    if row.get("kind") in (
+                        "dispatch", "stage", "compute", "collect",
+                        "scatter", "host_prep",
+                    )
+                )
+                frac = host_stolen / wall
+                if frac > 0.10:
+                    depth += 1
+                if frac > 0.25:
+                    depth += 1
+            cap = max(2, int(get_config("serving_pipeline_max_depth")))
+            depth = min(depth, cap)
+        except Exception:
+            depth = 2
+        self._auto_memo = (now, depth)
+        return depth
+
     # -- dispatcher ----------------------------------------------------------
 
     def _ready_name_locked(self, now: float, draining: bool) -> Optional[str]:
         """The queued model whose head request is due: past the (AIMD-
         scaled, per-model) max-wait SLO, a full batch already queued, or
-        the server draining.  Per priority class the oldest due head
-        wins, so no model starves behind a hot one; when BOTH classes
-        hold a due head the controller's weighted credit picks the
-        class — batch gets `serving_batch_share` credit per interactive
-        win, so neither class starves the other."""
-        due: Dict[str, tuple] = {}  # class -> (t_enqueue, name)
+        the server draining.  When BOTH classes hold a due head the
+        controller's weighted credit picks the class — batch gets
+        `serving_batch_share` credit per interactive win, so neither
+        class starves the other.  Within the chosen class, the
+        `serving_pipeline_interleave` round-robin rotates across ALL
+        due models (no model starves behind a hot one AND no hot model
+        monopolizes consecutive pipeline slots); with the interleave
+        off, the oldest due head wins outright."""
+        due: Dict[str, List[tuple]] = {}  # class -> [(t_enqueue, name)]
         for name, by_cls in self._queues.items():
             if not any(by_cls.values()):
                 continue
             info = self._safe_info(name)
-            cap = self._batch_cap(name, info)
-            wait = self._max_wait_s(name)
+            cap, wait = self._cap_wait(name, info)
             rows = 0
             full = False
             for cls in PRIORITY_CLASSES:
@@ -739,16 +906,30 @@ class ServingServer:
                     or full
                 )
                 if ready:
-                    best = due.get(cls)
-                    if best is None or head.t_enqueue < best[0]:
-                        due[cls] = (head.t_enqueue, name)
+                    due.setdefault(cls, []).append((head.t_enqueue, name))
         if not due:
             return None
         if len(due) == 1:
-            return next(iter(due.values()))[1]
-        if not self._controller.enabled():
-            return min(due.values())[1]  # plain oldest-head-first
-        return due[self._controller.pick_class()][1]
+            cls = next(iter(due))
+        elif not self._controller.enabled():
+            # plain oldest-head-first across classes
+            cls = min((min(v), c) for c, v in due.items())[1]
+        else:
+            cls = self._controller.pick_class()
+        entries = due[cls]
+        if len(entries) == 1 or not bool(
+            get_config("serving_pipeline_interleave")
+        ):
+            return min(entries)[1]
+        # cyclic pick: the first due name (sorted order) strictly after
+        # the last model this class dispatched, wrapping to the start —
+        # per-model FIFO is untouched (each model's class deque still
+        # drains front-first), only the CROSS-model order rotates
+        names = sorted({n for _, n in entries})
+        last = self._rr_last.get(cls, "")
+        choice = next((n for n in names if n > last), names[0])
+        self._rr_last[cls] = choice
+        return choice
 
     def _take_batch_locked(self, name: str) -> List[_Request]:
         by_cls = self._queues[name]
@@ -817,15 +998,58 @@ class ServingServer:
         )
 
     def _loop(self) -> None:
-        pending: Optional[_InFlight] = None
+        # the staged pipeline's two threads: THIS thread coalesces,
+        # stages and launches device programs; the collect worker drains
+        # finished flights (fetch + scatter).  The worker adopts the
+        # dispatcher's (already-adopted) trace buffer, so one batch's
+        # dispatch->collect span tree stays one tree no matter which
+        # thread recorded which half.
+        with self._cv:
+            self._collect_stop = False
+        adopt = adopt_trace_context()
+
+        def _collector() -> None:
+            adopt()
+            self._collect_loop()
+
+        collector = threading.Thread(
+            target=_collector, name="serving-collect", daemon=True
+        )
+        collector.start()
         while True:
             batch: Optional[List[_Request]] = None
+            fault: Optional[tuple] = None
             with self._cv:
                 while True:
                     now = time.perf_counter()
+                    # a collect-side failure outranks new work: consume
+                    # the handback (plus any flight that raced in after
+                    # the worker filled it — its requests are LATER in
+                    # FIFO order than the failed ones, so letting it
+                    # complete would reorder a model's class queue) and
+                    # recover outside the cv
+                    if self._pipe_fault is not None:
+                        e, reqs = self._pipe_fault
+                        self._pipe_fault = None
+                        reqs = list(reqs)
+                        for fl in self._inflight:
+                            reqs.extend(fl.reqs)
+                        self._inflight.clear()
+                        PIPELINE_INFLIGHT.set(
+                            1 if self._collecting else 0
+                        )
+                        self._cv.notify_all()
+                        fault = (e, reqs)
+                        break
                     draining = not self._running
+                    depth = self._pipeline_depth()
+                    slots = len(self._inflight) + (
+                        1 if self._collecting else 0
+                    )
+                    blocked = slots >= depth
                     name = (
-                        None if self._paused and self._running
+                        None
+                        if blocked or (self._paused and self._running)
                         else self._ready_name_locked(now, draining)
                     )
                     if name is not None:
@@ -835,15 +1059,23 @@ class ServingServer:
                         # frozen at the last idle overshoot would hide
                         # exactly the lag the controller acts on
                         DISPATCH_LAG.set(self._lag_locked(name, now))
-                        # `or None`: a queue of nothing-but-cancelled
-                        # requests yields an empty take — loop back
                         batch = self._take_batch_locked(name) or None
+                        if batch is None:
+                            # nothing but cancelled requests: re-scan
+                            continue
                         break
-                    if pending is not None:
-                        break  # collect finished work instead of idling
-                    if draining and self._queued == 0:
+                    if (
+                        draining and self._queued == 0
+                        and not self._inflight and not self._collecting
+                    ):
                         break
-                    t_wait = self._next_deadline_locked(now)
+                    # with the pipeline full the head deadline is moot
+                    # (no slot to dispatch into); wait for the worker's
+                    # slot-free notify instead of spinning on it
+                    t_wait = (
+                        0.5 if blocked
+                        else self._next_deadline_locked(now)
+                    )
                     if not self._cv.wait(timeout=t_wait):
                         # timed-out idle tick: break to the outer loop so
                         # _refresh_slo_all runs (burn gauges must decay
@@ -862,48 +1094,101 @@ class ServingServer:
                             )
                         )
                         break
-            if batch is None and pending is None:
+            if fault is not None:
+                self._recover_guarded(fault[0], list(fault[1]))
+                self._controller_tick()
+                continue
+            if batch is None:
                 with self._cv:
-                    if not self._running and self._queued == 0:
+                    if (
+                        not self._running and self._queued == 0
+                        and not self._inflight and not self._collecting
+                        and self._pipe_fault is None
+                    ):
                         # final exit decision under the cv: start() reads
                         # _loop_done under the same lock, so revive and
                         # exit cannot interleave into a dead server
+                        self._collect_stop = True
                         self._loop_done = True
-                        return
+                        self._cv.notify_all()
+                        collector_done = True
+                    else:
+                        collector_done = False
+                if collector_done:
+                    collector.join(timeout=10.0)
+                    return
                 self._refresh_slo_all()
                 self._controller_tick()
                 continue
-            # phase-separated failure attribution: a dispatch error
-            # belongs to THIS batch only — the pending batch of a
-            # (possibly different) model is already computing and stays
-            # in flight to collect next round, so a fatal error for one
-            # model can never fail another model's healthy work
-            current: Optional[_InFlight] = None
-            phase = "dispatch"
+            # a dispatch error belongs to THIS batch only — earlier
+            # flights are already computing and stay in the pipeline for
+            # the worker to collect, so a fatal error for one model can
+            # never fail another model's healthy in-flight work
             try:
-                current = self._dispatch(batch) if batch else None
-                phase = "collect"
-                if pending is not None:
-                    self._collect(pending)
-                    self._batches += 1
-                    self._note_clean_batch()
-                pending = current
+                flight = self._dispatch(batch)
             except Exception as e:
-                if phase == "dispatch":
-                    recover = list(batch or [])
-                else:
-                    # the fetch is the shared sync point: both in-flight
-                    # batches are suspect and re-dispatch from the queue
-                    recover = list(pending.reqs)
-                    if current is not None:
-                        recover.extend(current.reqs)
-                    pending = None
-                self._recover_guarded(e, recover)
-            # feedback step AFTER the round's dispatch/collect: the
-            # busy path must tick too — an overloaded dispatcher never
-            # reaches the idle branch, and that is exactly when control
-            # matters (rate-limited inside, so the hot loop pays ~0)
+                self._recover_guarded(e, list(batch))
+            else:
+                with self._cv:
+                    self._inflight.append(flight)
+                    PIPELINE_INFLIGHT.set(
+                        len(self._inflight)
+                        + (1 if self._collecting else 0)
+                    )
+                    self._cv.notify_all()
+            # feedback step AFTER the round's dispatch: the busy path
+            # must tick too — an overloaded dispatcher never reaches
+            # the idle branch, and that is exactly when control matters
+            # (rate-limited inside, so the hot loop pays ~0)
             self._controller_tick()
+
+    def _collect_loop(self) -> None:
+        """The collect worker: pop the oldest in-flight batch, fetch +
+        scatter it, repeat.  Runs until the dispatcher's exit path sets
+        `_collect_stop` with the pipeline drained.  A collect failure
+        drains EVERY in-flight flight into one `_pipe_fault` handback
+        (requests in dispatch order — oldest first, so the dispatcher's
+        front-requeue preserves per-model/per-class FIFO) and parks
+        until the dispatcher consumes it; the worker itself never
+        recovers (recovery requeues and repins — dispatcher-side state
+        transitions)."""
+        while True:
+            with self._cv:
+                while not self._inflight or self._pipe_fault is not None:
+                    if (
+                        self._collect_stop
+                        and not self._inflight
+                        and self._pipe_fault is None
+                    ):
+                        return
+                    self._cv.wait(timeout=0.5)
+                flight = self._inflight.popleft()
+                # the popped flight still occupies a pipeline slot until
+                # its scatter finishes — without this, depth 1 would let
+                # the dispatcher stage batch N+1 while N scatters, and
+                # "fully serialized" would be a lie
+                self._collecting = True
+                PIPELINE_INFLIGHT.set(len(self._inflight) + 1)
+                self._cv.notify_all()
+            try:
+                self._collect(flight)
+            except Exception as e:
+                with self._cv:
+                    reqs = list(flight.reqs)
+                    for fl in self._inflight:
+                        reqs.extend(fl.reqs)
+                    self._inflight.clear()
+                    self._collecting = False
+                    PIPELINE_INFLIGHT.set(0)
+                    self._pipe_fault = (e, reqs)
+                    self._cv.notify_all()
+            else:
+                with self._cv:
+                    self._collecting = False
+                    self._batches += 1
+                    PIPELINE_INFLIGHT.set(len(self._inflight))
+                    self._cv.notify_all()
+                self._note_clean_batch()
 
     # -- dispatch / collect --------------------------------------------------
 
@@ -950,6 +1235,7 @@ class ServingServer:
     ) -> _InFlight:
         from ..parallel.mesh import RowStager
         from ..resilience import maybe_inject
+        from ..telemetry import utilization
 
         with run_context(prefix="batch") as batch_id:
             with trace(f"serving_dispatch[{name}]", logger):
@@ -969,9 +1255,14 @@ class ServingServer:
                     )
                 BATCH_ROWS.observe(rows, model=name)
                 if not pinned.device:
+                    t_c = time.perf_counter()
                     with trace("serving_compute", logger):
                         X = np.ascontiguousarray(X, dtype=pinned.dtype)
                         outs = pinned.transform_fn(X)
+                    utilization.note_interval(
+                        "compute", t_c, time.perf_counter(), cause=name,
+                        domain="serving",
+                    )
                     return _InFlight(
                         name, pinned.model, reqs, rows, None, None, outs,
                         t0, batch_id,
@@ -979,6 +1270,7 @@ class ServingServer:
                 # telemetry=False: the per-staging instrumentation (device
                 # census, dataset_stagings bump, byte prediction) is fit-
                 # scale bookkeeping a request-rate micro-batch must not pay
+                t_s = time.perf_counter()
                 with trace("serving_stage", logger):
                     # padding classes: force the {1,1.5}x2^k bucket grid
                     # (regardless of the global shape_bucketing conf) so
@@ -994,8 +1286,21 @@ class ServingServer:
                         telemetry=False,
                     )
                     Xs = st.stage(np.ascontiguousarray(X), pinned.dtype)
+                t_c = time.perf_counter()
+                utilization.note_interval(
+                    "stage", t_s, t_c, cause=name, domain="serving"
+                )
                 with trace("serving_compute", logger):
                     dev = pinned.model._transform_device(Xs)
+                # the compute window here is only the async LAUNCH; the
+                # device series (noted at collect) carries the real
+                # compute span.  It still matters for depth tuning: a
+                # launch stealing idle seconds means dispatch-side
+                # Python is the bottleneck, not the chips
+                utilization.note_interval(
+                    "compute", t_c, time.perf_counter(), cause=name,
+                    domain="serving",
+                )
         return _InFlight(
             name, pinned.model, reqs, rows, st, dev, None, t0, batch_id
         )
@@ -1010,8 +1315,15 @@ class ServingServer:
             self._collect_traced(flight)
 
     def _collect_traced(self, flight: _InFlight) -> None:
+        from ..resilience import maybe_inject
         from ..telemetry import utilization
 
+        # deterministic fault hook for the collect/scatter phase
+        # (docs/resilience.md `serving_collect`): fires on the collect
+        # worker while LATER batches may still be in flight behind this
+        # one — the mid-pipeline failure drill.  Every in-flight batch's
+        # requests ride the fault handback to the dispatcher's requeue.
+        maybe_inject("serving_collect")
         if flight.host_outs is not None:
             outs = flight.host_outs
         else:
@@ -1020,13 +1332,21 @@ class ServingServer:
                 outs = flight.model._fetch_transform_outputs(
                     flight.stager, flight.dev
                 )
+            t_fetched = time.perf_counter()
+            # the fetch wait + device->host transfer window: the collect
+            # worker's share of the gap table (a "collect" series
+            # stealing idle seconds = the worker, not depth, limits)
+            utilization.note_interval(
+                "collect", t_fetch, t_fetched, cause=flight.name,
+                domain="serving",
+            )
             # the window from the batch's dispatch to the fetch
             # completing is device-or-transfer activity: the serving
             # timeline's "device" series (host prep rode in at dispatch)
             utilization.note_interval(
                 "device",
                 min(flight.t_dispatch, t_fetch),
-                time.perf_counter(),
+                t_fetched,
                 cause=flight.name,
                 domain="serving",
             )
@@ -1076,6 +1396,13 @@ class ServingServer:
                     r.future.set_result(sl)
                 except Exception:
                     pass  # cancelled in the race window; result dropped
+        # the slice-and-resolve window ("scatter" series): stolen idle
+        # seconds here mean the futures' consumers are the gap, which
+        # more depth cannot buy back
+        utilization.note_interval(
+            "scatter", t_done, time.perf_counter(), cause=flight.name,
+            domain="serving",
+        )
         if slow_hits:
             self._capture_slow(flight, slow_hits)
         # drift monitor fold (monitor/): the batch's already-decoded
@@ -1297,19 +1624,26 @@ class ServingServer:
         """Success-driven cap recovery: after enough clean batches the
         OOM-shrunk coalescing cap doubles back toward the configured
         value — one transient OOM must not cap QPS for the rest of the
-        process (the memory pressure that caused it is long gone)."""
-        if self._shrunk_cap is None:
-            return
-        self._clean_batches += 1
-        if self._clean_batches < _CAP_REGROW_BATCHES:
-            return
-        self._clean_batches = 0
-        grown = self._shrunk_cap * 2
-        if grown >= int(get_config("serving_max_batch_rows")):
-            self._shrunk_cap = None
+        process (the memory pressure that caused it is long gone).
+        Runs on the collect worker; the cv guards the shrink state
+        against the dispatcher's `_recover` halving it concurrently
+        (callers never hold the cv — it is non-reentrant)."""
+        restored = False
+        with self._cv:
+            if self._shrunk_cap is None:
+                return
+            self._clean_batches += 1
+            if self._clean_batches < _CAP_REGROW_BATCHES:
+                return
+            self._clean_batches = 0
+            grown = self._shrunk_cap * 2
+            if grown >= int(get_config("serving_max_batch_rows")):
+                self._shrunk_cap = None
+                restored = True
+            else:
+                self._shrunk_cap = grown
+        if restored:
             logger.info("serving coalescing cap fully restored")
-        else:
-            self._shrunk_cap = grown
 
     def _recover(self, e: Exception, reqs: List[_Request]) -> None:
         """Policy-driven degradation for a failed dispatch/collect: the
@@ -1380,14 +1714,18 @@ class ServingServer:
                 from ..parallel.device_cache import clear_device_cache
 
                 clear_device_cache()
-                cap = self._shrunk_cap or max(
-                    1, int(get_config("serving_max_batch_rows"))
-                )
-                self._shrunk_cap = max(self._oom_floor(), cap // 2)
-                self._clean_batches = 0
+                # cv-guarded against the collect worker's clean-batch
+                # regrowth racing this halving (called cv-free here)
+                with self._cv:
+                    cap = self._shrunk_cap or max(
+                        1, int(get_config("serving_max_batch_rows"))
+                    )
+                    self._shrunk_cap = max(self._oom_floor(), cap // 2)
+                    self._clean_batches = 0
+                    shrunk = self._shrunk_cap
                 logger.warning(
                     "serving dispatch exhausted device memory; coalescing "
-                    f"cap shrunk to {self._shrunk_cap} rows"
+                    f"cap shrunk to {shrunk} rows"
                 )
             elif action == "device_loss":
                 from ..resilience.elastic import recover_from_device_loss

@@ -184,10 +184,14 @@ def _normalize_source(
             rows = -(-min(chunk_rows, n) // n_dev) * n_dev
             prep: Dict[str, Any] = {"s": 0.0, "iv": []}
             return (
+                # with_offsets: each chunk carries its GLOBAL first-row
+                # index, so offset-addressed host programs (the
+                # kmeans_sample reservoir) fill the same slots from the
+                # same rows at any process/reader count
                 iter_parquet_chunks(
                     source, features_col, features_cols,
                     label_col if needs_y else None, weight_col,
-                    rows, dtype, prep=prep,
+                    rows, dtype, prep=prep, with_offsets=True,
                 ),
                 prep,
             )
@@ -398,10 +402,16 @@ def _one_pass(
     try:
         with run_context(rid), compile_label("stat_programs"):
             hb = Heartbeat("stat_programs")
-            for cX, cy, cw, in prefetch_iter(chunks, _staging_depth()):
+            for item in prefetch_iter(chunks, _staging_depth()):
                 # the engine's fault site: a failure here fails the WHOLE
                 # pass; the retry restarts with fresh accumulators
                 maybe_inject("stat_program_step")
+                # parquet producers yield 4-tuples carrying the chunk's
+                # GLOBAL first-row offset (iter_parquet_chunks
+                # with_offsets); in-memory producers yield 3-tuples and
+                # the rank-local running offset is already global there
+                cX, cy, cw = item[0], item[1], item[2]
+                goff = item[3] if len(item) > 3 else None
                 chunk_rows = int(cX.shape[0])
                 ta = time.perf_counter()
 
@@ -417,7 +427,7 @@ def _one_pass(
                         None, chunk_rows, chunk_rows, dtype
                     )
                     ctx = {
-                        "offset": offset,
+                        "offset": offset if goff is None else goff,
                         "n_valid": int(np.count_nonzero(w_host > 0)),
                     }
                     for p in host_progs:
@@ -535,10 +545,13 @@ def _reduce_pass_across_processes(progs, popts, d, folded, rows):
     computes byte-identical results — the 2-process parity suite
     asserts describe() equality against a single-process run.
 
-    Note: host-step `ctx["offset"]` stays rank-local under sharded
-    ingest, so offset-addressed slot programs (kmeans_sample) merge
-    deterministically but sample per-rank strides rather than the
-    single-process global stride."""
+    Host-step `ctx["offset"]` is GLOBAL under sharded ingest (the
+    parquet producer labels every chunk with its first-row index in the
+    file — iter_parquet_chunks with_offsets), so offset-addressed slot
+    programs (kmeans_sample) fill the same reservoir slots from the
+    same rows at any process count and their merge is byte-identical
+    to the single-process fill (the 2-process parity suite asserts a
+    k-means fit equal against a 1-process run)."""
     import io
 
     from ..parallel.context import reduce_blob_list, reduce_host_arrays

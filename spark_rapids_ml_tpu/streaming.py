@@ -1594,8 +1594,9 @@ def kmeans_streaming_fit(
         raise ValueError(f"k={k} exceeds the dataset row count {n_total}")
     lo, hi = _process_row_range(n_total)
 
-    # ---- strided global subsample for seeding (every process contributes
-    # its rows at the same global stride, then all-gathers).  The
+    # ---- strided global subsample for seeding (every process fills the
+    # GLOBAL reservoir slots of its ingest range, then the slot-disjoint
+    # accumulators wire-merge in rank order on every rank).  The
     # collection runs as the registered `kmeans_sample` statistic
     # program (stats/programs.py): slot-disjoint per-chunk folds, so any
     # chunking assembles the identical sample (byte parity with the
@@ -1605,38 +1606,44 @@ def kmeans_streaming_fit(
     from .stats.engine import iter_chunk_accs
     from .stats.programs import get_program
 
-    sample = get_program("kmeans_sample").finalize(
-        iter_chunk_accs(
-            "kmeans_sample",
-            iter_chunks(
-                path, features_col, features_cols, None, weight_col,
-                chunk_rows, dtype, row_range=(lo, hi),
-            ),
-            d, dtype,
-            opts={"stride": stride, "cap": cap},
-            offset0=lo,
+    prog = get_program("kmeans_sample")
+    ks_opts = {"stride": stride, "cap": cap}
+    acc = iter_chunk_accs(
+        "kmeans_sample",
+        iter_chunks(
+            path, features_col, features_cols, None, weight_col,
+            chunk_rows, dtype, row_range=(lo, hi),
         ),
-        {},
+        d, dtype,
+        opts=ks_opts,
+        offset0=lo,
     )
+    if jax.process_count() > 1:
+        # merge the slot-disjoint per-rank reservoirs (each rank filled
+        # only the GLOBAL slots of its ingest range) in ascending rank
+        # order: every rank assembles the identical global sample,
+        # byte-for-byte the single-process fill.  The padded-allgather
+        # concatenation this replaces changed the sample SHAPE (and
+        # zero-row layout) with process count, which perturbed the
+        # seeding draws — 1p vs Np centers diverged (ROADMAP item-1
+        # leftover; parity asserted by tests/test_multihost_datapath)
+        import io
+
+        from .parallel.context import reduce_blob_list
+        from .stats.programs import merge_accs
+
+        buf = io.BytesIO()
+        np.savez(buf, **{f: np.asarray(v) for f, v in acc.items()})
+        states = []
+        for blob in reduce_blob_list("kmeans_seed_sample", buf.getvalue()):
+            with np.load(io.BytesIO(blob)) as z:
+                states.append({f: np.array(z[f]) for f in z.files})
+        acc = states[0]
+        for s in states[1:]:
+            acc = merge_accs(prog, acc, s, ks_opts)
+    sample = prog.finalize(acc, {})
     Xs_host = np.asarray(sample["X"], dtype)
     ws_host = np.asarray(sample["w"], np.float64)
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        counts = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray(Xs_host.shape[0], np.int64)
-            )
-        ).reshape(-1)
-        mx = int(counts.max())
-        padX = np.zeros((mx, d), dtype)
-        padX[: Xs_host.shape[0]] = Xs_host
-        padw = np.zeros((mx,))
-        padw[: ws_host.shape[0]] = ws_host
-        allX = np.asarray(multihost_utils.process_allgather(padX))
-        allw = np.asarray(multihost_utils.process_allgather(padw))
-        Xs_host = allX.reshape(-1, d)
-        ws_host = allw.reshape(-1)
     valid_s = ws_host > 0
     if valid_s.sum() < k:
         raise ValueError(

@@ -234,6 +234,17 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "serving_dispatcher_lag_seconds": {
         "kind": "gauge", "labels": (), "cardinality": 1,
     },
+    # staged dispatch pipeline (serving/server.py): the resolved
+    # in-flight depth (explicit conf or the auto value derived from the
+    # serving idle-gap profile) and the live batch occupancy across the
+    # stage/compute/collect/scatter stages — occupancy pinned at depth
+    # means the pipeline is full and depth is the throughput limiter
+    "serving_pipeline_depth": {
+        "kind": "gauge", "labels": (), "cardinality": 1,
+    },
+    "serving_pipeline_inflight": {
+        "kind": "gauge", "labels": (), "cardinality": 1,
+    },
     # serving control plane (serving/control.py, ROADMAP item 2's
     # actuator half): the AIMD controller's live actuator values per
     # model (the EFFECTIVE coalescing cap / max-wait after scaling),

@@ -9,8 +9,10 @@
 #   note_interval(kind, t0, t1, cause)   producers append labeled
 #       wall-clock intervals — "device" (the chip had work), "host_prep"
 #       (chunk decode/pad/cast), "stage" (host->device transfers),
-#       "dispatch"/"collect" (serving phases), "lock_wait" (contended
-#       named-lock acquires, telemetry/locks.py)
+#       "dispatch"/"collect" (serving aggregate phases) with
+#       "compute"/"scatter" sub-windows from the staged dispatch
+#       pipeline, "lock_wait" (contended named-lock acquires,
+#       telemetry/locks.py)
 #
 #   summarize(run_id=..., window_s=...)   folds them into
 #       `device_busy_fraction` plus a RANKED gap-attribution table: the
@@ -39,8 +41,22 @@ from typing import Any, Dict, List, Optional, Tuple
 from .registry import gauge
 
 # interval kinds producers may record; "device" is the busy series the
-# gaps are measured against, everything else is attribution evidence
-KINDS = ("device", "host_prep", "stage", "dispatch", "collect", "lock_wait")
+# gaps are measured against, everything else is attribution evidence.
+# "dispatch"/"collect" are the serving pipeline's aggregate phases;
+# "stage"/"compute"/"scatter" are its finer-grained sub-windows (the
+# depth-tuning evidence: stage/compute stealing gap seconds means a
+# deeper `serving_pipeline_depth` pays, scatter stealing means the
+# collect worker is the bottleneck)
+KINDS = (
+    "device",
+    "host_prep",
+    "stage",
+    "compute",
+    "dispatch",
+    "collect",
+    "scatter",
+    "lock_wait",
+)
 
 # retained intervals, process-wide: at fused-chunk granularity this is
 # hours of history; serving batches recycle it faster but a report only

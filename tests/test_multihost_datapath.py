@@ -526,6 +526,31 @@ _PARITY_WORKER = textwrap.dedent(
         r["distinct_count"]["distinct"]
     )]
 
+    # --- kmeans_sample reservoir through the sharded engine ingest ----
+    # each rank decodes only its row-group share; chunks carry GLOBAL
+    # first-row offsets (iter_parquet_chunks with_offsets), so every
+    # rank fills the same reservoir slots a single-process scan fills
+    ks = run_programs(
+        ["kmeans_sample"], ppath, features_col="features",
+        dtype=np.float64,
+        opts={"kmeans_sample": {"stride": 7, "cap": (500 - 1) // 7 + 1}},
+    )["kmeans_sample"]
+    out["kmeans_sample"] = {
+        "X": hexd(ks["X"]), "w": hexd(ks["w"]), "count": int(ks["count"]),
+    }
+
+    # --- streaming k-means fit: global-slot seeding, merged sample ----
+    # integer-valued f64 rows keep the Lloyd sums/counts exact, so the
+    # centers must come out byte-identical at any process count; cost
+    # accumulates in f32 chunk order and is NOT compared
+    from spark_rapids_ml_tpu.streaming import kmeans_streaming_fit
+    km = kmeans_streaming_fit(
+        ppath, "features", (), None, k=4, seed=7, max_iter=8,
+        dtype=np.float64, chunk_rows=CHUNK, init_rows=150,
+    )
+    out["kmeans_centers"] = hexd(km["centers"])
+    out["kmeans_n_iter"] = int(km["n_iter"])
+
     if pid == 0:
         with open(outfile, "w") as f:
             json.dump(out, f)
@@ -563,3 +588,6 @@ def test_two_process_fused_parity_byte_identical(
     assert multi["describe"] == single["describe"]
     assert multi["frequent"] == single["frequent"]
     assert multi["distinct"] == single["distinct"]
+    assert multi["kmeans_sample"] == single["kmeans_sample"]
+    assert multi["kmeans_centers"] == single["kmeans_centers"]
+    assert multi["kmeans_n_iter"] == single["kmeans_n_iter"]

@@ -1,0 +1,449 @@
+#
+# Staged serving pipeline (spark_rapids_ml_tpu/serving/server.py) — the
+# deep in-flight dispatch path: byte parity pipelined vs depth-1 on
+# identical traffic, per-model FIFO preserved under round-robin
+# interleave, mid-pipeline fault recovery (OOM at dispatch, device loss
+# at collect) requeueing without loss, controller cap changes applying
+# at the next coalesce, depth auto-resolution bounds, the serving
+# utilization windows, and the registry-at-scale surfaces (O(1) pin
+# probes, incremental byte accounting, batched LRU eviction).
+#
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_ml_tpu.classification import LogisticRegression
+from spark_rapids_ml_tpu.config import reset_config, set_config
+from spark_rapids_ml_tpu.feature import PCA
+from spark_rapids_ml_tpu.resilience import fault_inject
+from spark_rapids_ml_tpu.resilience.elastic import reset_elastic
+from spark_rapids_ml_tpu.serving import ServingServer
+from spark_rapids_ml_tpu.serving.registry import PINS
+
+_D = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_config()
+    set_config(retry_backoff_s=0.01, retry_jitter=0.0)
+    yield
+    reset_config()
+    reset_elastic()
+    from spark_rapids_ml_tpu.parallel.device_cache import get_device_cache
+
+    cache = get_device_cache()
+    for tag in list(cache._external):
+        cache.release_external(tag)
+
+
+@pytest.fixture(scope="module")
+def rng_m():
+    return np.random.default_rng(11)
+
+
+@pytest.fixture(scope="module")
+def pca_model(rng_m):
+    X = rng_m.normal(size=(300, _D)).astype(np.float32)
+    df = pd.DataFrame({"features": list(X)})
+    return PCA(k=3).setInputCol("features").setOutputCol("proj").fit(df)
+
+
+@pytest.fixture(scope="module")
+def logreg_model(rng_m):
+    X = rng_m.normal(size=(300, _D)).astype(np.float32)
+    y = (X[:, 0] - 0.3 * X[:, 1] > 0).astype(np.float32)
+    df = pd.DataFrame({"features": list(X), "label": y})
+    return LogisticRegression(maxIter=25).fit(df)
+
+
+def _serve(**models) -> ServingServer:
+    server = ServingServer()
+    for name, model in models.items():
+        server.register(name, model)
+    return server.start()
+
+
+def _q(rng, n=1, d=_D):
+    return rng.normal(size=(n, d)).astype(np.float32)
+
+
+def _run_traffic(server, name, rows):
+    """Queue `rows` while paused, release, gather outputs by index."""
+    server.pause()
+    futs = [server.submit(name, r) for r in rows]
+    server.resume()
+    return [f.result(timeout=120) for f in futs]
+
+
+# ---------------------------------------------------------------------------
+# parity: pipelined output == depth-1 output, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_byte_parity_vs_depth1(pca_model, rng):
+    """The SAME traffic at depth=4 and depth=1 produces byte-identical
+    per-request outputs (and both match the direct transform): deeper
+    in-flight overlap must never change a single bit."""
+    rows = [_q(rng, 1 + (i % 3)) for i in range(24)]
+    set_config(serving_pipeline_depth=1, serving_max_batch_rows=4)
+    server = ServingServer()
+    server.register("par", pca_model)
+    server.start()
+    try:
+        base = _run_traffic(server, "par", rows)
+    finally:
+        server.stop()
+
+    set_config(serving_pipeline_depth=4, serving_max_batch_rows=4)
+    server = ServingServer()
+    server.register("par", pca_model)
+    server.start()
+    try:
+        piped = _run_traffic(server, "par", rows)
+    finally:
+        server.stop()
+
+    for r, b, p in zip(rows, base, piped):
+        ref = pca_model._transform_array(r)["proj"]
+        assert np.array_equal(b["proj"], ref)
+        assert np.array_equal(p["proj"], ref)
+        assert b["proj"].tobytes() == p["proj"].tobytes()
+
+
+def test_multi_model_interleave_parity(pca_model, logreg_model, rng):
+    """Two models' interleaved batches under a deep pipeline still
+    answer exactly; each model's outputs match its direct transform."""
+    set_config(
+        serving_pipeline_depth=4, serving_pipeline_interleave=True,
+        serving_max_batch_rows=2,
+    )
+    server = _serve(ia=pca_model, ib=logreg_model)
+    try:
+        server.pause()
+        rows_a = [_q(rng, 1) for _ in range(8)]
+        rows_b = [_q(rng, 1) for _ in range(8)]
+        futs_a = [server.submit("ia", r) for r in rows_a]
+        futs_b = [server.submit("ib", r) for r in rows_b]
+        server.resume()
+        for r, f in zip(rows_a, futs_a):
+            ref = pca_model._transform_array(r)["proj"]
+            assert np.array_equal(f.result(timeout=120)["proj"], ref)
+        for r, f in zip(rows_b, futs_b):
+            ref = logreg_model._transform_array(r)
+            out = f.result(timeout=120)
+            for col in ref:
+                assert np.array_equal(out[col], ref[col]), col
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# ordering
+# ---------------------------------------------------------------------------
+
+
+def test_per_model_fifo_preserved_under_interleave(pca_model, rng):
+    """Round-robin interleave alternates MODELS, never reorders one
+    model's FIFO: with 1-row batches, each model's requests complete in
+    submission order."""
+    set_config(
+        serving_max_batch_rows=1,  # every request is its own batch
+        serving_pipeline_depth=3,
+        serving_pipeline_interleave=True,
+    )
+    server = _serve(fa=pca_model, fb=pca_model)
+    try:
+        # warm both compiled programs so completion stamps measure
+        # scatter order, not first-call compilation
+        server.transform("fa", _q(rng), timeout=60)
+        server.transform("fb", _q(rng), timeout=60)
+        server.pause()
+        stamps = {}
+
+        def _stamp(key):
+            return lambda f: stamps.__setitem__(key, time.perf_counter())
+
+        futs = []
+        for i in range(6):
+            for name in ("fa", "fb"):
+                f = server.submit(name, _q(rng))
+                f.add_done_callback(_stamp((name, i)))
+                futs.append(f)
+        server.resume()
+        for f in futs:
+            f.result(timeout=120)
+        for name in ("fa", "fb"):
+            order = [stamps[(name, i)] for i in range(6)]
+            assert order == sorted(order), (
+                f"{name} completed out of submission order: {order}"
+            )
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# mid-pipeline fault recovery
+# ---------------------------------------------------------------------------
+
+
+def test_oom_mid_pipeline_requeues_without_loss(pca_model, rng):
+    """An OOM with multiple batches in flight: the affected requests
+    requeue, EVERY future completes with the exact answer, and the
+    coalescing cap halves."""
+    set_config(serving_pipeline_depth=4, serving_max_batch_rows=2)
+    server = _serve(poom=pca_model)
+    try:
+        rows = [_q(rng, 1) for _ in range(16)]
+        server.pause()
+        futs = [server.submit("poom", r) for r in rows]
+        with fault_inject("serving_dispatch", "oom", times=1):
+            server.resume()
+            outs = [f.result(timeout=120) for f in futs]
+        assert len(outs) == 16
+        for r, o in zip(rows, outs):
+            assert np.array_equal(
+                o["proj"], pca_model._transform_array(r)["proj"]
+            )
+        assert server._shrunk_cap is not None
+    finally:
+        server.stop()
+
+
+def test_collect_fault_mid_pipeline_requeues_without_loss(pca_model, rng):
+    """A failure on the COLLECT side (the async worker fetching device
+    results) hands every in-flight batch's requests back to the
+    dispatcher: none lost, none answered twice, all exact."""
+    from spark_rapids_ml_tpu.resilience.retry import RETRIES
+
+    set_config(serving_pipeline_depth=4, serving_max_batch_rows=2)
+    server = _serve(pcol=pca_model)
+    try:
+        r0 = RETRIES.value(label="serving_dispatch", action="oom")
+        rows = [_q(rng, 1) for _ in range(16)]
+        server.pause()
+        futs = [server.submit("pcol", r) for r in rows]
+        with fault_inject("serving_collect", "oom", times=1):
+            server.resume()
+            outs = [f.result(timeout=120) for f in futs]
+        assert len(outs) == 16
+        for r, o in zip(rows, outs):
+            assert np.array_equal(
+                o["proj"], pca_model._transform_array(r)["proj"]
+            )
+        assert RETRIES.value(label="serving_dispatch", action="oom") > r0
+    finally:
+        server.stop()
+
+
+def test_device_lost_mid_pipeline_repins_and_drains(pca_model, rng):
+    """Device loss with a full pipeline: elastic recovery shrinks the
+    mesh, pinned models re-pin, and every queued + in-flight request
+    completes on the survivors."""
+    from spark_rapids_ml_tpu.parallel.mesh import active_devices
+
+    n_before = len(active_devices())
+    set_config(serving_pipeline_depth=4, serving_max_batch_rows=2)
+    server = _serve(pdl=pca_model)
+    try:
+        rows = [_q(rng, 1) for _ in range(16)]
+        server.pause()
+        futs = [server.submit("pdl", r) for r in rows]
+        with fault_inject("serving_dispatch", "device_lost", times=1):
+            server.resume()
+            outs = [f.result(timeout=120) for f in futs]
+        assert len(outs) == 16
+        assert len(active_devices()) == n_before - 1
+        assert PINS.value(model="pdl", event="repin") >= 1
+        for r, o in zip(rows, outs):
+            ref = pca_model._transform_array(r)["proj"]
+            np.testing.assert_allclose(o["proj"], ref, rtol=1e-5)
+    finally:
+        server.stop()
+        reset_elastic()
+
+
+def test_brownout_composes_with_pipeline(pca_model, rng):
+    """Controller on, deep pipeline, mixed-class burst: every ADMITTED
+    request completes exactly — degradation machinery and in-flight
+    batches compose without losing or reordering work."""
+    set_config(
+        serving_pipeline_depth=4,
+        serving_controller_interval_s=0.05,
+        serving_max_batch_rows=4,
+    )
+    server = _serve(bo=pca_model)
+    try:
+        server.pause()
+        rows = [_q(rng, 1) for _ in range(24)]
+        futs = [
+            server.submit(
+                "bo", r,
+                priority="batch" if i % 3 == 0 else "interactive",
+            )
+            for i, r in enumerate(rows)
+        ]
+        server.resume()
+        for r, f in zip(rows, futs):
+            out = f.result(timeout=120)
+            assert np.array_equal(
+                out["proj"], pca_model._transform_array(r)["proj"]
+            )
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# controller changes apply at the next coalesce
+# ---------------------------------------------------------------------------
+
+
+def test_cap_change_applies_at_next_coalesce_no_torn_batch(pca_model, rng):
+    """A cap change while requests are queued applies when the NEXT
+    batch coalesces — 8 one-row requests under cap=4 dispatch as
+    exactly 2 whole batches, never a torn split from a stale cap."""
+    set_config(serving_max_batch_rows=64, serving_pipeline_depth=1)
+    server = _serve(cap=pca_model)
+    try:
+        server.transform("cap", _q(rng), timeout=60)  # warm the program
+        server.pause()
+        futs = [server.submit("cap", _q(rng)) for _ in range(8)]
+        set_config(serving_max_batch_rows=4)  # applies at next coalesce
+        b0 = server._batches
+        server.resume()
+        for f in futs:
+            f.result(timeout=120)
+        assert server._batches - b0 == 2
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# depth resolution
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_depth_bounds(pca_model):
+    server = ServingServer()
+    server.register("dep", pca_model)
+    # explicit depth: clamped to the hard module cap
+    import spark_rapids_ml_tpu.serving.server as srv_mod
+
+    set_config(serving_pipeline_depth=99)
+    assert server._pipeline_depth() == srv_mod._MAX_PIPELINE_DEPTH
+    set_config(serving_pipeline_depth=1)
+    assert server._pipeline_depth() == 1
+    # auto: bounded by [2, serving_pipeline_max_depth]
+    set_config(serving_pipeline_depth=0, serving_pipeline_max_depth=3)
+    d = server._pipeline_depth()
+    assert 2 <= d <= 3
+    server.registry.clear()
+
+
+def test_pipeline_info_and_report_surface(pca_model, rng):
+    set_config(serving_pipeline_depth=3)
+    server = _serve(pinfo=pca_model)
+    try:
+        server.transform("pinfo", _q(rng, 2), timeout=60)
+        info = server.pipeline_info()
+        assert info["depth"] == 3
+        assert info["depth_conf"] == 3
+        assert info["interleave"] is True
+        assert info["inflight"] == 0  # idle after the request drained
+        assert info["batches"] >= 1
+        rep = server.report()
+        assert rep["_totals"]["pipeline"]["depth"] == 3
+    finally:
+        server.stop()
+
+
+def test_serving_utilization_windows_recorded(pca_model, rng):
+    """The staged windows land on the utilization timeline under the
+    serving domain: stage + compute + collect + scatter all present
+    after device-path traffic."""
+    from spark_rapids_ml_tpu.telemetry import utilization
+
+    server = _serve(util=pca_model)
+    try:
+        for _ in range(6):
+            server.transform("util", _q(rng, 2), timeout=60)
+        evs = utilization.timeline(window_s=60.0, domain="serving")
+        kinds = {e[1] for e in evs}
+        for kind in ("stage", "compute", "collect", "scatter", "dispatch"):
+            assert kind in kinds, (kind, sorted(kinds))
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# registry at scale
+# ---------------------------------------------------------------------------
+
+
+def test_registry_o1_probe_and_incremental_bytes(pca_model, logreg_model):
+    server = ServingServer()
+    server.register("ra", pca_model)
+    server.register("rb", logreg_model)
+    reg = server.registry
+    assert reg.is_pinned("ra") and reg.is_pinned("rb")
+    assert not reg.is_pinned("nope")
+    expect = reg.resolve("ra").nbytes + reg.resolve("rb").nbytes
+    assert reg.pinned_bytes() == expect
+    reg.unregister("ra")
+    assert not reg.is_pinned("ra")
+    assert reg.pinned_bytes() == reg.resolve("rb").nbytes
+    reg.clear()
+    assert reg.pinned_bytes() == 0
+
+
+def test_batched_eviction_covers_shortfall_in_one_pass(pca_model):
+    """Pins that stop fitting evict in ONE batched pass: shrinking the
+    budget under three resident pins, the next pin displaces all three
+    victims at once and lands alone."""
+    server = ServingServer()
+    server.register("ba", pca_model)
+    nbytes = server.registry.resolve("ba").nbytes
+    server.register("bb", pca_model)
+    server.register("bc", pca_model)
+    assert server.registry.pinned_bytes() == 3 * nbytes
+    # room for ~1.5 pins: the fourth pin needs every earlier one gone
+    set_config(device_cache_bytes=int(nbytes * 1.5))
+    server.register("bd", pca_model)
+    assert server.registry.pinned_names() == ["bd"]
+    assert server.registry.pinned_bytes() == nbytes
+    for name in ("ba", "bb", "bc"):
+        assert PINS.value(model=name, event="evict") >= 1
+    server.registry.clear()
+
+
+def test_release_external_many_batched_ledger():
+    from spark_rapids_ml_tpu.parallel.device_cache import get_device_cache
+
+    cache = get_device_cache()
+    for i in range(3):
+        assert cache.reserve_external(f"t:{i}", 1024)
+    freed = cache.release_external_many([f"t:{i}" for i in range(3)] + ["t:x"])
+    assert freed == 3 * 1024
+    assert cache.release_external_many([f"t:{i}" for i in range(3)]) == 0
+
+
+def test_interleave_off_keeps_oldest_head_order(pca_model, rng):
+    """With interleave disabled the dispatcher keeps the pre-pipeline
+    oldest-head-first behavior — a pure conf rollback path."""
+    set_config(
+        serving_pipeline_interleave=False,
+        serving_max_batch_rows=1,
+        serving_pipeline_depth=2,
+    )
+    server = _serve(oa=pca_model, ob=pca_model)
+    try:
+        server.pause()
+        futs = [server.submit("oa", _q(rng)) for _ in range(3)]
+        futs += [server.submit("ob", _q(rng)) for _ in range(3)]
+        server.resume()
+        for f in futs:
+            assert f.result(timeout=120)["proj"].shape == (1, 3)
+    finally:
+        server.stop()
