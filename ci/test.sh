@@ -140,7 +140,7 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py \
-    tests/test_multihost_datapath.py "$@"
+    tests/test_multihost_datapath.py tests/test_pod_elastic.py "$@"
 # guard against a new test file silently missing from the batches: only
 # run_batch lines count as "listed" (not the --fast tier or comments),
 # and discovery recurses like `pytest tests/` did
@@ -184,6 +184,21 @@ echo "== fault-injection smoke: every recovery path on the CPU mesh =="
 # guard requires it there): this dedicated step keeps the recovery gate
 # visible and runnable in isolation even if the batches are resharded
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q
+
+echo "== pod chaos smoke: kill -9 one rank mid-pass, survivor byte parity =="
+# tier-1 marker-safe: a real 2-process jax.distributed fit where rank 1
+# is SIGKILLed inside its second fused accumulate.  Rank 0 must detect
+# the loss via the KV liveness table within pod_death_grace_s, advance
+# the reduction generation (zombie-rank safety), reassign the dead
+# rank's row-group share to itself, replay its OWN share from the chunk
+# cache, and finish with coefficients BYTE-identical to a fault-free
+# 1-process fit.  Self-skips via the require_coordination_cpu probe on
+# builds whose CPU coordination service can't host two ranks.
+# Intentionally ALSO in a tier-1 batch above (the batch-completeness
+# guard requires it there); this dedicated step keeps the chaos gate
+# visible and runnable in isolation.
+JAX_PLATFORMS=cpu WEDGE_GUARD_S=540 \
+    python -m pytest tests/test_pod_elastic.py -q -k chaos
 
 echo "== elastic-recovery smoke: device loss mid-Lloyd shrinks the mesh =="
 # tier-1 marker-safe: a device_lost injection at Lloyd iteration 4 of a

@@ -80,6 +80,21 @@ _DEFAULTS: Dict[str, Any] = {
     # computed from different inputs.  Costs one extra small allgather
     # per reduction.
     "multiproc_agreement_check": True,
+    # Pod-scale rank-loss recovery (resilience/pod.py): "on" shrinks the
+    # quorum to the surviving ranks when a peer process dies mid-pass
+    # (bumped reduction generation, dead rank's row-group shares
+    # reassigned, pass restarted with fresh accumulators); "off" keeps
+    # the prior behavior — every cross-process wait is still BOUNDED and
+    # raises a typed ReduceTimeout, but the failure is fatal.
+    "pod_elastic": "on",
+    # Seconds between liveness heartbeats each rank publishes into the
+    # coordination-service KV namespace while pod_elastic is on; also
+    # the slice at which bounded waits re-check peer liveness.
+    "pod_heartbeat_interval_s": 2.0,
+    # Straggler grace: a peer is declared DEAD only after its heartbeat
+    # has not advanced for this many seconds — a slow-but-beating rank
+    # is waited on to the full multiproc_reduce_timeout_s instead.
+    "pod_death_grace_s": 10.0,
     # Spark-DataFrame exchange: datasets estimated above this many bytes
     # are written by the EXECUTORS to `spark_exchange_dir` as parquet and
     # fit through the streaming-ingest path instead of `toPandas()`
@@ -180,9 +195,10 @@ _DEFAULTS: Dict[str, Any] = {
     # Deterministic fault injection (resilience/faults.py):
     # "site:kind[:times[:skip]]" comma list, e.g.
     # "fit_kernel:oom:1,transform_dispatch:timeout:1:2".  Kinds: oom,
-    # timeout, preemption, hang, device_lost.  Empty disables.  Tests use the
-    # `fault_inject` context manager instead; this conf arms sites for
-    # whole-process runs (CI smoke, bench rehearsals).
+    # timeout, preemption, hang, device_lost, rank_lost, kv_timeout.
+    # Empty disables.  Tests use the `fault_inject` context manager
+    # instead; this conf arms sites for whole-process runs (CI smoke,
+    # bench rehearsals).
     "fault_inject_spec": "",
     # Fused Pallas distance+top-k kernel for brute-force kNN (the cuVS
     # fusedL2Knn analog, ops/pallas_knn.py).  RETIRED from the default

@@ -515,16 +515,90 @@ def iter_parquet_chunks(
 
         return timed_iter(it, prep)
 
-    import jax
+    from .parallel.context import process_topology
+    from .resilience.pod import active_recovery_plan, record_pass_manifest
 
-    n_proc = jax.process_count()
+    # the TOPOLOGY view, not jax.process_count(): after a rank loss the
+    # pod layer shrinks the reduce group without tearing down the jax
+    # backend, and the ingest partition must follow the survivors
+    n_proc, pid = process_topology()
+
+    plan = active_recovery_plan()
+    plan_shares = (
+        process_row_group_shares(path, plan.share_n)
+        if plan is not None else None
+    )
+    if plan is not None and plan_shares is not None:
+        # RESUME under a rank-loss recovery plan: this survivor decodes
+        # the ORIGINAL share_n-way layout's shares the plan assigned it —
+        # its own pre-loss share (same stream key as the interrupted
+        # pass, so it replays from the chunk cache at epoch-2 cost) plus
+        # any share inherited from a dead rank (cache miss on first
+        # post-loss pass: parquet decode, cached for later passes).
+        # Every row of the file is covered exactly once across the
+        # survivors, which is all the commutative accumulators need for
+        # byte parity with a fault-free fit.
+        plan_starts = (
+            _share_row_starts(path, plan_shares) if with_offsets else None
+        )
+        entries = plan.assignments.get(pid, ())
+        record_pass_manifest(
+            path=str(path), tag=tag, share_n=plan.share_n,
+            generation=plan.generation,
+            assignments={
+                str(r): [list(e) for e in v]
+                for r, v in plan.assignments.items()
+            },
+        )
+
+        def _share_stream(share_idx: int, owner_boot: int):
+            # keyed by the ORIGINAL topology slot (share_n, owner boot
+            # rank): the survivor's own share reuses its pre-loss cache
+            # entries byte-for-byte
+            skey = _chunk_stream_key(
+                path, features_col, features_cols, label_col,
+                weight_col, chunk_rows, dtype, None, tag=tag,
+                topology=(plan.share_n, owner_boot),
+            )
+            groups = plan_shares[share_idx]
+
+            def _ssource():
+                if not groups:
+                    return iter(())
+                base = (
+                    plan_starts[share_idx] if with_offsets else None
+                )
+                return _range_chunks(
+                    path, features_col, features_cols, label_col,
+                    weight_col, chunk_rows, dtype, ldt, groups,
+                    base_offset=base,
+                )
+
+            # ordered=True: a vanished spill blob mid-serve degrades to
+            # source replay at the failed position instead of forcing a
+            # restart of an already-part-folded recovery pass
+            return cached_chunk_stream(
+                skey, _ssource, device_elem=0, serve_device=True,
+                ordered=True,
+            )
+
+        def _plan_chained():
+            for share_idx, owner_boot in entries:
+                yield from _share_stream(int(share_idx), int(owner_boot))
+
+        yield from _timed(_plan_chained())
+        return
+
     if n_proc > 1:
         # multi-host ingest partition: this process decodes ONLY its
         # deterministic row-group share (coverage-asserted); the
         # commutative accumulators make the resulting arbitrary global
         # chunk order irrelevant, and the per-rank chunk-stream key
         # keeps each host's cache holding only its own slice
-        pid = jax.process_index()
+        record_pass_manifest(
+            path=str(path), tag=tag, share_n=n_proc, generation=None,
+            assignments={str(pid): [[pid, pid]]},
+        )
         shares = process_row_group_shares(path, n_proc)
 
         def _source():
@@ -798,11 +872,15 @@ def accumulate_chunks(
             )
     _baseline.pass_complete()
     host = acc_to_host_f64(acc)
-    if jax.process_count() > 1:
+    from .parallel.context import process_topology
+
+    if process_topology()[0] > 1:
         # the pass_complete reduction: one global fold of the per-rank
         # f64 partials (rank-agreement-checked); everything downstream —
         # finalize, the solve — sees the same global statistics a
-        # single-process pass over the full data would produce
+        # single-process pass over the full data would produce.  Gated
+        # on the TOPOLOGY view so a post-rank-loss survivor group of one
+        # skips the reduce instead of waiting on the dead
         from .parallel.context import reduce_host_arrays
 
         host = reduce_host_arrays(host, "fused_pass")

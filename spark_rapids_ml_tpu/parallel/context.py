@@ -32,6 +32,42 @@ from .mesh import get_mesh
 
 _distributed_initialized = False
 
+# The EFFECTIVE process topology every cross-process seam gates on.
+# `jax.process_count()` is the BOOT view — the runtime caches it, and it
+# stays stale after a rank dies (tearing the backend down would
+# invalidate every live array).  Pod recovery (resilience/pod.py)
+# installs the surviving quorum here instead: reductions, share
+# partitioning, and cache keys all follow the override while the local
+# device mesh keeps the backend view.  None -> the jax view.
+_topology_override: "Optional[tuple]" = None
+
+
+def process_topology() -> "tuple[int, int]":
+    """(nranks, rank) as the data path should see it: the pod-recovery
+    override when one is installed (survivor quorum, or a simulated
+    topology from the `rank_lost` fault kind), the jax.distributed view
+    otherwise.  Every reduction gate and ingest-share computation reads
+    this instead of `jax.process_count()` directly."""
+    if _topology_override is not None:
+        return _topology_override
+    return int(jax.process_count()), int(jax.process_index())
+
+
+def topology_overridden() -> bool:
+    return _topology_override is not None
+
+
+def set_topology_override(nranks: int, rank: int) -> None:
+    global _topology_override
+    if not (0 <= int(rank) < int(nranks)):
+        raise ValueError(f"invalid topology override ({nranks}, {rank})")
+    _topology_override = (int(nranks), int(rank))
+
+
+def clear_topology_override() -> None:
+    global _topology_override
+    _topology_override = None
+
 
 class RankDivergenceError(RuntimeError):
     """The content fingerprints of a cross-process reduction disagree
@@ -147,6 +183,7 @@ def init_distributed(
     # initialize the XLA backend, after which distributed init is rejected
     if _distributed_initialized or _runtime_initialized():
         _distributed_initialized = True
+        _start_pod_liveness()
         return True
     coord = coordinator_address or get_config("coordinator_address")
     if coord:
@@ -162,6 +199,7 @@ def init_distributed(
             ),
         )
         _distributed_initialized = True
+        _start_pod_liveness()
         return True
     import os
 
@@ -186,7 +224,21 @@ def init_distributed(
         )
         return False
     _distributed_initialized = True
+    _start_pod_liveness()
     return True
+
+
+def _start_pod_liveness() -> None:
+    """Best-effort heartbeat bootstrap (resilience/pod.py): with
+    `pod_elastic` on, every rank beats from the moment distributed mode
+    comes up, so a peer killed before its first reduction is still
+    nameable by the survivors' liveness probe."""
+    try:
+        from ..resilience.pod import maybe_start_heartbeat
+
+        maybe_start_heartbeat()
+    except Exception:  # pragma: no cover - liveness must never block init
+        pass
 
 
 def shutdown_distributed() -> bool:
@@ -229,6 +281,16 @@ def reinit_distributed(
     global _reduce_backend_resolved
     _reduce_backend_resolved = None  # re-probe collectives on the new runtime
     globals().pop("_psum_probe_result", None)
+    # a re-bootstrap is a fresh quorum: the pod layer drops its recovery
+    # plan / topology override / liveness history and bumps the reduction
+    # GENERATION, so no KV key (or zombie write) from the previous
+    # bootstrap can bleed into the new one
+    try:
+        from ..resilience.pod import on_reinit
+
+        on_reinit()
+    except Exception:  # pragma: no cover - import-order defensive
+        pass
     coord = coordinator_address or get_config("coordinator_address")
     return init_distributed(
         coordinator_address=coord,
@@ -280,6 +342,23 @@ def _reduce_timeout_ms() -> int:
     return max(1, int(float(get_config("multiproc_reduce_timeout_s")) * 1000))
 
 
+def reset_kv_epoch() -> None:
+    """Drop every per-tag sequence counter: called on each generation
+    bump (resilience/pod.py) so the recovered quorum restarts its key
+    sequences at 0 inside the NEW generation's disjoint namespace."""
+    with _kv_lock:
+        _kv_seq.clear()
+
+
+def _gen_prefix() -> str:
+    # every KV key carries the reduction generation: a zombie rank that
+    # keeps writing after the quorum shrank lands its payloads in the
+    # dead generation's namespace, where no survivor ever reads
+    from ..resilience.pod import generation
+
+    return f"srmt/g{generation()}"
+
+
 def _kv_put(client, key: str, payload: bytes) -> None:
     # the KV store's string API is the one stable across the jaxlib
     # versions we support; base64 keeps arbitrary wire bytes intact
@@ -287,8 +366,21 @@ def _kv_put(client, key: str, payload: bytes) -> None:
     client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
 
 
-def _kv_take(client, key: str, timeout_ms: int) -> bytes:
-    return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+def _kv_take(
+    client,
+    key: str,
+    timeout_ms: int,
+    tag: str = "",
+    peer: Optional[int] = None,
+) -> bytes:
+    # EVERY cross-process get goes through the pod layer's bounded wait:
+    # typed ReduceTimeout/RankLost instead of an unbounded client block
+    # (tests assert no raw blocking_key_value_get remains in this module)
+    from ..resilience.pod import kv_wait
+
+    return base64.b64decode(
+        kv_wait(client, key, timeout_ms, tag=tag, peer=peer)
+    )
 
 
 def allgather_bytes(
@@ -298,10 +390,14 @@ def allgather_bytes(
     payload in rank order, on every rank.  Single-process: [payload].
     Collective contract: every process calls the same `allgather_bytes`
     sites in the same order (SPMD), or tags/sequence numbers desync.
-    A rank whose peers never show up fails with a timeout after
-    `multiproc_reduce_timeout_s` — a dead rank must surface loudly, not
-    hang the pass."""
-    if jax.process_count() == 1:
+    Every peer wait is bounded (`multiproc_reduce_timeout_s`) and typed:
+    a dead or diverged peer surfaces as `ReduceTimeout` — or, with
+    `pod_elastic` on and its heartbeat stopped past the grace window, an
+    early `RankLost` naming the corpse — never a hang.  Keys live in the
+    current reduction GENERATION's namespace, so a zombie rank's delayed
+    writes are invisible to a recovered quorum."""
+    nranks, rank = process_topology()
+    if nranks == 1:
         return [bytes(payload)]
     client = _coordination_client()
     if client is None:
@@ -309,41 +405,47 @@ def allgather_bytes(
             "allgather_bytes: jax.distributed is not initialized (no "
             "coordination client); call init_distributed() first"
         )
+    from ..resilience import pod as _pod
+
+    _pod.maybe_start_heartbeat()
     with _kv_lock:
         seq = _kv_seq.get(tag, 0)
         _kv_seq[tag] = seq + 1
-    rank, nranks = jax.process_index(), jax.process_count()
-    base = f"srmt/ag/{tag}/{seq}"
+    base = f"{_gen_prefix()}/ag/{tag}/{seq}"
     timeout_ms = (
         int(timeout_s * 1000) if timeout_s is not None else _reduce_timeout_ms()
     )
     _kv_put(client, f"{base}/{rank}", payload)
     out: List[bytes] = []
     for peer in range(nranks):
-        try:
-            out.append(_kv_take(client, f"{base}/{peer}", timeout_ms))
-        except Exception as e:
-            raise RuntimeError(
-                f"allgather_bytes[{tag}#{seq}]: rank {rank} timed out "
-                f"waiting for rank {peer}'s payload after "
-                f"{timeout_ms} ms ({type(e).__name__}: {e}) — peer dead "
-                "or diverged"
-            ) from e
+        out.append(
+            _kv_take(
+                client,
+                f"{base}/{peer}",
+                timeout_ms,
+                tag=f"{tag}#{seq}",
+                peer=peer,
+            )
+        )
     # cleanup: after everyone has read, each rank deletes its own key so
     # a long-running process doesn't grow the coordination store without
     # bound.  Barrier first — deleting before a slow peer's read would
     # turn its read into a spurious timeout.  Both steps are
     # best-effort: older clients lack the APIs, and leaked keys are
-    # harmless (seq numbers never reuse a name).
-    try:
-        barrier = getattr(client, "wait_at_barrier", None)
-        if barrier is not None:
-            barrier(f"srmt/agb/{tag}/{seq}", timeout_ms)
-            delete = getattr(client, "key_value_delete", None)
-            if delete is not None:
-                delete(f"{base}/{rank}")
-    except Exception:  # pragma: no cover - version/timing dependent
-        pass
+    # harmless (seq numbers never reuse a name).  Skipped entirely under
+    # an active recovery plan: the coordination service still counts the
+    # dead ranks as barrier participants, so every barrier would stall
+    # to its full timeout.
+    if _pod.active_recovery_plan() is None:
+        try:
+            barrier = getattr(client, "wait_at_barrier", None)
+            if barrier is not None:
+                barrier(f"{_gen_prefix()}/agb/{tag}/{seq}", timeout_ms)
+                delete = getattr(client, "key_value_delete", None)
+                if delete is not None:
+                    delete(f"{base}/{rank}")
+        except Exception:  # pragma: no cover - version/timing dependent
+            pass
     return out
 
 
@@ -356,8 +458,10 @@ def broadcast_bytes(
     """One-to-all: rank `root` publishes `payload`; every rank returns
     it.  The direct analog of the NCCL-uid broadcast (root creates the
     uid, the barrier allGather hands it to everyone).  Non-root ranks
-    may pass payload=None."""
-    if jax.process_count() == 1:
+    may pass payload=None.  Bounded and generation-scoped like
+    `allgather_bytes`."""
+    nranks, rank = process_topology()
+    if nranks == 1:
         return bytes(payload or b"")
     client = _coordination_client()
     if client is None:
@@ -365,19 +469,22 @@ def broadcast_bytes(
             "broadcast_bytes: jax.distributed is not initialized (no "
             "coordination client); call init_distributed() first"
         )
+    from ..resilience.pod import maybe_start_heartbeat
+
+    maybe_start_heartbeat()
     with _kv_lock:
         seq = _kv_seq.get(f"bc/{tag}", 0)
         _kv_seq[f"bc/{tag}"] = seq + 1
-    key = f"srmt/bc/{tag}/{seq}"
+    key = f"{_gen_prefix()}/bc/{tag}/{seq}"
     timeout_ms = (
         int(timeout_s * 1000) if timeout_s is not None else _reduce_timeout_ms()
     )
-    if jax.process_index() == root:
+    if rank == root:
         if payload is None:
             raise ValueError("broadcast_bytes: root rank needs a payload")
         _kv_put(client, key, payload)
         return bytes(payload)
-    return _kv_take(client, key, timeout_ms)
+    return _kv_take(client, key, timeout_ms, tag=f"bc/{tag}#{seq}", peer=root)
 
 
 def _observe_reduce(phase: str, seconds: float) -> None:
@@ -410,7 +517,7 @@ def check_rank_agreement(tag: str, fingerprint: str) -> None:
     the same one; divergence raises `RankDivergenceError` BEFORE any
     merge.  No-op single-process or when `multiproc_agreement_check` is
     off."""
-    if jax.process_count() == 1 or not get_config("multiproc_agreement_check"):
+    if process_topology()[0] == 1 or not get_config("multiproc_agreement_check"):
         return
     t0 = time.perf_counter()
     fps = [
@@ -477,7 +584,7 @@ def cross_process_reduce_ready() -> bool:
     single-process, and in distributed mode whenever the coordination
     client is live (the wire path needs nothing else; psum capability
     only picks WHICH backend)."""
-    if jax.process_count() == 1:
+    if process_topology()[0] == 1:
         return True
     return _coordination_client() is not None
 
@@ -545,20 +652,41 @@ def reduce_host_arrays(
     so exactly-representable partials (integer-valued test data) reduce
     byte-identically to the single-process fold.  The agreement check
     (conf `multiproc_agreement_check`) runs first either way."""
-    if jax.process_count() == 1:
+    if process_topology()[0] == 1:
         return arrays
     from ..telemetry.registry import counter
 
     arrays = {k: np.asarray(v) for k, v in arrays.items()}
     check_rank_agreement(tag, content_fingerprint(tag, arrays))
     backend = resolve_reduce_backend()
+    if topology_overridden():
+        # post-shrink (or simulated) quorums must not touch the psum
+        # path: the jitted collective spans the BOOT lead-device mesh,
+        # which still contains the dead rank's devices — the wire fold
+        # over the surviving quorum is the only sound backend
+        backend = "wire"
     t0 = time.perf_counter()
     if backend == "psum":
         names = sorted(arrays)
         flat = np.concatenate(
             [np.asarray(arrays[n], np.float64).ravel() for n in names]
         )
-        total = _psum_reduce_stacked(flat)
+        # the psum dispatch is a cross-process wait like any other: a
+        # dead peer would park the jitted collective forever, so it runs
+        # under the same bounded deadline and surfaces typed
+        from ..resilience.guard import DispatchTimeout, guarded
+        from ..resilience.pod import ReduceTimeout
+
+        try:
+            total = guarded(
+                lambda: _psum_reduce_stacked(flat),
+                deadline=float(get_config("multiproc_reduce_timeout_s")),
+                label=f"psum[{tag}]",
+            )
+        except DispatchTimeout as e:
+            raise ReduceTimeout(
+                tag, key=f"psum/{tag}", waited_s=e.deadline
+            ) from e
         out: Dict[str, np.ndarray] = {}
         off = 0
         for n in names:
@@ -599,7 +727,7 @@ def reduce_blob_list(tag: str, payload: bytes) -> List[bytes]:
     under the `sketch` phase.  The caller merges with the format's own
     associative merge — the wire format IS the cross-process contract,
     exactly as the reference ships sketch bytes through NCCL."""
-    if jax.process_count() == 1:
+    if process_topology()[0] == 1:
         return [bytes(payload)]
     t0 = time.perf_counter()
     blobs = allgather_bytes(f"blob/{tag}", payload)
@@ -631,11 +759,11 @@ class TpuContext:
 
     @property
     def rank(self) -> int:
-        return jax.process_index()
+        return process_topology()[1]
 
     @property
     def nranks(self) -> int:
-        return jax.process_count()
+        return process_topology()[0]
 
     def __enter__(self) -> "TpuContext":
         if get_config("coordinator_address") and not _distributed_initialized:

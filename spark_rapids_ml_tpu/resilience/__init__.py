@@ -27,6 +27,12 @@
 #                  cache entries for re-staging, and lets checkpointed
 #                  solvers resume at iteration k on the smaller mesh —
 #                  instead of the blind full retry.
+#   pod.py         the same contract at POD scale: bounded, typed
+#                  cross-process waits (`kv_wait`), per-rank liveness
+#                  heartbeats, and a RANK LOSS recovery that shrinks the
+#                  quorum to the survivors under a bumped reduction
+#                  generation and reassigns the dead rank's row-group
+#                  shares (fused.py consumes the RecoveryPlan).
 #
 # The layer imports neither jax nor numpy at module scope: arming faults
 # or reading a policy must not pay the multi-second jax import.
@@ -48,12 +54,21 @@ from .elastic import (  # noqa: F401
 )
 from .faults import SimulatedPreemption, fault_inject, maybe_inject  # noqa: F401
 from .guard import DispatchTimeout, guarded  # noqa: F401
+from .pod import (  # noqa: F401
+    POD_METRICS,
+    RankLost,
+    ReduceTimeout,
+    recover_from_rank_loss,
+    reset_pod,
+    simulate_rank_loss,
+)
 from .retry import (  # noqa: F401
     RetryPolicy,
     classify_error,
     is_device_loss,
     is_oom,
     is_preemption,
+    is_rank_loss,
     is_remote_compile_flake,
     is_transient,
     retry_call,
@@ -61,7 +76,10 @@ from .retry import (  # noqa: F401
 
 __all__ = [
     "DispatchTimeout",
+    "POD_METRICS",
     "RECOVERY_METRICS",
+    "RankLost",
+    "ReduceTimeout",
     "RetryPolicy",
     "SimulatedPreemption",
     "checkpoint_file_for",
@@ -72,16 +90,20 @@ __all__ = [
     "is_device_loss",
     "is_oom",
     "is_preemption",
+    "is_rank_loss",
     "is_remote_compile_flake",
     "is_transient",
     "load_checkpoint",
     "maybe_inject",
     "probe_lost_devices",
     "recover_from_device_loss",
+    "recover_from_rank_loss",
     "reset_elastic",
+    "reset_pod",
     "resolve_checkpoint_dir",
     "retry_call",
     "save_checkpoint",
     "simulate_device_loss",
+    "simulate_rank_loss",
     "sweep_orphaned_tmps",
 ]

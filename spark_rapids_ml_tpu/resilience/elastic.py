@@ -253,6 +253,20 @@ def recover_from_device_loss(logger_=None) -> bool:
             f"local_rank={pid}"
         )
         event("elastic_recovery[remote_host_loss]", detail=detail, log=lg)
+        # a remote-host device loss means a peer PROCESS is gone.  With
+        # `pod_elastic` on, that is exactly the pod fault domain: shrink
+        # the quorum to the surviving ranks (resilience/pod.py) and let
+        # the pass restart on the reassigned share layout — strictly
+        # better than the blind full re-bootstrap, which assumed the
+        # dead rank would come back
+        from .pod import RankLost, pod_elastic_enabled, recover_from_rank_loss
+
+        if pod_elastic_enabled():
+            dead = sorted({int(d.process_index) for d in remote})
+            if recover_from_rank_loss(
+                RankLost(dead, tag="device_probe"), log=lg
+            ):
+                return True
         lg.warning(
             f"Device loss includes remote-host device(s) "
             f"{[int(d.id) for d in remote]} (peer process gone); elastic "

@@ -80,11 +80,25 @@ KNOWN_SITES = frozenset({
     # retry restarts it with fresh accumulators, so a retried chunk can
     # never double-count (asserted by tests/test_stat_programs.py)
     "stat_program_step",
+    # the pod layer's bounded cross-process wait (resilience/pod.py
+    # `kv_wait`): every KV get/allgather/broadcast in
+    # parallel/context.py enters here, so arming it drives the
+    # rank-loss / reduce-timeout recovery paths at the exact wait a
+    # dead peer would have wedged
+    "kv_wait",
 })
 
 # Injectable fault kinds (`_Fault` validates against this; the docs and
 # the `fault_inject_spec` conf comment enumerate the same set)
-FAULT_KINDS = ("oom", "timeout", "preemption", "hang", "device_lost")
+FAULT_KINDS = (
+    "oom",
+    "timeout",
+    "preemption",
+    "hang",
+    "device_lost",
+    "rank_lost",
+    "kv_timeout",
+)
 
 
 class SimulatedPreemption(RuntimeError):
@@ -137,7 +151,13 @@ def fault_inject(
     (a jaxlib-shaped 'failed to execute ... device' RuntimeError that
     ALSO registers a simulated loss with resilience/elastic.py, so the
     health probe reports the device gone and the whole elastic-recovery
-    state machine runs on the CPU test mesh).
+    state machine runs on the CPU test mesh), `rank_lost` (a typed
+    `pod.RankLost` that ALSO registers a simulated dead peer with
+    resilience/pod.py — single-process it installs an implicit 2-rank
+    simulated topology first, so the pod detect/shrink/resume machine
+    runs on one box), `kv_timeout` (a typed `pod.ReduceTimeout`, the
+    bounded-wait expiry with no identifiable corpse — the straggler
+    shape).
     """
     f = _Fault(kind, times, skip, seconds)
     with _lock:
@@ -231,6 +251,19 @@ def maybe_inject(site: str) -> None:
             "INTERNAL: failed to execute XLA Runtime executable: device "
             f"{dev} has been lost (injected fault at dispatch site "
             f"'{site}')"
+        )
+    if fault.kind == "rank_lost":
+        # register the simulated dead peer FIRST (so liveness and the
+        # recovery probe find it), then raise the typed loss the bounded
+        # wait would have raised — the `device_lost` pattern at pod scale
+        from .pod import simulate_rank_loss
+
+        raise simulate_rank_loss(site)
+    if fault.kind == "kv_timeout":
+        from .pod import ReduceTimeout
+
+        raise ReduceTimeout(
+            site, key=f"injected/{site}", waited_s=fault.seconds
         )
     # "hang": park inside the dispatch so the guarded watchdog fires; on
     # its own (no deadline armed) this is just a stall, never an error

@@ -1,0 +1,751 @@
+#
+# Pod-scale fault domain — rank-loss detection, quorum shrink, and pass
+# resume.  PR 17-18 made fit-time ingest process-parallel with a single
+# pass-complete reduction (parallel/context.py), but a rank that died
+# mid-pass left every survivor blocked inside `allgather_bytes` on KV
+# keys that would never arrive.  This module lifts the single-process
+# elastic contract (resilience/elastic.py: detect -> shrink -> resume)
+# to the pod:
+#
+#   DETECT   every cross-process wait routes through `kv_wait`, a
+#            bounded deadline honoring `multiproc_reduce_timeout_s` that
+#            raises typed `ReduceTimeout`/`RankLost` instead of hanging.
+#            A per-rank liveness heartbeat in the coordination-service
+#            KV namespace (`srmt/hb/<rank>/<n>`, monotonic keys because
+#            the KV store is write-once) lets survivors name WHICH rank
+#            died, and the `pod_death_grace_s` straggler grace
+#            distinguishes dead-rank from slow-rank: a peer that still
+#            heartbeats is waited on to the full deadline.
+#   SHRINK   `recover_from_rank_loss` bumps the reduction GENERATION
+#            (every KV key is generation-prefixed, so a zombie rank's
+#            delayed writes land in the dead generation's namespace and
+#            are never merged — no split brain), clears the per-tag
+#            sequence counters, and installs a surviving-quorum topology
+#            override (parallel/context.py `process_topology`) under
+#            which the dead rank's row-group shares are deterministically
+#            reassigned across survivors (fused.py consumes the
+#            `RecoveryPlan`).
+#   RESUME   the retry loop restarts the interrupted pass with fresh
+#            accumulators on the new share layout (restart-not-double-
+#            count, the same contract as every fused fault site);
+#            survivors replay their OWN shares from the chunk cache at
+#            epoch-2 cost while only the reassigned shares pay parquet;
+#            checkpointed solvers resume at iteration k exactly as
+#            single-process elastic does.
+#
+# Gated behind the `pod_elastic` conf: off restores the prior behavior
+# — a bounded, typed timeout and then a fatal classification, never a
+# hang.  The whole state machine is drivable on one box via the
+# `rank_lost`/`kv_timeout` fault kinds (faults.py), which follow the
+# `device_lost` simulated-loss pattern: `simulate_rank_loss` installs an
+# implicit 2-rank simulated topology when run single-process.
+#
+# Like the rest of the resilience layer, no jax/numpy at module scope.
+#
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..config import get_config
+from ..telemetry.locks import named_lock
+from ..telemetry.registry import dict_view as _dict_view
+from ..utils import get_logger
+
+logger = get_logger("spark_rapids_ml_tpu.resilience")
+
+_lock = named_lock("pod_state")
+
+# cumulative pod-recovery counters (tests, the chaos smoke, operators):
+#   rank_losses_detected  peer ranks declared dead (liveness or typed)
+#   shares_reassigned     row-group shares moved off dead ranks
+#   pod_recoveries_total  successful shrink-to-survivors recoveries
+#   reduce_timeouts       bounded cross-process waits that expired
+#   generation            the current reduction generation number
+POD_METRICS = _dict_view(
+    "pod_recovery",
+    "Pod rank-loss recovery counters (losses/reassignment/generation)",
+    initial={
+        "rank_losses_detected": 0,
+        "shares_reassigned": 0,
+        "pod_recoveries_total": 0,
+        "reduce_timeouts": 0,
+        "generation": 0,
+    },
+)
+
+# how long a single KV probe for a peer's next heartbeat key blocks: one
+# miss per dead peer per liveness check, so this stays small
+_HB_PROBE_MS = 50
+
+
+class ReduceTimeout(RuntimeError):
+    """A bounded cross-process wait expired: the peer's KV payload (or
+    the psum dispatch) never arrived within `multiproc_reduce_timeout_s`.
+    Typed — carrying the reduce tag, the KV key, and the waited time —
+    so the retry classifier can route it (pod_elastic on: liveness-
+    driven recovery; off: fatal) instead of the pass hanging forever."""
+
+    def __init__(self, tag: str, key: str = "", waited_s: float = 0.0) -> None:
+        self.tag = tag
+        self.key = key
+        self.waited_s = float(waited_s)
+        super().__init__(
+            f"cross-process reduce {tag!r} timed out after "
+            f"{self.waited_s:.1f}s waiting on {key or tag!r} "
+            "(DEADLINE_EXCEEDED); peer slow, dead, or diverged — see "
+            "multiproc_reduce_timeout_s"
+        )
+
+
+class RankLost(RuntimeError):
+    """One or more peer PROCESSES are gone mid-pass (their liveness
+    heartbeat stopped for longer than `pod_death_grace_s`, or the loss
+    was injected).  Typed — carrying the lost boot ranks and the
+    generation they died under — so `recover_from_rank_loss` can shrink
+    the quorum to the survivors instead of treating the failure as an
+    opaque crash.  `lost_ranks` are BOOT ranks (the jax.distributed
+    process ids), stable across topology shrinks."""
+
+    def __init__(
+        self, lost_ranks, tag: str = "", generation: int = 0
+    ) -> None:
+        self.lost_ranks = sorted(int(r) for r in lost_ranks)
+        self.tag = tag
+        self.generation = int(generation)
+        super().__init__(
+            f"rank(s) {self.lost_ranks} lost during cross-process "
+            f"reduce {tag!r} (generation {self.generation}): liveness "
+            "heartbeat stopped past pod_death_grace_s — peer process is "
+            "dead, not slow"
+        )
+
+
+class RecoveryPlan:
+    """The shrink decision, consumed by the data path (fused.py): which
+    row-group SHARES (indices under the original `share_n`-way
+    `process_row_group_shares` partition) this process must cover on the
+    recovered pass, and which cache identity each share can replay from.
+
+    `assignments[new_rank]` is a tuple of `(share_idx, owner_boot_rank)`
+    entries: a survivor's own share keeps its original owner (so the
+    chunk cache replays it at epoch-2 cost); a reassigned share keeps
+    the DEAD owner's identity — the local cache has no stream under it,
+    so the first recovered pass decodes parquet and caches it for
+    epochs 2+.  `boot_ranks[new_rank]` maps post-shrink topology ranks
+    back to jax.distributed process ids (heartbeat identity)."""
+
+    __slots__ = (
+        "prior_n",
+        "prior_rank",
+        "dead_ranks",
+        "survivors",
+        "boot_ranks",
+        "share_n",
+        "assignments",
+        "generation",
+    )
+
+    def __init__(
+        self,
+        prior_n: int,
+        prior_rank: int,
+        dead_ranks: Tuple[int, ...],
+        survivors: Tuple[int, ...],
+        boot_ranks: Tuple[int, ...],
+        share_n: int,
+        assignments: Dict[int, Tuple[Tuple[int, int], ...]],
+        generation: int,
+    ) -> None:
+        self.prior_n = int(prior_n)
+        self.prior_rank = int(prior_rank)
+        self.dead_ranks = tuple(int(r) for r in dead_ranks)
+        self.survivors = tuple(int(r) for r in survivors)
+        self.boot_ranks = tuple(int(r) for r in boot_ranks)
+        self.share_n = int(share_n)
+        self.assignments = {
+            int(k): tuple((int(s), int(o)) for s, o in v)
+            for k, v in assignments.items()
+        }
+        self.generation = int(generation)
+
+    def as_dict(self) -> Dict:
+        return {
+            "prior_n": self.prior_n,
+            "prior_rank": self.prior_rank,
+            "dead_ranks": list(self.dead_ranks),
+            "survivors": list(self.survivors),
+            "boot_ranks": list(self.boot_ranks),
+            "share_n": self.share_n,
+            "assignments": {
+                str(k): [list(e) for e in v]
+                for k, v in self.assignments.items()
+            },
+            "generation": self.generation,
+        }
+
+
+_generation = 0
+_active_plan: Optional[RecoveryPlan] = None
+_sim_dead: set = set()
+_pass_manifest: Dict = {}
+
+# liveness bookkeeping: per-peer next-unseen heartbeat index, and the
+# monotonic time each peer's beat was last observed to ADVANCE (seeded
+# at first probe, so a rank killed before its first beat still ages out
+# after the grace window)
+_hb_next: Dict[int, int] = {}
+_hb_seen: Dict[int, float] = {}
+_hb_thread: Optional[threading.Thread] = None
+_hb_stop: Optional[threading.Event] = None
+
+# in-flight cross-process waits by thread id, for the hang doctor's
+# stall attribution (which reduce tag, which peer rank) and the
+# `reduce_wait` utilization intervals
+_live_waits: Dict[int, Dict] = {}
+
+
+def pod_elastic_enabled() -> bool:
+    return str(get_config("pod_elastic")).lower() == "on"
+
+
+def heartbeat_interval_s() -> float:
+    return max(0.05, float(get_config("pod_heartbeat_interval_s")))
+
+
+def death_grace_s() -> float:
+    return max(0.1, float(get_config("pod_death_grace_s")))
+
+
+def generation() -> int:
+    """The current reduction generation.  Every coordination-service KV
+    key is prefixed with it (parallel/context.py), so payloads written
+    by a rank that died under generation g are invisible to the quorum
+    recovered under g+1 — zombie-rank partials can never split-brain
+    into a recovered pass."""
+    with _lock:
+        return _generation
+
+
+def advance_generation(reason: str = "") -> int:
+    """Bump the reduction generation and reset the per-tag KV sequence
+    counters: the recovered quorum starts a fresh, disjoint key
+    namespace.  Called by `recover_from_rank_loss` and by every
+    `reinit_distributed` re-bootstrap."""
+    global _generation
+    with _lock:
+        _generation += 1
+        gen = _generation
+        POD_METRICS["generation"] = gen
+    try:
+        from ..parallel.context import reset_kv_epoch
+
+        reset_kv_epoch()
+    except Exception:  # pragma: no cover - import-order defensive
+        pass
+    from ..tracing import event
+
+    event(
+        "pod_recovery[generation]",
+        detail=f"gen={gen} reason={reason}",
+        log=logger,
+    )
+    return gen
+
+
+def active_recovery_plan() -> Optional[RecoveryPlan]:
+    with _lock:
+        return _active_plan
+
+
+def record_pass_manifest(**fields) -> None:
+    """Data-path breadcrumbs (path, share layout, generation) updated by
+    `iter_parquet_chunks` at pass start; attached verbatim to the
+    `reason="rank_loss"` flight-recorder bundle so the operator can see
+    WHAT pass the pod was in when the rank died."""
+    with _lock:
+        _pass_manifest.update(fields)
+
+
+def pass_manifest() -> Dict:
+    with _lock:
+        return dict(_pass_manifest)
+
+
+def simulated_dead_ranks() -> frozenset:
+    with _lock:
+        return frozenset(_sim_dead)
+
+
+def _current_boot_ranks() -> List[int]:
+    """Topology-rank -> boot-rank map for the CURRENT effective
+    topology: the plan's surviving boot ranks after a recovery, the
+    identity range under a plain (or simulated) override, the jax view
+    otherwise."""
+    plan = active_recovery_plan()
+    if plan is not None:
+        return list(plan.boot_ranks)
+    from ..parallel.context import process_topology, topology_overridden
+
+    n, _ = process_topology()
+    if topology_overridden():
+        return list(range(n))
+    import jax
+
+    return list(range(jax.process_count()))
+
+
+def _my_boot_rank() -> int:
+    from ..parallel.context import process_topology
+
+    boots = _current_boot_ranks()
+    _, rank = process_topology()
+    return boots[rank] if rank < len(boots) else int(rank)
+
+
+# ---------------------------------------------------------------------------
+# Liveness heartbeat
+# ---------------------------------------------------------------------------
+
+
+def _hb_loop(client, boot_rank: int, stop: threading.Event) -> None:
+    n = 0
+    while not stop.is_set():
+        try:
+            # the KV store is write-once across the jaxlib versions we
+            # support, so the beat is a monotonic KEY, not a mutated value
+            client.key_value_set(f"srmt/hb/{boot_rank}/{n}", "1")
+            n += 1
+        except Exception:  # pragma: no cover - client teardown races
+            pass
+        stop.wait(heartbeat_interval_s())
+
+
+def maybe_start_heartbeat() -> bool:
+    """Start this rank's liveness publisher (idempotent).  No-op when
+    `pod_elastic` is off, single-process, or outside distributed mode.
+    Called from `init_distributed` and from every allgather, so a rank
+    beats from bootstrap — a peer killed before its FIRST reduction is
+    still detectable."""
+    global _hb_thread, _hb_stop
+    if not pod_elastic_enabled():
+        return False
+    with _lock:
+        if _hb_thread is not None and _hb_thread.is_alive():
+            return True
+    import jax
+
+    if jax.process_count() <= 1:
+        return False
+    from ..parallel.context import _coordination_client
+
+    client = _coordination_client()
+    if client is None:
+        return False
+    boot = int(jax.process_index())
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_hb_loop, args=(client, boot, stop),
+        name="pod-heartbeat", daemon=True,
+    )
+    with _lock:
+        if _hb_thread is not None and _hb_thread.is_alive():
+            return True
+        _hb_thread, _hb_stop = t, stop
+    t.start()
+    return True
+
+
+def stop_heartbeat() -> None:
+    global _hb_thread, _hb_stop
+    with _lock:
+        t, stop = _hb_thread, _hb_stop
+        _hb_thread = _hb_stop = None
+    if stop is not None:
+        stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=1.0)
+
+
+def _probe_liveness(client, boot_ranks, my_boot: int) -> Dict[int, float]:
+    """Advance the last-seen table by draining each peer's new heartbeat
+    keys (tiny bounded gets); returns seconds since each peer's beat
+    last advanced.  A peer never probed before is seeded NOW, so its
+    grace window starts at first suspicion, not at minus infinity."""
+    now = time.monotonic()
+    ages: Dict[int, float] = {}
+    for r in boot_ranks:
+        if r == my_boot:
+            continue
+        with _lock:
+            nxt = _hb_next.get(r, 0)
+        advanced = False
+        while True:
+            try:
+                client.blocking_key_value_get(f"srmt/hb/{r}/{nxt}", _HB_PROBE_MS)
+            except Exception:
+                break
+            nxt += 1
+            advanced = True
+        with _lock:
+            _hb_next[r] = nxt
+            if advanced or r not in _hb_seen:
+                _hb_seen[r] = now
+            ages[r] = now - _hb_seen[r]
+    return ages
+
+
+def liveness_table() -> Dict[str, Dict]:
+    """The per-peer liveness snapshot (beats observed, seconds since the
+    last advance) attached to every rank_loss bundle."""
+    now = time.monotonic()
+    with _lock:
+        return {
+            str(r): {
+                "beats": _hb_next.get(r, 0),
+                "age_s": round(now - _hb_seen[r], 3) if r in _hb_seen else None,
+                "simulated_dead": r in _sim_dead,
+            }
+            for r in sorted(set(_hb_next) | set(_hb_seen) | set(_sim_dead))
+        }
+
+
+def _check_dead(client) -> List[int]:
+    """Boot ranks currently considered dead: simulated losses plus every
+    peer whose heartbeat has not advanced within `pod_death_grace_s`."""
+    boots = _current_boot_ranks()
+    my = _my_boot_rank()
+    dead = {b for b in simulated_dead_ranks() if b in boots and b != my}
+    if client is not None:
+        try:
+            ages = _probe_liveness(client, boots, my)
+        except Exception:  # pragma: no cover - client teardown races
+            ages = {}
+        grace = death_grace_s()
+        dead |= {r for r, age in ages.items() if age > grace}
+    return sorted(dead)
+
+
+# ---------------------------------------------------------------------------
+# The bounded cross-process wait
+# ---------------------------------------------------------------------------
+
+
+def live_reduce_waits() -> List[Dict]:
+    """Snapshot of in-flight cross-process waits (thread, reduce tag,
+    peer rank, waited seconds) — the hang doctor's stall-attribution
+    input."""
+    now = time.monotonic()
+    with _lock:
+        return [
+            {**w, "waited_s": round(now - w["since"], 3)}
+            for w in _live_waits.values()
+        ]
+
+
+def kv_wait(
+    client,
+    key: str,
+    timeout_ms: int,
+    tag: str = "",
+    peer: Optional[int] = None,
+) -> str:
+    """THE bounded cross-process wait: every KV get in
+    parallel/context.py routes through here (a unit test asserts no raw
+    `blocking_key_value_get` remains there).  Waits at most `timeout_ms`
+    and raises typed `ReduceTimeout` at the deadline — never hangs.
+    With `pod_elastic` on, the wait is sliced at the heartbeat cadence
+    and peer liveness is checked between slices: a peer whose heartbeat
+    stopped past `pod_death_grace_s` raises `RankLost` EARLY (naming the
+    dead boot ranks), while a slow-but-beating straggler is waited on to
+    the full deadline.  The wait is registered for hang-doctor
+    attribution and lands on the utilization timeline as a
+    `reduce_wait` interval."""
+    from .faults import maybe_inject
+
+    maybe_inject("kv_wait")
+    t0 = time.monotonic()
+    tid = threading.get_ident()
+    entry = {
+        "thread": threading.current_thread().name,
+        "thread_id": tid,
+        "tag": tag or key,
+        "peer": peer,
+        "key": key,
+        "since": t0,
+    }
+    with _lock:
+        _live_waits[tid] = entry
+    liveness = pod_elastic_enabled()
+    deadline = t0 + max(1, int(timeout_ms)) / 1000.0
+    slice_s = heartbeat_interval_s() if liveness else None
+    try:
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                with _lock:
+                    POD_METRICS["reduce_timeouts"] += 1
+                raise ReduceTimeout(
+                    tag or key, key=key, waited_s=time.monotonic() - t0
+                )
+            wait_s = min(remaining, slice_s) if liveness else remaining
+            try:
+                return client.blocking_key_value_get(
+                    key, max(1, int(wait_s * 1000))
+                )
+            except Exception as e:
+                if not liveness:
+                    with _lock:
+                        POD_METRICS["reduce_timeouts"] += 1
+                    raise ReduceTimeout(
+                        tag or key, key=key, waited_s=time.monotonic() - t0
+                    ) from e
+                dead = _check_dead(client)
+                if dead:
+                    raise RankLost(
+                        dead, tag=tag or key, generation=generation()
+                    ) from e
+                # peer still beats (or liveness is inconclusive): a
+                # straggler, not a corpse — keep waiting to the deadline
+    finally:
+        with _lock:
+            _live_waits.pop(tid, None)
+        try:
+            from ..telemetry.utilization import note_interval
+
+            cause = f"{tag or key}:rank{peer}" if peer is not None else (tag or key)
+            note_interval(
+                "reduce_wait", t0, time.monotonic(), cause=cause, domain="any"
+            )
+        except Exception:  # pragma: no cover - telemetry must never raise
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Simulated losses (the one-box test hook, `device_lost` pattern)
+# ---------------------------------------------------------------------------
+
+
+def simulate_rank_loss(
+    site: str = "", rank: Optional[int] = None
+) -> RankLost:
+    """Mark a peer rank dead WITHOUT real processes: liveness reports it
+    exactly like a stopped heartbeat.  Run single-process, installs an
+    implicit simulated 2-rank topology (this process as rank 0, rank 1
+    dead) so the whole detect -> shrink -> resume machine is drivable on
+    one box.  Called by the `rank_lost` fault kind (faults.py); tests
+    may call it directly.  Returns the typed `RankLost` for the caller
+    to raise."""
+    from ..parallel import context as _pctx
+
+    n, my = _pctx.process_topology()
+    if n <= 1:
+        _pctx.set_topology_override(2, 0)
+        n, my = 2, 0
+    boots = _current_boot_ranks()
+    my_boot = boots[my] if my < len(boots) else my
+    if rank is None:
+        candidates = [
+            b for b in boots if b != my_boot and b not in _sim_dead
+        ]
+        if not candidates:
+            raise RuntimeError("no live peer rank left to simulate losing")
+        rank = candidates[-1]
+    with _lock:
+        _sim_dead.add(int(rank))
+    return RankLost([int(rank)], tag=site or "simulated", generation=generation())
+
+
+# ---------------------------------------------------------------------------
+# The recovery state machine
+# ---------------------------------------------------------------------------
+
+
+def recover_from_rank_loss(exc=None, log=None) -> bool:
+    """Handle a failure classified `rank_loss`: name the dead ranks
+    (from the typed exception, the simulated registry, and a final
+    liveness probe), then SHRINK the quorum to the survivors — bump the
+    generation, install the survivor topology override, and record a
+    `RecoveryPlan` reassigning the dead ranks' row-group shares — and
+    return True (the retry loop restarts the pass with fresh
+    accumulators on the new layout).  Returns False when recovery is
+    impossible and the caller should fall back to the full re-bootstrap
+    path: `pod_elastic` off, no dead rank identifiable (a straggler
+    timeout), or the coordinator rank itself died (the KV store died
+    with it — only `reinit_distributed` against a restarted coordinator
+    can help)."""
+    from ..tracing import event
+
+    lg = log or logger
+    if not pod_elastic_enabled():
+        return False
+    from ..parallel import context as _pctx
+
+    n, rank = _pctx.process_topology()
+    boots = _current_boot_ranks()
+    my_boot = boots[rank] if rank < len(boots) else rank
+    dead_boot = set(getattr(exc, "lost_ranks", None) or ())
+    dead_boot |= set(simulated_dead_ranks())
+    client = _pctx._coordination_client()
+    if client is not None:
+        try:
+            ages = _probe_liveness(client, boots, my_boot)
+            grace = death_grace_s()
+            dead_boot |= {r for r, age in ages.items() if age > grace}
+        except Exception:  # pragma: no cover - client teardown races
+            pass
+    dead_boot = {b for b in dead_boot if b in boots and b != my_boot}
+    if not dead_boot:
+        event(
+            "pod_recovery[inconclusive]",
+            detail=f"tag={getattr(exc, 'tag', '')!r} no dead rank found",
+            log=lg,
+        )
+        lg.warning(
+            "reduce failure with no identifiable dead rank (straggler "
+            "timeout?); falling back to the full re-bootstrap path"
+        )
+        return False
+    with _lock:
+        POD_METRICS["rank_losses_detected"] += len(dead_boot)
+    from ..telemetry.flight_recorder import note_failure
+
+    if my_boot != 0 and 0 in dead_boot:
+        # the coordinator process hosts the KV store: with it gone the
+        # wire has nothing to reduce over — the only sound answer is a
+        # full reinit_distributed against a restarted coordinator
+        note_failure(
+            "rank_loss",
+            detail=f"coordinator (boot rank 0) dead; dead={sorted(dead_boot)}",
+            attachments={
+                "pass_manifest": pass_manifest(),
+                "liveness": liveness_table(),
+            },
+            log=lg,
+        )
+        lg.warning(
+            "pod recovery: the coordinator rank died — survivors cannot "
+            "regroup over the dead KV store; falling back to full "
+            "re-bootstrap"
+        )
+        return False
+
+    dead = sorted(boots.index(b) for b in dead_boot)
+    survivors = [r for r in range(n) if r not in dead]
+    new_rank = survivors.index(rank)
+    new_boots = tuple(boots[s] for s in survivors)
+
+    # share bookkeeping: first loss partitions under the pre-loss
+    # topology size; a chained loss inherits the original share_n and
+    # redistributes the newly-dead survivors' entries
+    prev = active_recovery_plan()
+    if prev is None:
+        share_n = n
+        base_assign = {r: ((r, boots[r]),) for r in range(n)}
+    else:
+        share_n = prev.share_n
+        base_assign = dict(prev.assignments)
+    dead_entries = [e for d in dead for e in base_assign.get(d, ())]
+    assignments = {
+        i: tuple(base_assign.get(s, ())) for i, s in enumerate(survivors)
+    }
+    for j, ent in enumerate(dead_entries):
+        i = j % len(survivors)
+        assignments[i] = assignments[i] + (ent,)
+
+    gen = advance_generation("rank_loss")
+    plan = RecoveryPlan(
+        prior_n=n,
+        prior_rank=rank,
+        dead_ranks=tuple(dead),
+        survivors=tuple(survivors),
+        boot_ranks=new_boots,
+        share_n=share_n,
+        assignments=assignments,
+        generation=gen,
+    )
+    global _active_plan
+    with _lock:
+        _active_plan = plan
+        POD_METRICS["pod_recoveries_total"] += 1
+        POD_METRICS["shares_reassigned"] += len(dead_entries)
+    _pctx.set_topology_override(len(survivors), new_rank)
+    detail = (
+        f"dead={sorted(dead_boot)} survivors={list(new_boots)} "
+        f"gen={gen} shares_reassigned={len(dead_entries)}"
+    )
+    note_failure(
+        "rank_loss",
+        detail=detail,
+        attachments={
+            "pass_manifest": pass_manifest(),
+            "liveness": liveness_table(),
+            "recovery_plan": plan.as_dict(),
+        },
+        log=lg,
+    )
+    event("pod_recovery[shrink]", detail=detail, log=lg)
+    lg.warning(
+        f"pod recovery: rank(s) {sorted(dead_boot)} dead; continuing as "
+        f"rank {new_rank}/{len(survivors)} under generation {gen} "
+        f"({len(dead_entries)} share(s) reassigned); the interrupted "
+        "pass restarts with fresh accumulators on the new layout"
+    )
+    return True
+
+
+def on_reinit() -> int:
+    """A full `reinit_distributed` re-bootstrap starts a fresh world:
+    drop the recovery plan and topology override, clear simulated deaths
+    and liveness history, stop the (stale-client) heartbeat, and bump
+    the generation so no KV key from the previous bootstrap can bleed
+    into the new one."""
+    global _active_plan
+    stop_heartbeat()
+    with _lock:
+        _active_plan = None
+        _sim_dead.clear()
+        _hb_next.clear()
+        _hb_seen.clear()
+        _pass_manifest.clear()
+    from ..parallel.context import clear_topology_override
+
+    clear_topology_override()
+    return advance_generation("reinit")
+
+
+def reset_pod() -> None:
+    """Full reset of the pod layer (tests): everything `on_reinit` drops
+    plus the metrics and the generation counter itself."""
+    global _generation
+    on_reinit()
+    with _lock:
+        _generation = 0
+        for k in POD_METRICS:
+            POD_METRICS[k] = 0
+        _live_waits.clear()
+
+
+__all__ = [
+    "POD_METRICS",
+    "RankLost",
+    "RecoveryPlan",
+    "ReduceTimeout",
+    "active_recovery_plan",
+    "advance_generation",
+    "generation",
+    "kv_wait",
+    "live_reduce_waits",
+    "liveness_table",
+    "maybe_start_heartbeat",
+    "on_reinit",
+    "pass_manifest",
+    "pod_elastic_enabled",
+    "record_pass_manifest",
+    "recover_from_rank_loss",
+    "reset_pod",
+    "simulate_rank_loss",
+    "simulated_dead_ranks",
+    "stop_heartbeat",
+]

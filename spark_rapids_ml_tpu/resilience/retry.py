@@ -19,6 +19,12 @@
 #               checkpointed solvers resume at iteration k on the
 #               smaller mesh (falls back to the preemption repair when
 #               elastic is off / too few survivors)
+#   rank_loss   a peer PROCESS died mid-reduction (typed RankLost /
+#               ReduceTimeout from the pod layer's bounded waits): with
+#               `pod_elastic` on, resilience/pod.py shrinks the quorum
+#               to the survivors under a bumped generation and the pass
+#               restarts on the reassigned share layout; with it off the
+#               typed error is FATAL — bounded timeout, then propagate
 #   fatal       everything else propagates unchanged on the FIRST raise
 #
 from __future__ import annotations
@@ -154,12 +160,28 @@ def is_transient(e: BaseException) -> bool:
     )
 
 
+def is_rank_loss(e: BaseException) -> bool:
+    """A typed pod-layer failure: a peer PROCESS declared dead
+    (`RankLost`) or a bounded cross-process wait that expired
+    (`ReduceTimeout`).  Both come from resilience/pod.py's `kv_wait`
+    seam — string matching is unnecessary, the types are ours."""
+    from .pod import RankLost, ReduceTimeout
+
+    return isinstance(e, (RankLost, ReduceTimeout))
+
+
 def classify_error(e: BaseException) -> str:
-    """Map an exception to its recovery action:
+    """Map an exception to its recovery action: 'rank_loss' |
     'device_loss' | 'preemption' | 'oom' | 'transient' | 'fatal'.
-    Device loss classifies FIRST: the same jaxlib error can carry both a
-    device-loss marker and a coordinator string, and only the
-    device-loss action knows how to keep the survivors working."""
+    Rank loss classifies FIRST — the exceptions are typed, and their
+    messages deliberately carry DEADLINE/lost markers that the string
+    classifiers below would mis-route.  With `pod_elastic` off the same
+    typed errors are FATAL: the bounded timeout already did its job
+    (never hang), and there is no recovery to drive."""
+    if is_rank_loss(e):
+        from .pod import pod_elastic_enabled
+
+        return "rank_loss" if pod_elastic_enabled() else "fatal"
     if is_device_loss(e):
         return "device_loss"
     if is_preemption(e):
@@ -186,6 +208,19 @@ def _default_device_loss_hook() -> None:
     from .elastic import recover_from_device_loss
 
     recover_from_device_loss(logger)
+
+
+def _default_rank_loss_hook(exc: Optional[BaseException] = None) -> None:
+    # the pod recovery state machine (resilience/pod.py): shrink the
+    # quorum to the survivors under a bumped generation when a dead rank
+    # is identifiable, else fall back to the preemption repair (a
+    # straggler timeout or a dead coordinator — only a full re-bootstrap
+    # can help).  Either way the retry loop re-dispatches afterwards and
+    # the pass restarts with fresh accumulators.
+    from .pod import recover_from_rank_loss
+
+    if not recover_from_rank_loss(exc, log=logger):
+        _default_preemption_hook()
 
 
 def _default_preemption_hook() -> None:
@@ -218,7 +253,7 @@ class RetryPolicy:
     jitter: float = 0.25
     classify: Callable[[BaseException], str] = classify_error
     retryable: Tuple[str, ...] = (
-        "oom", "transient", "preemption", "device_loss",
+        "oom", "transient", "preemption", "device_loss", "rank_loss",
     )
     # OOM gets a TIGHTER budget than max_attempts: one gc'd re-dispatch
     # recovers fragmentation/injected faults, but a dataset that genuinely
@@ -250,13 +285,15 @@ def retry_call(
     on_oom: Optional[Callable[[], None]] = None,
     on_preemption: Optional[Callable[[], None]] = None,
     on_device_loss: Optional[Callable[[], None]] = None,
+    on_rank_loss: Optional[Callable[[], None]] = None,
 ) -> Any:
     """Run `fn` under `policy` (default: `RetryPolicy.from_config()`).
 
     Each recovery is surfaced as a `retry[label]` trace event.  `on_oom` /
-    `on_preemption` / `on_device_loss` override the default repair hooks
-    (gc-collect / `reinit_distributed` / the elastic mesh recovery —
-    resilience/elastic.py).  Callers whose recovery mutates loop state the
+    `on_preemption` / `on_device_loss` / `on_rank_loss` override the
+    default repair hooks (gc-collect / `reinit_distributed` / the elastic
+    mesh recovery / the pod quorum shrink — resilience/elastic.py and
+    resilience/pod.py).  Callers whose recovery mutates loop state the
     policy cannot see (the transform chunk loop in core.py: chunk halving,
     resume-row tracking across a pipelined pending dispatch) apply the
     SAME policy — `RetryPolicy.from_config()`, `classify`, `backoff`, and
@@ -271,6 +308,7 @@ def retry_call(
     while True:
         action = None
         err_desc = ""
+        rank_loss_exc = None
         try:
             return fn()
         except Exception as e:
@@ -300,6 +338,12 @@ def retry_call(
                     )
                 raise
             err_desc = f"{type(e).__name__}: {e}"
+            if action == "rank_loss":
+                # the recovery hook needs the typed exception (it names
+                # the dead ranks); safe to carry outside the except
+                # block — pod errors are host-side, their tracebacks pin
+                # no device buffers
+                rank_loss_exc = e
         # the retry runs OUTSIDE the except block: while handling, the
         # interpreter's exception state pins the failed dispatch's frames
         # via the traceback, whose locals reference the device buffers we
@@ -325,6 +369,11 @@ def retry_call(
             (on_preemption or _default_preemption_hook)()
         elif action == "device_loss":
             (on_device_loss or _default_device_loss_hook)()
+        elif action == "rank_loss":
+            if on_rank_loss is not None:
+                on_rank_loss()
+            else:
+                _default_rank_loss_hook(rank_loss_exc)
         else:  # transient
             time.sleep(policy.backoff(attempt))
         attempt += 1

@@ -469,7 +469,11 @@ def _one_pass(
         for p in device_progs:
             folded[p.name] = acc_to_host_f64(dev_acc[p.name])
         folded.update(host_acc)
-        if jax.process_count() > 1:
+        # topology view (parallel/context.py): a post-rank-loss survivor
+        # group of one skips the reduce instead of waiting on the dead
+        from ..parallel.context import process_topology
+
+        if process_topology()[0] > 1:
             folded, offset = _reduce_pass_across_processes(
                 progs, popts, d, folded, offset
             )

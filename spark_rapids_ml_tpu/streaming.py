@@ -257,24 +257,36 @@ def _chunk_stream_key(
     dtype,
     row_range,
     tag: str = "iter_chunks",
+    topology=None,
 ):
     """Chunk-cache stream key: the path's content stamp plus every scan
     parameter that shapes the yielded chunks.  None (cache bypass) when
     the path cannot be stat'd — a remote dataset rewritten in place must
-    never replay stale chunks.  The key also carries `process_index`:
-    each host caches (and spills) only its own slice's chunks, and two
-    ranks replaying the SAME parquet path through a shared
-    `chunk_cache_spill_dir` must never collide on a spill filename —
-    without the rank component their content stamps are identical."""
-    import jax
-
+    never replay stale chunks.  The key also carries the rank and the
+    process-group SIZE: each host caches (and spills) only its own
+    slice's chunks, two ranks replaying the SAME parquet path through a
+    shared `chunk_cache_spill_dir` must never collide on a spill
+    filename, and a stream decoded under one partition layout must never
+    be replayed under another (the share boundaries moved).  `topology`
+    overrides the (size, rank) pair — how a rank-loss recovery pass
+    (resilience/pod.py) reconstructs a pre-loss stream key so the
+    survivor's own share replays from cache byte-for-byte."""
     stamp = _path_stamp(path)
     if stamp is None:
         return None
+    if topology is not None:
+        nranks, rank = int(topology[0]), int(topology[1])
+    else:
+        # the topology view (identical to the jax view until a pod
+        # recovery installs an override): a stream decoded under one
+        # ingest layout must never serve another
+        from .parallel.context import process_topology
+
+        nranks, rank = process_topology()
     return (
-        tag, path, stamp, int(jax.process_index()), features_col,
+        tag, path, stamp, rank, features_col,
         tuple(features_cols or ()), label_col, weight_col,
-        int(chunk_rows), np.dtype(dtype).str, row_range,
+        int(chunk_rows), np.dtype(dtype).str, row_range, nranks,
     )
 
 
@@ -554,7 +566,9 @@ def stage_parquet(
     if chunk_rows is None:
         chunk_rows = chunk_rows_for(d, dtype.itemsize)
 
-    if jax.process_count() > 1:
+    from .parallel.context import process_topology
+
+    if process_topology()[0] > 1:
         # per-partition read: every host decodes ONLY its contiguous row
         # share (host memory = dataset / n_processes, decode throughput
         # scales with host count), then the standard RowStager layout —
@@ -563,7 +577,9 @@ def stage_parquet(
         # The share partition is pure arithmetic on (n_total, rank):
         # deterministic on every rank, and coverage-asserted to tile
         # [0, n_total) exactly, so no row is decoded twice or dropped.
-        n_proc, pid = jax.process_count(), jax.process_index()
+        # Topology view: a post-rank-loss survivor group re-partitions
+        # over the survivors, not the boot process count.
+        n_proc, pid = process_topology()
         ranges = process_ingest_ranges(n_total, n_proc)
         lo, hi = ranges[pid]
         n_local = hi - lo
@@ -834,9 +850,9 @@ def process_ingest_ranges(n_total: int, n_proc: int) -> list:
 
 
 def _process_row_range(n_total: int) -> Tuple[int, int]:
-    import jax
+    from .parallel.context import process_topology
 
-    n_proc, pid = jax.process_count(), jax.process_index()
+    n_proc, pid = process_topology()
     if n_proc == 1:
         return 0, n_total
     return process_ingest_ranges(n_total, n_proc)[pid]
@@ -846,10 +862,11 @@ def _sum_across_processes(host_stats: dict) -> dict:
     """Sum per-process partial statistics (host side) through the
     cross-process reduce seam (parallel/context.py): one jitted psum on
     collective-capable backends, the coordination-service wire fold on
-    CPU builds — with the rank-agreement check either way."""
-    import jax
+    CPU builds — with the rank-agreement check either way.  Topology-
+    gated: a post-rank-loss survivor group of one skips the reduce."""
+    from .parallel.context import process_topology
 
-    if jax.process_count() == 1:
+    if process_topology()[0] == 1:
         return host_stats
     from .parallel.context import reduce_host_arrays
 
@@ -1117,16 +1134,26 @@ def _label_moments_scan(
          "not_integral": 1.0 - integral}
     )
     # min/max need min/max-reduction, not sum: gather explicitly
-    import jax as _jax
+    from .parallel.context import process_topology, topology_overridden
 
-    if _jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if process_topology()[0] > 1:
+        rng = np.asarray([y_min, -y_max], np.float64)
+        if topology_overridden():
+            # post-rank-loss survivor group: the jax collective spans
+            # the (stale) boot process set and would park on the dead —
+            # gather over the bounded KV wire path instead
+            from .parallel.context import allgather_bytes
 
-        rng_all = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray([y_min, -y_max], np.float64)
-            )
-        ).reshape(-1, 2)
+            rng_all = np.stack([
+                np.frombuffer(b, np.float64)
+                for b in allgather_bytes(rng.tobytes(), "label_range")
+            ]).reshape(-1, 2)
+        else:
+            from jax.experimental import multihost_utils
+
+            rng_all = np.asarray(
+                multihost_utils.process_allgather(rng)
+            ).reshape(-1, 2)
         y_min = float(rng_all[:, 0].min())
         y_max = float(-rng_all[:, 1].min())
     return {
@@ -1618,7 +1645,9 @@ def kmeans_streaming_fit(
         opts=ks_opts,
         offset0=lo,
     )
-    if jax.process_count() > 1:
+    from .parallel.context import process_topology as _ptopo
+
+    if _ptopo()[0] > 1:
         # merge the slot-disjoint per-rank reservoirs (each rank filled
         # only the GLOBAL slots of its ingest range) in ascending rank
         # order: every rank assembles the identical global sample,

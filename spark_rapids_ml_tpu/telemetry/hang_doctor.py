@@ -217,11 +217,23 @@ class HangDoctor:
             )
         return (self._events, solver, collects)
 
+    def _reduce_waits(self) -> List[Dict[str, Any]]:
+        """In-flight cross-process waits (resilience/pod.py kv_wait):
+        thread, reduce tag, peer rank, waited seconds — the pod-scale
+        analog of the lock waiter table."""
+        try:
+            from ..resilience.pod import live_reduce_waits
+
+            return live_reduce_waits()
+        except Exception:  # pragma: no cover - import-order defensive
+            return []
+
     def _work_pending(self, table: List[Dict[str, Any]]) -> List[str]:
         """Evidence something SHOULD be making progress: live solver
         gauges (a fit mid-loop), queued serving requests, held or
-        awaited named locks.  Returns the evidence labels (empty = the
-        process is legitimately idle)."""
+        awaited named locks, in-flight cross-process reduce waits.
+        Returns the evidence labels (empty = the process is
+        legitimately idle)."""
         evidence: List[str] = []
         m = REGISTRY.get("solver_iteration")
         if m is not None and m.samples():
@@ -234,6 +246,8 @@ class HangDoctor:
             evidence.append("queued_serving_requests")
         if any(r.get("holder") or r.get("waiters") for r in table):
             evidence.append("held_locks")
+        if self._reduce_waits():
+            evidence.append("reduce_wait")
         return evidence
 
     # -- the tick ------------------------------------------------------------
@@ -259,6 +273,11 @@ class HangDoctor:
             for w in row.get("waiters", ())
             if w.get("waited_s", 0.0) >= stall_s
         ]
+        reduce_stuck = [
+            w
+            for w in self._reduce_waits()
+            if w.get("waited_s", 0.0) >= stall_s
+        ]
         kind = None
         episode: Any = None
         if stuck:
@@ -267,6 +286,19 @@ class HangDoctor:
                 "lock_wait",
                 frozenset(
                     (row["name"], w["thread_id"]) for row, w in stuck
+                ),
+            )
+        elif reduce_stuck:
+            # a thread parked in a cross-process wait past the stall
+            # window: name the blocked reduce tag and peer rank — the
+            # pod-scale analog of the lock_wait diagnosis.  kv_wait
+            # itself bounds the wait (ReduceTimeout at the deadline);
+            # the doctor's job is ATTRIBUTION while it is still stuck
+            kind = "reduce_wait"
+            episode = (
+                "reduce_wait",
+                frozenset(
+                    (w["tag"], w["thread_id"]) for w in reduce_stuck
                 ),
             )
         else:
@@ -281,7 +313,7 @@ class HangDoctor:
             return None  # same episode, already diagnosed
         self._dumped_episode = episode
         STALLS.inc(kind=kind)
-        return self._diagnose(kind, stall_s, table, stuck)
+        return self._diagnose(kind, stall_s, table, stuck, reduce_stuck)
 
     def _diagnose(
         self,
@@ -289,14 +321,25 @@ class HangDoctor:
         stall_s: float,
         table: List[Dict[str, Any]],
         stuck: List[tuple],
+        reduce_stuck: Optional[List[Dict[str, Any]]] = None,
     ) -> Optional[str]:
         from .flight_recorder import note_failure
 
+        reduce_stuck = reduce_stuck or []
         edges = build_wait_graph(table)
         cycles = find_cycles(edges)
         if cycles:
             detail = "deadlock: " + "; ".join(
                 describe_cycle(c) for c in cycles
+            )
+        elif kind == "reduce_wait" and reduce_stuck:
+            worst = max(reduce_stuck, key=lambda w: w.get("waited_s", 0.0))
+            peer = worst.get("peer")
+            detail = (
+                f"thread {worst['thread']} has waited "
+                f"{worst.get('waited_s', 0.0):.1f}s in cross-process "
+                f"reduce {worst['tag']!r}"
+                + (f" on rank {peer}" if peer is not None else "")
             )
         elif stuck:
             worst_row, worst_w = max(
@@ -323,6 +366,10 @@ class HangDoctor:
             "kind": kind,
             "stall_s": stall_s,
             "edges": edges,
+            "reduce_waits": [
+                {k: v for k, v in w.items() if k != "since"}
+                for w in reduce_stuck
+            ],
             "cycles": [
                 {
                     "threads": [e["waiter"] for e in c],

@@ -108,6 +108,11 @@ LOCK_CATALOG: Dict[str, Dict[str, Any]] = {
     "elastic": {
         "kind": "lock", "module": "spark_rapids_ml_tpu/resilience/elastic.py",
     },
+    # pod rank-loss recovery: generation/plan state, liveness tables,
+    # and the in-flight cross-process wait registry
+    "pod_state": {
+        "kind": "lock", "module": "spark_rapids_ml_tpu/resilience/pod.py",
+    },
     # telemetry/: the registry's own internal lock is named too (it is
     # one of the hottest in the process), plus the install/http/owner
     # guards

@@ -161,6 +161,9 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     # out-of-core epoch engine's decoded-chunk tiers
     "chunk_cache": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "recovery": {"kind": "view", "labels": ("key",), "cardinality": 16},
+    # pod rank-loss recovery (resilience/pod.py): losses detected,
+    # shares reassigned, recoveries, bounded-wait expiries, generation
+    "pod_recovery": {"kind": "view", "labels": ("key",), "cardinality": 16},
     "fused_last": {"kind": "view", "labels": ("key",), "cardinality": 32},
     "pca_solver_last": {"kind": "view", "labels": ("key",), "cardinality": 16},
     # statistic-program engine (stats/engine.py): executions per

@@ -56,6 +56,10 @@ KINDS = (
     "collect",
     "scatter",
     "lock_wait",
+    # bounded cross-process waits (resilience/pod.py kv_wait): time a
+    # rank spent parked on a peer's KV payload — the pod-scale analog of
+    # lock_wait, cause carries "<reduce tag>:rank<peer>"
+    "reduce_wait",
 )
 
 # retained intervals, process-wide: at fused-chunk granularity this is
