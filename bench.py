@@ -48,6 +48,7 @@ WORKLOADS = [
         "BENCH_WORKLOADS",
         "logreg,pca,fused_pca,kmeans,ann,knn,umap,dbscan,staging,cv_cached,"
         "serving,serving_control,serving_scale,drift,utilization,"
+        "pod_observatory,"
         "streaming,summarize,"
         "epoch_cache,multiproc,"
         "refconfig,rf",
@@ -1869,6 +1870,63 @@ def bench_utilization(extra: dict):
     )
 
 
+def bench_pod_observatory(extra: dict):
+    """Pod observatory (telemetry/fleet.py): the cross-rank telemetry's
+    own cost, priced single-process.  Two numbers: (1) folding an
+    8-rank x 2000-event set of Chrome-trace dumps into the one
+    Perfetto-loadable pod trace (the incident-bundle / post-incident
+    merge path) in seconds, and (2) the per-pass bookkeeping a fused
+    accumulate pass pays — pass-id mint, phase clipping over a
+    populated utilization timeline, straggler table, gauges — in
+    microseconds per pass.  Both must stay far below the passes they
+    instrument or the observatory becomes the straggler."""
+    from spark_rapids_ml_tpu.telemetry import fleet, utilization
+
+    # (1) merge cost over a realistic incident-sized input
+    n_ranks = int(os.environ.get("BENCH_POD_OBS_RANKS", 8))
+    n_events = int(os.environ.get("BENCH_POD_OBS_EVENTS", 2000))
+    traces = {
+        r: {
+            "traceEvents": [
+                {"name": f"s{i}", "ph": "X", "ts": float(i), "dur": 1.0,
+                 "pid": 1000 + r, "tid": i % 7,
+                 "args": {"pass_id": "pass-bench"}}
+                for i in range(n_events)
+            ],
+            "displayTimeUnit": "ms",
+        }
+        for r in range(n_ranks)
+    }
+    offsets = {r: (0.001 * r, 0.0005) for r in range(n_ranks)}
+    t0 = time.perf_counter()
+    merged = fleet.merge_chrome_traces(traces, offsets=offsets)
+    merge_s = time.perf_counter() - t0
+    assert len(merged["traceEvents"]) >= n_ranks * n_events
+    extra["pod_observatory_merge_seconds"] = round(merge_s, 4)
+    extra["pod_observatory_merge_events"] = n_ranks * n_events
+
+    # (2) per-pass report cost with a few hundred timeline intervals to
+    # scan (the clip-and-merge work every pass-complete performs)
+    utilization.clear()
+    base = time.perf_counter()
+    for i in range(300):
+        lo = base - 1.0 + i * 1e-4
+        utilization.note_interval(
+            ("device", "host_prep", "reduce_wait")[i % 3],
+            lo, lo + 5e-5, cause="bench",
+        )
+    m = 50
+    t0 = time.perf_counter()
+    for _ in range(m):
+        fleet.begin_pod_pass()
+        fleet.complete_pod_pass(run_id="bench")
+    extra["pod_observatory_pass_report_us"] = round(
+        (time.perf_counter() - t0) / m * 1e6, 1
+    )
+    utilization.clear()
+    fleet.reset_fleet()
+
+
 def bench_cv_cached(extra: dict):
     """Device-resident dataset cache (parallel/device_cache.py): a
     k-fold CrossValidator run on the stage-once cached driver vs the
@@ -2575,6 +2633,7 @@ def main() -> None:
         "serving_scale": bench_serving_scale,
         "drift": bench_drift,
         "utilization": bench_utilization,
+        "pod_observatory": bench_pod_observatory,
         "streaming": bench_streaming,
         "summarize": bench_summarize,
         "epoch_cache": bench_epoch_cache,

@@ -58,6 +58,11 @@ _LOWER_BETTER = (
     "_us_per_acquire",
     "_acquire_us",
     "_tick_us",
+    # pod-observatory pass bookkeeping (bench.py `pod_observatory`
+    # section): the per-pass straggler/report cost rides every fused
+    # accumulate pass — microseconds, or the observatory IS the
+    # straggler
+    "_report_us",
     # serving control plane (bench.py `serving_control` section): the
     # fraction of batch traffic shed during the engineered SLO spike —
     # a controller shedding more than it must is discarding capacity

@@ -75,6 +75,11 @@ _SECTION_PREFIXES: Tuple[Tuple[str, str], ...] = (
     # the observatory ON vs OFF (`_observatory_speedup_x` gates the
     # within-noise-of-1.0 acceptance)
     ("utilization_", "utilization"),
+    # pod observatory (bench.py `pod_observatory` section): the
+    # cross-rank trace merge cost in seconds and the per-pass straggler
+    # bookkeeping in us/pass — both lower-better via the standard
+    # `_seconds` / `_report_us` suffix rules
+    ("pod_observatory_", "pod_observatory"),
 )
 
 # run-level numeric context worth trending as its own pseudo-section

@@ -140,7 +140,8 @@ run_batch tests/test_umap.py tests/test_streaming.py \
     tests/test_no_import_change.py \
     tests/test_pyspark_interop.py \
     tests/test_slow_scale.py tests/test_multiprocess.py \
-    tests/test_multihost_datapath.py tests/test_pod_elastic.py "$@"
+    tests/test_multihost_datapath.py tests/test_pod_elastic.py \
+    tests/test_fleet_observatory.py "$@"
 # guard against a new test file silently missing from the batches: only
 # run_batch lines count as "listed" (not the --fast tier or comments),
 # and discovery recurses like `pytest tests/` did
@@ -199,6 +200,24 @@ echo "== pod chaos smoke: kill -9 one rank mid-pass, survivor byte parity =="
 # visible and runnable in isolation.
 JAX_PLATFORMS=cpu WEDGE_GUARD_S=540 \
     python -m pytest tests/test_pod_elastic.py -q -k chaos
+
+echo "== pod observatory smoke: straggler named, one incident bundle per pod =="
+# tier-1 marker-safe: the cross-rank telemetry acceptance runs.  (1) a
+# 2-rank fused fit with an injected device-side slowdown on rank 1 —
+# the pass-complete straggler exchange must name rank 1 for
+# device_accumulate and the per-rank trace dumps must merge into ONE
+# Perfetto-loadable timeline whose spans share a pod pass id.  (2) the
+# SIGKILL chaos variant — the survivor writes exactly ONE rank_loss
+# bundle carrying a deterministic incident id, with the dead rank's
+# absent ring NAMED and the merged pod trace parseable.  (3) 2-rank
+# split shifted traffic — the fleet-merged drift_score equals the
+# 1-process score over the combined rows, one drift bundle per pod.
+# Self-skips via require_coordination_cpu where 2-rank coordination is
+# unavailable.  Intentionally ALSO in a tier-1 batch above (the
+# batch-completeness guard requires it there); this dedicated step
+# keeps the observatory gate visible and runnable in isolation.
+JAX_PLATFORMS=cpu WEDGE_GUARD_S=540 \
+    python -m pytest tests/test_fleet_observatory.py -q -k two_rank
 
 echo "== elastic-recovery smoke: device loss mid-Lloyd shrinks the mesh =="
 # tier-1 marker-safe: a device_lost injection at Lloyd iteration 4 of a
@@ -902,7 +921,8 @@ echo "== perf smoke: bench history + regression gate =="
 PERF_DIR=$(mktemp -d)
 for i in 1 2; do
     BENCH_ROWS=20000 BENCH_COLS=16 BENCH_CPU_SAMPLE=5000 BENCH_MAX_ITER=10 \
-    BENCH_WORKLOADS=staging,fused_pca BENCH_STAGING_ROWS=40000 \
+    BENCH_WORKLOADS=staging,fused_pca,pod_observatory \
+    BENCH_STAGING_ROWS=40000 \
     BENCH_FUSED_ROWS=48000 BENCH_FUSED_COLS=64 BENCH_FUSED_SOLVER_ROWS=2000 \
     BENCH_ISOLATE=0 \
     BENCH_PROBE_TIMEOUT=0 BENCH_RUN_ID="perf-smoke-$i" \
@@ -924,6 +944,12 @@ python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
 python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
     --sections fused_pca --tolerance 10 \
     --band fused_pca_overlap_fraction=0.75 --abs-floor 0.05
+# pod-observatory gate: the trace merge and per-pass report costs are
+# pure-python microbenchmarks — wide band + the 50 ms absolute floor
+# absorbs shared-box scheduler jitter while still catching an
+# order-of-magnitude regression in the merge or pass-complete path
+python -m benchmark.compare --history "$PERF_DIR/history.jsonl" \
+    --sections pod_observatory --tolerance 2.0 --abs-floor 0.05
 # injected serialization: staging_pipeline_depth=1 strips the producer
 # thread, the prep and accumulate windows stop co-occurring, and the
 # recorded overlap_fraction collapses to 0.0 — the comparator must trip
@@ -957,7 +983,7 @@ for rid, secs in per_run.items():
     want = (
         {"logreg", "fused_pca"}
         if rid == "perf-smoke-serialized"
-        else {"logreg", "staging", "fused_pca"}
+        else {"logreg", "staging", "fused_pca", "pod_observatory"}
     )
     assert want <= set(secs), (rid, secs)
 # inject a synthetic 2x slowdown of run 2 and expect the gate to trip

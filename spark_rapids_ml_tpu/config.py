@@ -95,6 +95,19 @@ _DEFAULTS: Dict[str, Any] = {
     # has not advanced for this many seconds — a slow-but-beating rank
     # is waited on to the full multiproc_reduce_timeout_s instead.
     "pod_death_grace_s": 10.0,
+    # Pod incident bundles (telemetry/fleet.py): total deadline for the
+    # dumping rank's best-effort pull of its peers' flight-recorder
+    # rings.  Shared across all peers — a slow pod spends at most this
+    # long collecting evidence before writing the bundle with whatever
+    # arrived; absent rings are named in pod_incident.json.
+    "pod_incident_ring_deadline_s": 2.0,
+    # Fleet-merged drift windows (monitor/monitor.py + fleet.py): "on"
+    # publishes each closed serve-time drift window's sketch blob to
+    # the pod KV seam and merges peers' latest blobs rank-ordered, so
+    # drift_score reflects pod-wide traffic (per-host partials stay on
+    # drift_score_partial{model,process}); "off" keeps drift purely
+    # per-process.
+    "drift_fleet_merge": "on",
     # Spark-DataFrame exchange: datasets estimated above this many bytes
     # are written by the EXECUTORS to `spark_exchange_dir` as parquet and
     # fit through the streaming-ingest path instead of `toPandas()`

@@ -813,6 +813,13 @@ def accumulate_chunks(
     from .stats.engine import _device_step_lock
 
     _baseline.begin_pass()
+    # pod observatory (telemetry/fleet.py): one pod-global pass id per
+    # accumulate pass — rank 0 mints, the broadcast seam distributes,
+    # every rank's spans and reduce-wait intervals carry it until the
+    # pass report closes below.  SPMD site, like begin_pass itself
+    from .telemetry import fleet as _fleet
+
+    _fleet.begin_pod_pass()
 
     t0 = time.perf_counter()
     # a producer that tracks its own prep (the parallel parquet readers)
@@ -893,6 +900,13 @@ def accumulate_chunks(
 
     utilization.note_intervals("device", acc_iv, cause="fused_accumulate")
     utilization.note_intervals("host_prep", prep_iv, cause="chunk_prep")
+    # close the pod pass AFTER the intervals land: the straggler blob
+    # is computed from the timeline, and its reduce_blob_list exchange
+    # is the pass's last SPMD site (every rank reaches it after the
+    # fold above succeeded)
+    from .tracing import current_run_id
+
+    _fleet.complete_pod_pass(run_id=current_run_id())
     return host, {
         "wall_s": wall,
         "host_prep_s": prep["s"],

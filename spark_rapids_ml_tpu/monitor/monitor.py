@@ -47,7 +47,12 @@ from ..config import get_config
 from ..telemetry.registry import counter, gauge
 from ..utils import get_logger
 from .compare import STAT_NAMES, divergence_table
-from .fingerprint import BaselineBuilder, Fingerprint
+from .fingerprint import (
+    BaselineBuilder,
+    Fingerprint,
+    builder_from_bytes,
+    builder_to_bytes,
+)
 
 logger = get_logger("spark_rapids_ml_tpu.monitor")
 
@@ -55,6 +60,11 @@ DRIFT_SCORE = gauge(
     "drift_score",
     "Data/model drift divergence per model, column and statistic "
     "(top-k drifting columns; column=_overall is the alert score)",
+)
+DRIFT_SCORE_PARTIAL = gauge(
+    "drift_score_partial",
+    "This process's LOCAL window drift score per model, next to the "
+    "pod-merged drift_score (fleet merge on, multi-process only)",
 )
 DRIFT_ROWS = counter(
     "drift_rows_observed_total",
@@ -98,7 +108,10 @@ class _Window:
     def fold(self, X: np.ndarray) -> None:
         self.cur.update(X)
 
-    def view(self) -> Optional[Fingerprint]:
+    def view_builder(self) -> Optional[BaselineBuilder]:
+        """The merged last+current BUILDER behind `view()` — the pod
+        drift merge (telemetry/fleet.py) folds peers' window blobs into
+        this before finalizing."""
         if self.last is not None and (
             (self.last.k, self.last.cap, self.last.bits)
             != (self.cur.k, self.cur.cap, self.cur.bits)
@@ -110,13 +123,16 @@ class _Window:
             # conf-geometry changes safe; so must this path)
             self.last = None
         if self.last is not None and self.last.n > 0:
-            merged = (
+            return (
                 self.last.merge(self.cur) if self.cur.n > 0 else self.last
             )
-            return merged.finalize(self.columns)
         if self.cur.n == 0:
             return None
-        return self.cur.finalize(self.columns)
+        return self.cur
+
+    def view(self) -> Optional[Fingerprint]:
+        b = self.view_builder()
+        return None if b is None else b.finalize(self.columns)
 
 
 class _ModelState:
@@ -172,6 +188,14 @@ class DriftMonitor:
         if st is not None:
             self._prune(name, st.exported, set())
             DRIFT_SCORE.remove(model=name, column="_overall", stat="score")
+            try:
+                from ..parallel.context import process_topology
+
+                DRIFT_SCORE_PARTIAL.remove(
+                    model=name, process=str(process_topology()[1])
+                )
+            except Exception:
+                pass
 
     def tracks(self, name: str) -> bool:
         with self._mu:
@@ -255,28 +279,57 @@ class DriftMonitor:
         window_s = max(float(get_config("drift_window_s")), 1e-3)
         min_rows = max(int(get_config("drift_min_window_rows")), 1)
         top_k = max(int(get_config("drift_top_k")), 1)
+        fleet_on = self._fleet_active()
         with self._mu:
             st = self._models.get(name)
             if st is None:
                 return None
-            st.window.maybe_roll(window_s)
+            closed = st.window.maybe_roll(window_s)
             for key, w in st.outputs.items():
-                closed = w.maybe_roll(window_s)
-                if closed is not None and key not in st.out_refs:
+                oclosed = w.maybe_roll(window_s)
+                if oclosed is not None and key not in st.out_refs:
                     # the first closed window freezes as the output
                     # reference distribution
-                    ref = closed.finalize([key])
+                    ref = oclosed.finalize([key])
                     if ref is not None:
                         st.out_refs[key] = ref
             view = st.window.view()
+            pod_vb = None
+            if fleet_on:
+                pod_vb = st.window.view_builder()
+                if pod_vb is st.window.cur and pod_vb.n > 0:
+                    # the live builder keeps folding once the lock
+                    # drops; the pod merge below runs unlocked (it
+                    # probes the KV seam), so it works on a wire-
+                    # round-trip SNAPSHOT instead
+                    pod_vb = builder_from_bytes(builder_to_bytes(pod_vb))
+            columns = list(st.window.columns)
             baseline = st.baseline
             out_views = {
                 key: (st.out_refs.get(key), w.view())
                 for key, w in st.outputs.items()
             }
+        partial: Optional[Fingerprint] = None
+        if fleet_on:
+            pod_view = self._pod_view(name, closed, pod_vb, columns)
+            if pod_view is not None:
+                view, partial = pod_view, view
         if view is None or view.n < min_rows:
             return None
         table = divergence_table(baseline, view, top_k)
+        if partial is not None and partial.n >= min_rows:
+            # the local window's score stays visible next to the
+            # pod-merged one, keyed by this process's rank
+            try:
+                from ..parallel.context import process_topology
+
+                pt = divergence_table(baseline, partial, 1)
+                DRIFT_SCORE_PARTIAL.set(
+                    pt["overall"], model=name,
+                    process=str(process_topology()[1]),
+                )
+            except Exception:
+                pass
         out_scores: Dict[str, float] = {}
         for key, (ref, wv) in out_views.items():
             if ref is None or wv is None or wv.n < min_rows:
@@ -297,6 +350,65 @@ class DriftMonitor:
                 st.last_table = table
                 st.last_out = out_scores
         return table
+
+    @staticmethod
+    def _fleet_active() -> bool:
+        """Whether the pod drift merge applies right now: multi-process
+        topology, `drift_fleet_merge` on, seam importable."""
+        try:
+            from ..parallel.context import process_topology
+            from ..telemetry import fleet
+
+            return (
+                process_topology()[0] > 1 and fleet.fleet_drift_enabled()
+            )
+        except Exception:
+            return False
+
+    def _pod_view(
+        self,
+        name: str,
+        closed: Optional[BaselineBuilder],
+        vb: Optional[BaselineBuilder],
+        columns: List[str],
+    ) -> Optional[Fingerprint]:
+        """The pod-wide scoring view: publish this rank's just-closed
+        window blob (non-collective — idle peers owe nothing), drain
+        peers' latest blobs, and merge local + peers in ASCENDING rank
+        order (the deterministic fold every reduction here uses; the
+        SRSK wire merge is exact, so the pod view over split traffic
+        equals one process folding the combined rows).  Returns None
+        when nothing merged — the caller keeps the local view.  Never
+        raises into the serving path."""
+        try:
+            from ..parallel.context import process_topology
+            from ..telemetry import fleet
+
+            if closed is not None and closed.n > 0:
+                fleet.publish_drift_window(
+                    name, builder_to_bytes(closed)
+                )
+            me = process_topology()[1]
+            ranked: Dict[int, Optional[BaselineBuilder]] = {me: vb}
+            for r, blob in fleet.fetch_peer_drift_windows(name).items():
+                try:
+                    ranked[int(r)] = builder_from_bytes(blob)
+                except Exception:
+                    continue  # one bad blob must not drop the rest
+            merged: Optional[BaselineBuilder] = None
+            for r in sorted(ranked):
+                b = ranked[r]
+                if b is None or b.n == 0:
+                    continue
+                try:
+                    merged = b if merged is None else merged.merge(b)
+                except Exception:
+                    continue  # geometry drift on one peer: keep the rest
+            if merged is None:
+                return None
+            return merged.finalize(columns)
+        except Exception:
+            return None
 
     def _export(
         self, name: str, table: Dict[str, Any],
@@ -361,6 +473,7 @@ class DriftMonitor:
                 st.above_since = None  # re-arm; the recorder cooldown
                 st.alerts += 1         # absorbs a persisting breach
             baseline = st.baseline
+            alerts = st.alerts
         if not fire:
             return
         from ..telemetry.flight_recorder import note_failure
@@ -372,10 +485,29 @@ class DriftMonitor:
             f"window_rows={table['window_rows']}"
         )
         event(f"drift_alert[{name}]", detail=detail, log=logger)
+        # pod mode: ONE bundle per pod incident, not one per rank — the
+        # merged view crossed the threshold everywhere, so only rank 0
+        # dumps, under a deterministic incident id any rank could mint
+        incident_id = ""
+        if self._fleet_active():
+            try:
+                from ..parallel.context import process_topology
+                from ..resilience.pod import generation
+                from ..telemetry import fleet
+
+                if process_topology()[1] != 0:
+                    return
+                incident_id = fleet.mint_incident_id(
+                    "drift", f"{name}/{alerts}", generation=generation()
+                )
+                detail += f" incident={incident_id}"
+            except Exception:
+                incident_id = ""
         note_failure(
             "drift",
             detail=detail,
             log=logger,
+            incident_id=incident_id,
             attachments={
                 "drift": {
                     "model": name,
@@ -420,4 +552,10 @@ class DriftMonitor:
 # the process-global monitor the serving layer feeds
 MONITOR = DriftMonitor()
 
-__all__ = ["DriftMonitor", "MONITOR", "DRIFT_ROWS", "DRIFT_SCORE"]
+__all__ = [
+    "DriftMonitor",
+    "MONITOR",
+    "DRIFT_ROWS",
+    "DRIFT_SCORE",
+    "DRIFT_SCORE_PARTIAL",
+]

@@ -383,6 +383,51 @@ def _kv_take(
     )
 
 
+def coordination_client():
+    """Public handle to the live coordination-service client, or None
+    outside distributed mode — the pod observatory's entry point to the
+    KV seam without reaching into module privates."""
+    return _coordination_client()
+
+
+def kv_publish(key: str, payload: bytes) -> None:
+    """Write one generation-namespaced, write-once KV payload under
+    `srmt/g{gen}/{key}` (base64 on the wire, symmetric with `kv_fetch`).
+    NON-collective — the publish side of the pod observatory's
+    pull-based exchanges (incident rings, fleet drift blobs): nobody is
+    obligated to read it, and a zombie's late write lands in a dead
+    generation's namespace like every other KV key."""
+    client = _coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "kv_publish: jax.distributed is not initialized (no "
+            "coordination client)"
+        )
+    _kv_put(client, f"{_gen_prefix()}/{key}", payload)
+
+
+def kv_fetch(
+    key: str,
+    timeout_ms: int,
+    tag: str = "",
+    peer: Optional[int] = None,
+) -> bytes:
+    """Bounded read of one `kv_publish` payload: goes through the pod
+    layer's `kv_wait`, so a missing payload surfaces as typed
+    `ReduceTimeout` (or `RankLost` when the peer's heartbeat is gone),
+    never an unbounded client block — the pull side of the
+    observatory's non-collective exchanges."""
+    client = _coordination_client()
+    if client is None:
+        raise RuntimeError(
+            "kv_fetch: jax.distributed is not initialized (no "
+            "coordination client)"
+        )
+    return _kv_take(
+        client, f"{_gen_prefix()}/{key}", timeout_ms, tag=tag, peer=peer
+    )
+
+
 def allgather_bytes(
     tag: str, payload: bytes, timeout_s: Optional[float] = None
 ) -> List[bytes]:

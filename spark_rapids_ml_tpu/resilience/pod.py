@@ -314,8 +314,15 @@ def _hb_loop(client, boot_rank: int, stop: threading.Event) -> None:
     while not stop.is_set():
         try:
             # the KV store is write-once across the jaxlib versions we
-            # support, so the beat is a monotonic KEY, not a mutated value
-            client.key_value_set(f"srmt/hb/{boot_rank}/{n}", "1")
+            # support, so the beat is a monotonic KEY, not a mutated
+            # value.  The VALUE is the sender's wall clock at write time:
+            # liveness only checks key existence (any value works), while
+            # the pod trace merger (telemetry/fleet.py) reads it as a
+            # clock-offset sample — (send stamp, receive stamp) pairs
+            # bound each peer's skew to within one heartbeat interval.
+            client.key_value_set(
+                f"srmt/hb/{boot_rank}/{n}", repr(time.time())
+            )
             n += 1
         except Exception:  # pragma: no cover - client teardown races
             pass
@@ -383,11 +390,25 @@ def _probe_liveness(client, boot_ranks, my_boot: int) -> Dict[int, float]:
         advanced = False
         while True:
             try:
-                client.blocking_key_value_get(f"srmt/hb/{r}/{nxt}", _HB_PROBE_MS)
+                beat = client.blocking_key_value_get(
+                    f"srmt/hb/{r}/{nxt}", _HB_PROBE_MS
+                )
             except Exception:
                 break
             nxt += 1
             advanced = True
+            # the beat value is the sender's wall clock at write time
+            # (see _hb_loop): feed it to the fleet clock-offset
+            # estimator.  Legacy "1" values (pre-timestamp peers) parse
+            # as implausible and are rejected there; never raises.
+            try:
+                from ..telemetry import fleet as _fleet
+
+                _fleet.note_clock_sample(r, float(beat), time.time())
+            except (TypeError, ValueError):
+                pass
+            except Exception:  # pragma: no cover - telemetry never raises
+                pass
         with _lock:
             _hb_next[r] = nxt
             if advanced or r not in _hb_seen:
@@ -466,6 +487,7 @@ def kv_wait(
 
     maybe_inject("kv_wait")
     t0 = time.monotonic()
+    t0_abs = time.time()
     tid = threading.get_ident()
     entry = {
         "thread": threading.current_thread().name,
@@ -518,6 +540,19 @@ def kv_wait(
             note_interval(
                 "reduce_wait", t0, time.monotonic(), cause=cause, domain="any"
             )
+            # non-trivial waits also land as trace spans (run id + the
+            # pod-global pass id), so a merged pod trace SHOWS which
+            # rank was parked on which peer during a correlated pass
+            waited = time.monotonic() - t0
+            if waited >= 0.001:
+                from ..tracing import record_span
+
+                record_span(
+                    f"reduce_wait[{tag or key}]", t0_abs, t0_abs + waited,
+                    detail=(
+                        f"peer=rank{peer}" if peer is not None else ""
+                    ),
+                )
         except Exception:  # pragma: no cover - telemetry must never raise
             pass
 
@@ -611,6 +646,28 @@ def recover_from_rank_loss(exc=None, log=None) -> bool:
         POD_METRICS["rank_losses_detected"] += len(dead_boot)
     from ..telemetry.flight_recorder import note_failure
 
+    # ONE incident id per pod event: deterministic over (reason, the
+    # detection generation, the dead set), so every survivor computes
+    # the SAME id without communicating — their bundles correlate, and
+    # aggregate.py fleet sums group per incident instead of counting one
+    # death N times.  The survivors also swap their recent recorder
+    # rings over the KV seam (deadline-bounded, absent peers named) so
+    # the dumping rank writes ONE bundle carrying the whole pod's
+    # timeline.
+    incident_id = ""
+    ring_attachments: Dict = {}
+    try:
+        from ..telemetry import fleet as _fleet
+
+        incident_id = _fleet.mint_incident_id(
+            "rank_loss", f"dead={sorted(dead_boot)}", generation=generation()
+        )
+        ring_attachments = _fleet.exchange_incident_rings(
+            incident_id, dead=dead_boot
+        )
+    except Exception:  # pragma: no cover - telemetry must never block recovery
+        pass
+
     if my_boot != 0 and 0 in dead_boot:
         # the coordinator process hosts the KV store: with it gone the
         # wire has nothing to reduce over — the only sound answer is a
@@ -621,7 +678,9 @@ def recover_from_rank_loss(exc=None, log=None) -> bool:
             attachments={
                 "pass_manifest": pass_manifest(),
                 "liveness": liveness_table(),
+                **ring_attachments,
             },
+            incident_id=incident_id,
             log=lg,
         )
         lg.warning(
@@ -673,7 +732,9 @@ def recover_from_rank_loss(exc=None, log=None) -> bool:
     _pctx.set_topology_override(len(survivors), new_rank)
     detail = (
         f"dead={sorted(dead_boot)} survivors={list(new_boots)} "
-        f"gen={gen} shares_reassigned={len(dead_entries)}"
+        f"gen={gen} shares_reassigned={len(dead_entries)} "
+        f"rank={my_boot}"
+        + (f" incident={incident_id}" if incident_id else "")
     )
     note_failure(
         "rank_loss",
@@ -682,7 +743,9 @@ def recover_from_rank_loss(exc=None, log=None) -> bool:
             "pass_manifest": pass_manifest(),
             "liveness": liveness_table(),
             "recovery_plan": plan.as_dict(),
+            **ring_attachments,
         },
+        incident_id=incident_id,
         log=lg,
     )
     event("pod_recovery[shrink]", detail=detail, log=lg)
@@ -709,6 +772,14 @@ def on_reinit() -> int:
         _hb_next.clear()
         _hb_seen.clear()
         _pass_manifest.clear()
+    try:
+        # clock-offset samples and incident dedupe are per-bootstrap
+        # state too: a new world's peers have new clocks
+        from ..telemetry import fleet as _fleet
+
+        _fleet.on_reinit()
+    except Exception:  # pragma: no cover - import-order defensive
+        pass
     from ..parallel.context import clear_topology_override
 
     clear_topology_override()

@@ -680,6 +680,16 @@ class ServingServer:
         )
         if util:
             out["_totals"]["utilization"] = util
+        # the pod-observatory view (telemetry/fleet.py): last pod pass
+        # report + live peer clock-offset table — empty single-process
+        try:
+            from ..telemetry import fleet
+
+            pod = fleet.fleet_summary()
+            if pod:
+                out["_totals"]["pod"] = pod
+        except Exception:
+            pass
         return out
 
     def pipeline_info(self) -> Dict[str, Any]:

@@ -392,6 +392,11 @@ def _one_pass(
     # dies mid-loop deliberately leaves its last state visible for the
     # flight recorder
     rid = current_run_id() or mint_run_id("summarize")
+    # pod observatory (telemetry/fleet.py): pod-global pass id for this
+    # statistics pass — SPMD site, every rank mints/receives here
+    from ..telemetry import fleet as _fleet
+
+    _fleet.begin_pod_pass()
     pass_token = {"overlapped": False}
     with _stat_metrics_lock:
         if _PASS_STATE["live"]:
@@ -490,6 +495,10 @@ def _one_pass(
 
         utilization.note_intervals("device", acc_iv, cause="stat_programs")
         utilization.note_intervals("host_prep", prep_iv, cause="chunk_prep")
+        # close the pod pass after the intervals land (the straggler
+        # blob reads the timeline); its exchange is the pass's last
+        # SPMD site
+        _fleet.complete_pod_pass(run_id=rid)
         overlap_s = _interval_overlap_s(prep_iv, acc_iv)
         overlap = 0.0
         if min(prep["s"], acc_s) > 1e-9:

@@ -71,6 +71,16 @@ from .flight_recorder import (  # noqa: F401
     FlightRecorder,
     note_failure,
 )
+
+# pod observatory — the cross-rank correlation layer (stdlib-only at
+# module scope, like everything else in this package)
+from .fleet import (  # noqa: F401
+    begin_pod_pass,
+    clock_offsets,
+    complete_pod_pass,
+    merge_chrome_traces,
+    mint_incident_id,
+)
 from .hang_doctor import (  # noqa: F401
     DOCTOR,
     HangDoctor,
@@ -146,10 +156,13 @@ __all__ = [
     "RECORDER",
     "REGISTRY",
     "SimulatedMemoryProvider",
+    "begin_pod_pass",
     "check_cardinality",
     "chrome_trace",
+    "clock_offsets",
     "compile_label",
     "compile_span",
+    "complete_pod_pass",
     "counter",
     "delta",
     "dict_view",
@@ -165,7 +178,9 @@ __all__ = [
     "find_cycles",
     "lock_table",
     "maybe_start_http_server",
+    "merge_chrome_traces",
     "merge_prometheus",
+    "mint_incident_id",
     "named_lock",
     "note_failure",
     "note_interval",

@@ -34,9 +34,12 @@
 #
 from __future__ import annotations
 
+import glob as _glob
+import json
+import os
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .exporters import parse_prometheus_families, render_families
 
@@ -146,15 +149,49 @@ class ScrapeResult:
         )
 
 
+def _expand_file_globs(
+    targets: Dict[str, str], absent: Dict[str, str]
+) -> Dict[str, str]:
+    """Expand `file://<glob>` targets in place: ONE pattern covering
+    every rank's on-disk dump (`file:///run/telemetry/rank*.prom`)
+    becomes one target per matching file, named `{name}:{basename}` —
+    the no-URL-list form pod CI smokes and air-gapped runs use.  The
+    dead-rank contract is preserved: a pattern matching NOTHING is
+    reported absent under its own name (a rank that never wrote its
+    dump must not silently vanish from the merge), and matched files
+    that fail to read land in `.absent` individually."""
+    out: Dict[str, str] = {}
+    for name in sorted(targets):
+        url = targets[name]
+        if not str(url).startswith("file://"):
+            out[name] = url
+            continue
+        pattern = str(url)[len("file://"):]
+        matches = sorted(_glob.glob(pattern))
+        if not matches:
+            absent[name] = f"no files matched {pattern!r}"
+            continue
+        if len(matches) == 1 and matches[0] == pattern:
+            out[name] = url  # literal single-file target keeps its name
+            continue
+        for path in matches:
+            out[f"{name}:{os.path.basename(path)}"] = f"file://{path}"
+    return out
+
+
 def scrape_endpoints(
     targets: Dict[str, str], timeout_s: float = 5.0
 ) -> ScrapeResult:
     """Scrape `{process_name: url}` `telemetry_port` endpoints (each url
     is the full `http://host:port/metrics`) and merge what answered.
-    Unreachable/erroring endpoints land in `.absent` with the error —
-    the fleet view names its blind spots instead of zero-filling them.
-    Targets fetch CONCURRENTLY (bounded pool), so a round over a fleet
-    with dead hosts costs ~one timeout, not one per dead host."""
+    `file://` targets may be GLOB patterns — one pattern matching every
+    rank's written dump expands to one page per matching file (named
+    `{name}:{basename}`); a pattern matching nothing is absent under
+    its own name.  Unreachable/erroring endpoints land in `.absent`
+    with the error — the fleet view names its blind spots instead of
+    zero-filling them.  Targets fetch CONCURRENTLY (bounded pool), so a
+    round over a fleet with dead hosts costs ~one timeout, not one per
+    dead host."""
 
     def _fetch(url: str) -> str:
         with urllib.request.urlopen(url, timeout=timeout_s) as resp:
@@ -162,6 +199,7 @@ def scrape_endpoints(
 
     pages: Dict[str, str] = {}
     absent: Dict[str, str] = {}
+    targets = _expand_file_globs(targets, absent)
     names = sorted(targets)
     if names:
         with ThreadPoolExecutor(
@@ -222,11 +260,39 @@ def merge_pages_from_files(
     return merge_prometheus(pages)
 
 
+def group_postmortems_by_incident(
+    base_dirs: Iterable[str],
+) -> Dict[str, List[str]]:
+    """Group flight-recorder bundles (`postmortem_*` directories under
+    each base dir) by the pod incident id in their manifests: one
+    rank-loss event makes every survivor dump, so a fleet sum of
+    `postmortems_total` counts it N times — grouping per incident id
+    restores "one event, one row".  Bundles WITHOUT an incident id
+    (ordinary per-process failures) each form their own group, keyed by
+    their bundle path; unreadable manifests are skipped.  Returns
+    `{group_key: [bundle_dir, ...]}` sorted within each group."""
+    groups: Dict[str, List[str]] = {}
+    for base in base_dirs:
+        for mpath in sorted(
+            _glob.glob(os.path.join(str(base), "postmortem_*", "manifest.json"))
+        ):
+            bdir = os.path.dirname(mpath)
+            try:
+                with open(mpath, "r") as f:
+                    manifest = json.load(f)
+            except Exception:
+                continue
+            key = str(manifest.get("incident_id") or "") or bdir
+            groups.setdefault(key, []).append(bdir)
+    return {k: sorted(v) for k, v in groups.items()}
+
+
 __all__ = [
     "ScrapeResult",
     "counter_total",
     "dump_merged",
     "endpoints_for_hosts",
+    "group_postmortems_by_incident",
     "merge_pages_from_files",
     "merge_prometheus",
     "scrape_endpoints",

@@ -109,6 +109,10 @@ def chrome_trace(
             args["detail"] = e.detail
         if e.run_id:
             args["run_id"] = e.run_id
+        # the pod-global pass id (telemetry/fleet.py): the join key a
+        # merged pod trace correlates cross-rank spans on
+        if getattr(e, "pass_id", ""):
+            args["pass_id"] = e.pass_id
         if getattr(e, "kind", "span") == "instant":
             out.append(
                 {

@@ -80,6 +80,11 @@ class FlightRecorder:
         self._enabled = True
         self._conf_countdown = 0
         self._last_dump: Dict[str, float] = {}  # reason -> monotonic t
+        # pod incidents already dumped by THIS process: one pod-scale
+        # event (rank loss detected, then its reduce timing out, then
+        # the retry failing) must write one bundle here, not one per
+        # typed failure path it cascades through
+        self._seen_incidents: Dict[str, float] = {}
         self.cooldown_s = _DUMP_COOLDOWN_S
 
     # -- recording (the hot path) -------------------------------------------
@@ -149,6 +154,7 @@ class FlightRecorder:
             self._last_snap = {}
             self._last_snap_t = 0.0
             self._last_dump.clear()
+            self._seen_incidents.clear()
 
     # -- dumping -------------------------------------------------------------
 
@@ -165,6 +171,7 @@ class FlightRecorder:
         self, reason: str, detail: str = "",
         log: Optional[object] = None,
         attachments: Optional[Dict[str, Any]] = None,
+        incident_id: str = "",
     ) -> Optional[str]:
         """A typed failure path fired: write a post-mortem bundle
         (rate-limited — one per `reason` per cooldown window) and return
@@ -172,11 +179,17 @@ class FlightRecorder:
         destination configured).  `attachments` adds caller evidence to
         the bundle (the drift monitor ships both distribution
         fingerprints + the divergence table): `bytes` values write
-        verbatim under their key, anything else as `<key>.json`.  NEVER
+        verbatim under their key, anything else as `<key>.json`.
+        `incident_id` marks a pod-scale event (telemetry/fleet.py mints
+        one deterministic id per incident): it lands in the manifest so
+        fleet aggregation can group the pod's bundles per incident, and
+        this process dedupes on it — the same incident cascading
+        through several typed failure paths writes ONE bundle.  NEVER
         raises: the black box must not add a second failure to the one
         being recorded."""
         prev = None
         claimed = False
+        inc_claimed = False
         try:
             with self._lock:
                 if self._conf_countdown <= 0:
@@ -184,6 +197,8 @@ class FlightRecorder:
                 if not self._enabled:
                     return None
                 now = time.monotonic()
+                if incident_id and incident_id in self._seen_incidents:
+                    return None
                 prev = self._last_dump.get(reason)
                 if prev is not None and now - prev < self.cooldown_s:
                     return None
@@ -191,8 +206,12 @@ class FlightRecorder:
                 # a concurrent storm writes one bundle, not N...
                 self._last_dump[reason] = now
                 claimed = True
+                if incident_id:
+                    self._seen_incidents[incident_id] = now
+                    inc_claimed = True
             bdir = self.dump(reason, detail, log=log,
-                             attachments=attachments)
+                             attachments=attachments,
+                             incident_id=incident_id)
             if bdir is None:
                 # ...but a dump that wrote NOTHING (no destination
                 # configured yet) must not burn the slot: the operator
@@ -204,6 +223,8 @@ class FlightRecorder:
                             self._last_dump.pop(reason, None)
                         else:
                             self._last_dump[reason] = prev
+                    if inc_claimed:
+                        self._seen_incidents.pop(incident_id, None)
             return bdir
         except Exception as e:  # pragma: no cover - defensive
             with self._lock:
@@ -212,6 +233,8 @@ class FlightRecorder:
                         self._last_dump.pop(reason, None)
                     else:
                         self._last_dump[reason] = prev
+                if inc_claimed:
+                    self._seen_incidents.pop(incident_id, None)
             _warn(log, f"flight-recorder dump failed "
                        f"({type(e).__name__}: {e})")
             return None
@@ -220,6 +243,7 @@ class FlightRecorder:
         self, reason: str, detail: str = "",
         log: Optional[object] = None,
         attachments: Optional[Dict[str, Any]] = None,
+        incident_id: str = "",
     ) -> Optional[str]:
         """Write the bundle unconditionally (no cooldown — operator/test
         entry point).  Returns the bundle directory, or None when no
@@ -278,6 +302,7 @@ class FlightRecorder:
             "run_ids": sorted({e.run_id for e in evs if e.run_id}),
             "solver_state": _solver_state(),
             "metric_deltas": self.metric_deltas(),
+            **({"incident_id": incident_id} if incident_id else {}),
             **({"attachments": attached} if attached else {}),
         }
         with open(os.path.join(bdir, "manifest.json"), "w") as f:
@@ -339,12 +364,15 @@ def install() -> FlightRecorder:
 def note_failure(
     reason: str, detail: str = "", log: Optional[object] = None,
     attachments: Optional[Dict[str, Any]] = None,
+    incident_id: str = "",
 ) -> Optional[str]:
     """Module-level convenience over `RECORDER.note_failure` — the one
     call the failure hooks (retry exhaustion, DispatchTimeout,
-    device-loss recovery, sustained overload, sustained drift) make."""
+    device-loss recovery, sustained overload, sustained drift, pod rank
+    loss) make."""
     return RECORDER.note_failure(reason, detail, log=log,
-                                 attachments=attachments)
+                                 attachments=attachments,
+                                 incident_id=incident_id)
 
 
 def measure_overhead(n: int = 2000) -> float:

@@ -154,6 +154,12 @@ LOCK_CATALOG: Dict[str, Dict[str, Any]] = {
     "native_build": {
         "kind": "lock", "module": "spark_rapids_ml_tpu/native.py",
     },
+    # fleet.py: pod-observatory state — peer clock samples, current
+    # pass bookkeeping, drift-window publish/fetch caches.  Never held
+    # across a KV wait
+    "fleet_state": {
+        "kind": "lock", "module": "spark_rapids_ml_tpu/telemetry/fleet.py",
+    },
 }
 
 # waits shorter than this never record a lock_wait utilization interval

@@ -285,6 +285,26 @@ METRIC_CATALOG: Dict[str, Dict[str, Any]] = {
     "multiproc_reductions_total": {
         "kind": "counter", "labels": ("backend",), "cardinality": 4,
     },
+    # pod observatory (telemetry/fleet.py): per-rank wall seconds by
+    # pass phase (decode | device_accumulate | reduce_wait) from the
+    # last pod pass report — every rank publishes the SAME table, so
+    # any one scrape names the straggler; pod-scale incidents minted,
+    # by reason (rank_loss | drift | ...) — each incident id is shared
+    # by every bundle the event produced across the pod
+    "pod_straggler_seconds": {
+        "kind": "gauge", "labels": ("rank", "phase"), "cardinality": 256,
+    },
+    "pod_incidents_total": {
+        "kind": "counter", "labels": ("reason",), "cardinality": 16,
+    },
+    # fleet-merged drift (monitor/monitor.py + telemetry/fleet.py):
+    # `drift_score` itself reflects pod-wide traffic after the
+    # rank-ordered sketch merge; this family keeps each host's LOCAL
+    # window score visible next to it, keyed by process rank
+    "drift_score_partial": {
+        "kind": "gauge", "labels": ("model", "process"),
+        "cardinality": 256,
+    },
 }
 
 _DEFAULT_BUCKETS = (

@@ -414,6 +414,22 @@ class FitTelemetry:
                 })
         except Exception:
             pass
+        # pod pass report (telemetry/fleet.py LAST_PASS_REPORT): the
+        # straggler table of the last pod-correlated pass — same
+        # last-run-state discipline, so a report only claims a pass
+        # that completed inside its own window
+        pass_report: Dict[str, Any] = {}
+        try:
+            from . import fleet as _fleet
+
+            rep = _fleet.pass_report()
+            if (
+                not self._overlapped
+                and rep.get("stamp", 0) >= self._t0
+            ):
+                pass_report = rep
+        except Exception:
+            pass
 
         report: Dict[str, Any] = {
             "run_id": self.run_id,
@@ -467,6 +483,8 @@ class FitTelemetry:
             report["fused"] = fused
         if stats_section:
             report["stats"] = stats_section
+        if pass_report:
+            report["pass_report"] = pass_report
         if solver_decision:
             report["solver_decision"] = solver_decision
         if self._watermark is not None:

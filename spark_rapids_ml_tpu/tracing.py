@@ -53,6 +53,11 @@ class TraceEvent:
     thread_id: int = 0  # threading.get_ident() of the recording thread
     run_id: str = ""  # the fit/transform run this event belongs to
     kind: str = "span"  # "span" (timed stage) | "instant" (marker)
+    # the pod-global pass id active when the event was recorded
+    # (telemetry/fleet.py: rank 0 mints it at begin_pass and broadcasts
+    # over the KV seam, so the SAME id lands on every rank's spans) —
+    # the cross-rank correlation key a merged pod trace is joined on
+    pass_id: str = ""
 
 
 # every thread's record list, registered once at creation so the
@@ -150,6 +155,26 @@ def get_all_trace_events(run_id: Optional[str] = None) -> List[TraceEvent]:
 # Run correlation — one id per fit/transform
 # ---------------------------------------------------------------------------
 
+# the pod-global pass id (telemetry/fleet.py begin_pod_pass): PROCESS-
+# global, not thread-local — the producer/prefetch threads of a fused
+# pass must stamp the same id as the consumer that minted it.  A str
+# assignment is GIL-atomic, so readers never need the lock.
+_current_pass_id = ""
+
+
+def current_pass_id() -> str:
+    """The pod-global pass id active in this process ('' outside any
+    pod-correlated pass)."""
+    return _current_pass_id
+
+
+def set_current_pass_id(pass_id: str) -> None:
+    """Install (or clear, with '') the process-global pass id every
+    subsequently recorded span/instant is stamped with.  Called by
+    telemetry/fleet.py at begin/complete of a pod-correlated pass."""
+    global _current_pass_id
+    _current_pass_id = str(pass_id or "")
+
 
 def mint_run_id(prefix: str = "run") -> str:
     """A fresh globally-unique run id (`<prefix>-<12 hex>`); core.py
@@ -246,6 +271,7 @@ def event(name: str, detail: str = "", log: Optional[object] = None) -> None:
             thread_id=threading.get_ident(),
             run_id=getattr(_tls, "run_id", ""),
             kind="instant",
+            pass_id=_current_pass_id,
         )
     )
     if int(get_config("verbose") or 0) >= 1:
@@ -277,10 +303,35 @@ def trace(name: str, log: Optional[object] = None) -> Iterator[None]:
                 thread_id=threading.get_ident(),
                 run_id=getattr(_tls, "run_id", ""),
                 kind="span",
+                pass_id=_current_pass_id,
             )
         )
         if int(get_config("verbose") or 0) >= 1:
             (log or logger).info(f"[trace] {'  ' * depth}{name}: {dt:.4f}s")
+
+
+def record_span(
+    name: str, t0_abs: float, t1_abs: float, detail: str = ""
+) -> None:
+    """Record an already-timed span from absolute epoch endpoints — for
+    producers that measured a window themselves (the pod layer's bounded
+    cross-process waits) and only want it on the trace after the fact.
+    Stamped with the active run id and the pod-global pass id exactly
+    like `trace()`."""
+    _append(
+        TraceEvent(
+            name,
+            max(t1_abs - t0_abs, 0.0),
+            getattr(_tls, "depth", 0),
+            detail,
+            t0=float(t0_abs),
+            t1=float(t1_abs),
+            thread_id=threading.get_ident(),
+            run_id=getattr(_tls, "run_id", ""),
+            kind="span",
+            pass_id=_current_pass_id,
+        )
+    )
 
 
 _profile_lock = threading.Lock()
